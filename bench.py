@@ -1,0 +1,97 @@
+"""Headline benchmark: ResNet-50 synthetic data-parallel training throughput.
+
+Mirrors the reference's synthetic benchmark protocol
+(reference: examples/pytorch/pytorch_synthetic_benchmark.py,
+docs/benchmarks.rst:67-83 — synthetic ImageNet-shaped data, timed train
+steps, images/sec). Runs the full framework train step (forward, backward,
+fused gradient allreduce over the mesh, SGD update) on every visible device
+of the current platform; on the CI host that is one TPU chip.
+
+Baseline: the reference's only published absolute throughput is ResNet-101
+at 1656.82 images/sec on 16 Pascal P100s = 103.55 images/sec/GPU
+(reference: docs/benchmarks.rst:32-43). vs_baseline reports
+images/sec/chip against that per-device number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+BATCH_PER_CHIP = 128
+WARMUP = 5
+ITERS = 20
+BASELINE_PER_DEVICE = 1656.82 / 16.0  # reference docs/benchmarks.rst:32-43
+
+
+def main():
+    from horovod_tpu.models import ResNet50
+    from horovod_tpu.parallel import dp, mesh as mesh_lib
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = mesh_lib.data_parallel_mesh(devices)
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.key(0)
+    batch_size = BATCH_PER_CHIP * n_dev
+    init_images = jnp.zeros((8, 224, 224, 3), jnp.bfloat16)
+    variables = model.init(rng, init_images, train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt = optax.sgd(0.05, momentum=0.9)
+
+    def loss_fn(params, batch, rng):
+        logits, new_model_state = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"], train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        return loss, {}
+
+    step = dp.make_train_step(loss_fn, opt, mesh, donate=False)
+
+    rs = np.random.RandomState(0)
+    batch = {
+        "image": dp.shard_batch(
+            jnp.asarray(rs.rand(batch_size, 224, 224, 3), jnp.bfloat16),
+            mesh),
+        "label": dp.shard_batch(
+            jnp.asarray(rs.randint(0, 1000, batch_size)), mesh),
+    }
+    params_d = dp.replicate(params, mesh)
+    opt_state = dp.replicate(opt.init(params), mesh)
+    key = jax.random.key(1)
+
+    for i in range(WARMUP):
+        out = step(params_d, opt_state, batch, key)
+        params_d, opt_state = out.params, out.opt_state
+    # Force completion with a host transfer: on remote-relay platforms
+    # block_until_ready can return before execution finishes.
+    float(out.loss)
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        out = step(params_d, opt_state, batch, key)
+        params_d, opt_state = out.params, out.opt_state
+    float(out.loss)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch_size * ITERS / dt
+    per_chip = images_per_sec / n_dev
+    print(json.dumps({
+        "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

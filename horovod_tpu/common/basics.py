@@ -1,0 +1,244 @@
+"""Process-level context: init/shutdown/rank/size and the default mesh.
+
+Mirrors the surface of the reference's ``horovod/common/basics.py`` (init,
+shutdown, rank, size, local_rank, local_size, cross_rank, cross_size,
+is_initialized, start_timeline, stop_timeline) — reference basics.py:27-258 —
+but TPU-native underneath:
+
+- topology comes from the launcher env contract (``HOROVOD_RANK`` etc., same
+  variable names the reference's gloo launcher exports,
+  reference: horovod/runner/gloo_run.py:65-78) or defaults to a single
+  process;
+- the *device* dimension is a `jax.sharding.Mesh` over this process's (or the
+  job's) devices — replica count = processes × local devices;
+- when the native coordination engine is available (horovod_tpu.engine), init
+  also boots its background thread for the eager/async collective path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+
+from horovod_tpu.parallel import mesh as mesh_lib
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+class _HorovodTpuContext:
+    """Singleton process context (reference analog: HorovodGlobalState,
+    horovod/common/global_state.h:43-132, minus the engine internals which
+    live in the native library)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.initialized = False
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.cross_rank = 0
+        self.cross_size = 1
+        self.mesh = None
+        self.engine = None  # native engine session, when booted
+        self.elastic = False
+
+    def init(self,
+             mesh_spec: Optional[mesh_lib.MeshSpec] = None,
+             devices: Optional[Sequence[jax.Device]] = None,
+             start_engine: Optional[bool] = None):
+        with self._lock:
+            if self.initialized:
+                return
+            self.rank = _env_int("HOROVOD_RANK", 0)
+            self.size = _env_int("HOROVOD_SIZE", 1)
+            self.local_rank = _env_int("HOROVOD_LOCAL_RANK", 0)
+            self.local_size = _env_int("HOROVOD_LOCAL_SIZE", 1)
+            self.cross_rank = _env_int("HOROVOD_CROSS_RANK", self.rank)
+            self.cross_size = _env_int("HOROVOD_CROSS_SIZE", self.size)
+            self.elastic = os.environ.get("HOROVOD_ELASTIC", "0") == "1"
+            try:
+                self.mesh = mesh_lib.build_mesh(mesh_spec, devices)
+                if start_engine is None:
+                    # Engine is required for the multi-process eager path; a
+                    # pure single-process SPMD program doesn't need it.
+                    start_engine = self.size > 1
+                if start_engine:
+                    from horovod_tpu.common import engine_client
+                    self.engine = engine_client.start(
+                        rank=self.rank, size=self.size,
+                        local_rank=self.local_rank,
+                        local_size=self.local_size)
+                self.initialized = True
+            except BaseException:
+                self.mesh = None
+                self.engine = None
+                raise
+
+    def shutdown(self):
+        with self._lock:
+            if not self.initialized:
+                return
+            if self.engine is not None:
+                self.engine.shutdown()
+                self.engine = None
+            self.mesh = None
+            self.initialized = False
+
+
+_ctx = _HorovodTpuContext()
+
+
+def _context() -> _HorovodTpuContext:
+    return _ctx
+
+
+def _require_init():
+    if not _ctx.initialized:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init().")
+
+
+def init(mesh_spec: Optional[mesh_lib.MeshSpec] = None,
+         devices: Optional[Sequence[jax.Device]] = None,
+         start_engine: Optional[bool] = None):
+    """Initialize the framework (reference: hvd.init, basics.py:33-65)."""
+    _ctx.init(mesh_spec=mesh_spec, devices=devices, start_engine=start_engine)
+
+
+def shutdown():
+    """Tear down (reference: hvd.shutdown, basics.py:67-73)."""
+    _ctx.shutdown()
+
+
+def is_initialized() -> bool:
+    return _ctx.initialized
+
+
+def rank() -> int:
+    """Global process rank (reference: basics.py:141-150)."""
+    _require_init()
+    return _ctx.rank
+
+
+def size() -> int:
+    """Number of processes (reference: basics.py:123-131)."""
+    _require_init()
+    return _ctx.size
+
+
+def local_rank() -> int:
+    _require_init()
+    return _ctx.local_rank
+
+
+def local_size() -> int:
+    _require_init()
+    return _ctx.local_size
+
+
+def cross_rank() -> int:
+    _require_init()
+    return _ctx.cross_rank
+
+
+def cross_size() -> int:
+    _require_init()
+    return _ctx.cross_size
+
+
+def num_replicas() -> int:
+    """Total data-parallel replicas.
+
+    The reference has exactly one device per rank so this equals size();
+    on TPU one process drives many chips, so the DP world is larger than the
+    process world. Gradient averaging / LR scaling uses this count.
+
+    Two multi-process shapes exist:
+    - ``jax.distributed`` SPMD: the mesh is built over the job's *global*
+      devices, so its data×fsdp extent already counts every replica.
+    - engine-coordinated separate processes: each process has a local mesh;
+      replicas = size × local extent.
+    """
+    _require_init()
+    m = _ctx.mesh
+    extent = m.shape["data"] * m.shape["fsdp"] if m is not None else 1
+    if jax.process_count() > 1:
+        return extent
+    return _ctx.size * extent
+
+
+def mesh():
+    """The process's default device mesh."""
+    _require_init()
+    return _ctx.mesh
+
+
+def is_homogeneous() -> bool:
+    """Reference: basics.py:183-189 (same local_size on every host)."""
+    _require_init()
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    """Build-capability parity shim (reference: basics.py:191-206). The TPU
+    build has no MPI; the eager path is always thread-safe."""
+    return True
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    """The native TCP controller plays the role Gloo plays in the reference."""
+    return True
+
+
+def gloo_built() -> bool:
+    return True
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False):
+    """Start engine timeline capture (reference: basics.py:75-98)."""
+    _require_init()
+    if _ctx.engine is None:
+        raise RuntimeError("timeline requires the native engine (size>1 or "
+                           "init(start_engine=True))")
+    _ctx.engine.start_timeline(file_path, mark_cycles)
+
+
+def stop_timeline():
+    _require_init()
+    if _ctx.engine is not None:
+        _ctx.engine.stop_timeline()

@@ -1,0 +1,19 @@
+"""Client for the native coordination engine (horovod_tpu/engine).
+
+The engine provides the reference's background-thread machinery — async
+enqueue, rank-0 negotiation, tensor fusion, response cache, stall inspection,
+timeline (reference: horovod/common/operations.cc:358-587) — as a C++ shared
+library driven over ctypes. This module owns loading the library and the
+session lifecycle.
+"""
+
+from __future__ import annotations
+
+
+def start(rank: int, size: int, local_rank: int, local_size: int):
+    """Boot the native engine for this process. Raises until the native
+    library is built (phase 2 of the build plan, SURVEY §7.1-2)."""
+    from horovod_tpu.engine import bindings
+    return bindings.EngineSession(rank=rank, size=size,
+                                  local_rank=local_rank,
+                                  local_size=local_size)

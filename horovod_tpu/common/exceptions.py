@@ -1,0 +1,39 @@
+"""Framework exceptions.
+
+Mirrors the surface of the reference's ``horovod/common/exceptions.py``
+(HorovodInternalError, HostsUpdatedInterrupt) so elastic training loops can
+catch the same classes of failure.
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective operation fails.
+
+    In elastic mode this triggers state restoration and re-initialization
+    (reference: horovod/common/exceptions.py:19).
+    """
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised when the elastic driver notifies workers of a host-set change.
+
+    Carries ``skip_sync``: if the update was an addition only, state sync can
+    be skipped on reset (reference: horovod/common/exceptions.py:24-31).
+    """
+
+    def __init__(self, skip_sync=False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class HorovodShapeMismatchError(HorovodInternalError):
+    """Ranks submitted the same tensor name with mismatched shapes/dtypes.
+
+    The reference's coordinator constructs an error Response in this case
+    (reference: horovod/common/controller.cc:471-748); we surface it as a
+    dedicated subclass so tests can assert on it precisely.
+    """
+
+
+class WorkersAvailableException(RuntimeError):
+    """Elastic driver found new workers available (used to trigger re-rendezvous)."""

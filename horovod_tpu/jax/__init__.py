@@ -1,0 +1,154 @@
+"""The JAX user frontend — analog of the reference's ``horovod.torch`` /
+``horovod.tensorflow`` packages (reference: horovod/torch/__init__.py,
+horovod/tensorflow/__init__.py:568-742).
+
+The reference wraps an imperative optimizer and hooks per-parameter gradient
+callbacks; the optax analog wraps a GradientTransformation so the fused
+gradient allreduce happens inside the one compiled train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    cross_rank, cross_size, init, is_initialized, local_rank, local_size,
+    mesh, num_replicas, rank, shutdown, size, start_timeline, stop_timeline,
+)
+from horovod_tpu.jax.compression import Compression  # noqa: F401
+from horovod_tpu.ops.fusion import fused_apply_tree
+from horovod_tpu.parallel import collectives
+from horovod_tpu.parallel.collectives import (  # noqa: F401
+    Adasum, Average, Max, Min, Op, Product, Sum,
+    allgather, allreduce, alltoall, barrier, broadcast, grouped_allreduce,
+    reducescatter,
+)
+from horovod_tpu.parallel.dp import DP_AXES, make_eval_step, make_train_step  # noqa: F401
+
+
+class _DistOptState(NamedTuple):
+    count: jax.Array          # microsteps since last boundary
+    accum: Any                # local gradient accumulator (bpps > 1) or ()
+    inner: Any                # wrapped transformation state
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         *,
+                         op: Op = Average,
+                         axis=DP_AXES,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = True,
+                         gradient_predivide_factor: float = 1.0,
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax transformation with cross-replica gradient reduction.
+
+    Parity with reference DistributedOptimizer knobs
+    (horovod/torch/optimizer.py:443-508): ``op``, ``compression``,
+    ``backward_passes_per_step`` (local aggregation, fewer collectives),
+    ``gradient_predivide_factor`` (splits the averaging divisor across
+    pre/post scaling, reference torch/__init__.py). Use inside shard_map /
+    a mesh context — the reduction is ``lax.psum`` over the DP axes, fused
+    per dtype into single collectives.
+    """
+    if gradient_predivide_factor != 1.0 and op is not Average:
+        raise ValueError("gradient_predivide_factor supported only with Average")
+    if compression is None:
+        compression = Compression.none
+    bpps = int(backward_passes_per_step)
+    if bpps < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    def _reduce(tree):
+        if gradient_predivide_factor != 1.0:
+            pre = 1.0 / gradient_predivide_factor
+            # Average = sum * (1/size); split the divisor around the wire.
+            def red(v):
+                v, ctx = compression.compress(v)
+                ax = _axes_in_scope(axis)
+                out = collectives.allreduce(
+                    v, op=Sum, axis=ax,
+                    prescale_factor=pre,
+                    postscale_factor=gradient_predivide_factor
+                    / collectives.axis_size(ax),
+                    accumulate_in_fp32=compression is Compression.none)
+                return compression.decompress(out, ctx)
+        else:
+            def red(v):
+                v, ctx = compression.compress(v)
+                out = collectives.allreduce(
+                    v, op=op, axis=_axes_in_scope(axis),
+                    accumulate_in_fp32=compression is Compression.none)
+                return compression.decompress(out, ctx)
+        return fused_apply_tree(red, tree)
+
+    def _axes_in_scope(ax):
+        # Filter requested axes down to those bound in the current trace so
+        # the same optimizer works under any mesh shape.
+        names = ax if isinstance(ax, (tuple, list)) else (ax,)
+        bound = []
+        for name in names:
+            try:
+                jax.lax.axis_size(name)
+            except Exception:
+                continue
+            bound.append(name)
+        if not bound:
+            raise RuntimeError(
+                f"DistributedOptimizer: none of axes {names} are bound; call "
+                "the update inside shard_map over the mesh")
+        return tuple(bound)
+
+    def init_fn(params):
+        accum = () if bpps == 1 else jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p), params)
+        return _DistOptState(jnp.zeros((), jnp.int32), accum,
+                             optimizer.init(params))
+
+    def update_fn(grads, state, params=None):
+        if bpps == 1:
+            updates, inner = optimizer.update(_reduce(grads), state.inner, params)
+            return updates, _DistOptState(state.count + 1, (), inner)
+
+        accum = jax.tree_util.tree_map(lambda a, g: a + g, state.accum, grads)
+        count = state.count + 1
+        is_boundary = (count % bpps) == 0
+
+        def boundary(args):
+            accum, inner = args
+            scale = (1.0 / bpps) if average_aggregated_gradients else 1.0
+            g = jax.tree_util.tree_map(lambda a: a * scale, accum)
+            updates, new_inner = optimizer.update(_reduce(g), inner, params)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            return updates, zeroed, new_inner
+
+        def skip(args):
+            accum, inner = args
+            updates = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            return updates, accum, inner
+
+        updates, accum, inner = jax.lax.cond(
+            is_boundary, boundary, skip, (accum, state.inner))
+        return updates, _DistOptState(count, accum, inner)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def broadcast_parameters(params, root_rank: int = 0, axis=DP_AXES):
+    """In-program tree broadcast from ``root_rank`` (reference:
+    horovod/torch/functions.py:29-112 broadcast_parameters), fused per dtype
+    into single collectives. Use inside shard_map; for host-side state sync
+    across processes use broadcast_object (engine path)."""
+    return fused_apply_tree(
+        lambda v: collectives.broadcast(v, root_rank, axis), params)
+
+
+def metric_average(value, axis=DP_AXES):
+    """Average a scalar metric across replicas (reference: the
+    ``metric_average`` pattern in examples/pytorch/pytorch_mnist.py and
+    MetricAverageCallback, horovod/_keras/callbacks.py:48-88)."""
+    return collectives.allreduce(jnp.asarray(value), op=Average, axis=axis)

@@ -1,0 +1,9 @@
+from horovod_tpu.models.mnist import MnistConvNet  # noqa: F401
+from horovod_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)

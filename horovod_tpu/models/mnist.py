@@ -1,0 +1,37 @@
+"""MNIST ConvNet — the minimum end-to-end training slice.
+
+Parity target: the reference's ``examples/pytorch/pytorch_mnist.py`` Net
+(2 conv + dropout + 2 fc) used as its DistributedOptimizer smoke-test model.
+Written in flax.linen with a dtype knob so the same module runs bf16 on the
+MXU and f32 on CPU test meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistConvNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        # x: [B, 28, 28, 1] (NHWC; the reference's torch model is NCHW — NHWC
+        # is the TPU-native layout).
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(50, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,1 @@
+from horovod_tpu.ops.fusion import fused_apply, fused_apply_tree  # noqa: F401

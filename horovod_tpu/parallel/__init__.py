@@ -1,0 +1,19 @@
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    MeshSpec,
+    axis_size,
+    batch_sharding,
+    build_mesh,
+    data_parallel_mesh,
+    replicated,
+)
+from horovod_tpu.parallel import collectives  # noqa: F401
+from horovod_tpu.parallel.collectives import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Op,
+    Product,
+    Sum,
+)

@@ -1,0 +1,201 @@
+"""In-program collective primitives over named mesh axes.
+
+This is the TPU data plane: where the reference dispatches to
+NCCL/MPI/Gloo/oneCCL library calls on raw buffers (reference:
+horovod/common/ops/nccl_operations.cc:126-184, mpi_operations.cc,
+gloo_operations.cc), a TPU program expresses collectives *inside* the compiled
+computation and XLA lowers them onto ICI. These functions are meant to be used
+under ``jax.shard_map`` / ``pjit`` with a mesh from
+:mod:`horovod_tpu.parallel.mesh`.
+
+API parity (reference: horovod/torch/mpi_ops.py, horovod/tensorflow/mpi_ops.py):
+allreduce / grouped_allreduce / allgather / broadcast / alltoall (+
+reducescatter and barrier, which the reference composes internally), each with
+``op`` ∈ {Average, Sum, Adasum, Min, Max, Product} and
+prescale/postscale factors (reference: horovod/common/message.h Request
+prescale_factor/postscale_factor).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Op(enum.Enum):
+    """Reduction ops (reference: horovod/common/common.h ReduceOp + Python
+    Average/Sum/Adasum/Min/Max/Product constants in torch/mpi_ops.py:60-76)."""
+
+    AVERAGE = "average"
+    SUM = "sum"
+    ADASUM = "adasum"
+    MIN = "min"
+    MAX = "max"
+    PRODUCT = "product"
+
+
+Average = Op.AVERAGE
+Sum = Op.SUM
+Adasum = Op.ADASUM
+Min = Op.MIN
+Max = Op.MAX
+Product = Op.PRODUCT
+
+# Default axis: data parallelism — the reference's only axis (SURVEY §2.8).
+DEFAULT_AXIS = "data"
+
+
+def _scale(x, factor):
+    if factor is None or factor == 1.0:
+        return x
+    # Match reference semantics: scaling happens in the tensor's dtype for
+    # integral types, fp32 accumulation for fp16 (common/ops ScaleBuffer).
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return (x * factor).astype(x.dtype)
+    return (x.astype(jnp.float32) * factor).astype(x.dtype) \
+        if x.dtype in (jnp.float16, jnp.bfloat16) else x * factor
+
+
+def _axes(axis) -> tuple:
+    return tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+
+
+def axis_size(axis=DEFAULT_AXIS) -> int:
+    """Total extent across one or several named axes (static)."""
+    n = 1
+    for a in _axes(axis):
+        n *= lax.axis_size(a)
+    return n
+
+
+def axis_rank(axis=DEFAULT_AXIS) -> jax.Array:
+    """Linearized index across one or several named axes (row-major in the
+    order given)."""
+    idx = jnp.zeros((), jnp.int32)
+    for a in _axes(axis):
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def allreduce(x: jax.Array,
+              op: Op = Average,
+              axis=DEFAULT_AXIS,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              accumulate_in_fp32: bool = True) -> jax.Array:
+    """Reduce ``x`` across ``axis`` (reference: EnqueueTensorAllreduce,
+    horovod/common/operations.cc:902 → NCCLAllreduce::Execute).
+
+    ``accumulate_in_fp32=False`` keeps low-precision inputs in their dtype on
+    the wire — the point of fp16/bf16 compression (half the ICI bytes);
+    compressed paths set it."""
+    x = _scale(x, prescale_factor)
+    if op in (Average, Sum):
+        # Default: sum in fp32 for low-precision inputs — same accumulation
+        # contract as the reference's fp16 AVX kernels summing into fp32
+        # (common/half.cc).
+        orig_dtype = x.dtype
+        if accumulate_in_fp32 and orig_dtype in (jnp.float16, jnp.bfloat16):
+            x = x.astype(jnp.float32)
+        out = lax.psum(x, axis)
+        if op is Average:
+            out = out / axis_size(axis)
+        out = out.astype(orig_dtype)
+    elif op is Min:
+        out = lax.pmin(x, axis)
+    elif op is Max:
+        out = lax.pmax(x, axis)
+    elif op is Product:
+        # No native pprod: gather then reduce locally (XLA fuses the reduce).
+        out = jnp.prod(lax.all_gather(x, axis, axis=0), axis=0)
+    elif op is Adasum:
+        from horovod_tpu.parallel.adasum import adasum_allreduce
+        out = adasum_allreduce(x, axis)
+    else:
+        raise ValueError(f"unknown op {op}")
+    return _scale(out, postscale_factor)
+
+
+def grouped_allreduce(xs: Sequence[jax.Array],
+                      op: Op = Average,
+                      axis=DEFAULT_AXIS,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> list:
+    """Allreduce a group as one fused collective.
+
+    The reference fuses grouped entries through the fusion buffer as an atomic
+    unit (reference: GroupTable, horovod/common/operations.cc:1008-1015). Here
+    we concatenate flattened tensors per dtype-class into a single psum — one
+    ICI collective instead of len(xs).
+    """
+    from horovod_tpu.ops.fusion import fused_apply
+    fn = functools.partial(allreduce, op=op, axis=axis,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+    return fused_apply(fn, list(xs))
+
+
+def allgather(x: jax.Array, axis=DEFAULT_AXIS) -> jax.Array:
+    """Concatenate ``x`` from every rank along dim 0 (reference:
+    EnqueueTensorAllgather, horovod/common/operations.cc:1027; output
+    allocation logic collective_operations.h:95-170).
+
+    Inside a compiled program shapes are static, so this is the equal-shape
+    case; ragged first dims (reference controller.cc:576-648 computes
+    per-rank sizes) are handled by the eager engine path via padding
+    (horovod_tpu.jax.mpi_ops).
+    """
+    return lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+def broadcast(x: jax.Array, root_rank: int, axis=DEFAULT_AXIS) -> jax.Array:
+    """Every rank receives root's value (reference: EnqueueTensorBroadcast,
+    operations.cc:1062). Implemented as a masked psum — a single collective,
+    no gather of all shards."""
+    idx = axis_rank(axis)
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32) if orig_dtype in (jnp.float16, jnp.bfloat16, jnp.bool_) \
+        else x
+    masked = jnp.where(idx == root_rank, xf, jnp.zeros_like(xf))
+    out = lax.psum(masked, axis)
+    return out.astype(orig_dtype)
+
+
+def alltoall(x: jax.Array,
+             axis=DEFAULT_AXIS,
+             split_axis: int = 0,
+             concat_axis: int = 0) -> jax.Array:
+    """Scatter equal slices of ``x`` to every rank and gather their slices
+    (reference: EnqueueTensorAlltoall, operations.cc:1101; even-split case of
+    MPI_Alltoallv). Ragged splits go through the eager engine path."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def reducescatter(x: jax.Array, op: Op = Average, axis=DEFAULT_AXIS) -> jax.Array:
+    """Reduce-scatter along dim 0. The reference uses this as a building block
+    (NCCLHierarchicalAllreduce's intra-node phase,
+    ops/nccl_operations.cc:186-398); we expose it first-class because
+    psum_scatter is the natural TPU gradient-sharding primitive."""
+    out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    if op is Average:
+        out = (out.astype(jnp.float32) / axis_size(axis)).astype(x.dtype)
+    return out
+
+
+def barrier(axis=DEFAULT_AXIS) -> None:
+    """Synchronization point (reference: controller Barrier,
+    controller.h:158). In a compiled SPMD program a tiny psum serves as a
+    cross-replica fence."""
+    lax.psum(jnp.zeros((), jnp.float32), axis)
+
+
+def ppermute(x: jax.Array, perm, axis=DEFAULT_AXIS) -> jax.Array:
+    """Point-to-point ring/permutation exchange — the ICI-native primitive
+    ring attention and Adasum's recursive exchanges build on."""
+    return lax.ppermute(x, axis, perm)

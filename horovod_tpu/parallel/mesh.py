@@ -1,0 +1,104 @@
+"""Device-mesh construction — the TPU-native replacement for the reference's
+process topology (rank / local_rank / cross_rank).
+
+The reference derives a two-level topology from MPI communicator splits
+(reference: horovod/common/mpi/mpi_controller.cc:26-82 — global, per-node
+"local", and cross-node communicators). On TPU the equivalent structure is a
+`jax.sharding.Mesh` over the slice's devices: the "local" level is intra-host
+(or intra-slice ICI) and the "cross" level is DCN between slices. XLA lowers
+collectives onto ICI links when shardings keep an axis inside a slice, so the
+mesh axis order below puts the fastest-varying (largest-bandwidth) axes last.
+
+Axis vocabulary (superset of the reference, which is data-parallel only —
+reference SURVEY §2.8):
+
+- ``data``     — data parallelism (the reference's one and only axis)
+- ``fsdp``     — parameter/optimizer sharding within data parallelism
+- ``model``    — tensor parallelism
+- ``seq``      — sequence/context parallelism (ring attention, Ulysses)
+- ``pipe``     — pipeline stages
+- ``expert``   — MoE expert parallelism
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis order: slower/cheaper axes first, bandwidth-hungry axes last
+# so they land on contiguous (ICI-adjacent) devices.
+AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism degrees. -1 on ``data`` means "all remaining"."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+    pipe: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> dict:
+        sizes = {
+            "pipe": self.pipe,
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "expert": self.expert,
+            "seq": self.seq,
+            "model": self.model,
+        }
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        n_wild = sum(1 for v in sizes.values() if v == -1)
+        if n_wild > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if n_wild == 1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            wild = n_devices // fixed
+            sizes = {k: (wild if v == -1 else v) for k, v in sizes.items()}
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def build_mesh(spec: Optional[MeshSpec] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all global devices).
+
+    Degenerate (size-1) axes are kept in the mesh so PartitionSpecs can always
+    name every axis — XLA elides collectives over size-1 axes for free.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The Horovod topology: pure data parallelism over every device."""
+    return build_mesh(MeshSpec(data=-1), devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over data(+fsdp) — inputs to a DP step."""
+    return NamedSharding(mesh, P(("data", "fsdp")))
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]

@@ -1,0 +1,51 @@
+"""Model-family sanity tests (reference analog: the models exercised by
+examples/pytorch/pytorch_mnist.py and pytorch_imagenet_resnet50.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.models import MnistConvNet, ResNet18, ResNet50
+
+
+def _param_count(tree):
+    return sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_mnist_convnet_shapes():
+    model = MnistConvNet()
+    variables = model.init(jax.random.key(0), jnp.zeros((2, 28, 28, 1)))
+    out = model.apply(variables, jnp.zeros((4, 28, 28, 1)), train=False)
+    assert out.shape == (4, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_resnet18_forward():
+    model = ResNet18(num_classes=10)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)),
+                           train=False)
+    out = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_param_count():
+    """ResNet-50 ImageNet has ~25.56M params (torchvision parity)."""
+    model = ResNet50(num_classes=1000)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    n = _param_count(variables["params"])
+    assert 25.4e6 < n < 25.7e6, f"param count {n}"
+
+
+def test_resnet50_train_mode_updates_batch_stats():
+    model = ResNet50(num_classes=10, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    out, new_state = model.apply(variables, x, train=True,
+                                 mutable=["batch_stats"])
+    assert out.shape == (2, 10)
+    # batch stats must actually move
+    old = jax.tree_util.tree_leaves(variables["batch_stats"])
+    new = jax.tree_util.tree_leaves(new_state["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))
