@@ -47,15 +47,15 @@ def main():
     batch_stats = variables.get("batch_stats", {})
     opt = optax.sgd(0.05, momentum=0.9)
 
-    def loss_fn(params, batch, rng):
+    def loss_fn(params, model_state, batch, rng):
         logits, new_model_state = model.apply(
-            {"params": params, "batch_stats": batch_stats},
+            {"params": params, "batch_stats": model_state},
             batch["image"], train=True, mutable=["batch_stats"])
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["label"]).mean()
-        return loss, {}
+        return loss, (new_model_state["batch_stats"], {})
 
-    step = dp.make_train_step(loss_fn, opt, mesh, donate=False)
+    step = dp.make_stateful_train_step(loss_fn, opt, mesh, donate=False)
 
     rs = np.random.RandomState(0)
     batch = {
@@ -67,19 +67,22 @@ def main():
     }
     params_d = dp.replicate(params, mesh)
     opt_state = dp.replicate(opt.init(params), mesh)
+    state_d = dp.replicate(batch_stats, mesh)
     key = jax.random.key(1)
 
     for i in range(WARMUP):
-        out = step(params_d, opt_state, batch, key)
-        params_d, opt_state = out.params, out.opt_state
+        out = step(params_d, opt_state, state_d, batch, key)
+        params_d, opt_state, state_d = (out.params, out.opt_state,
+                                        out.model_state)
     # Force completion with a host transfer: on remote-relay platforms
     # block_until_ready can return before execution finishes.
     float(out.loss)
 
     t0 = time.perf_counter()
     for i in range(ITERS):
-        out = step(params_d, opt_state, batch, key)
-        params_d, opt_state = out.params, out.opt_state
+        out = step(params_d, opt_state, state_d, batch, key)
+        params_d, opt_state, state_d = (out.params, out.opt_state,
+                                        out.model_state)
     float(out.loss)
     dt = time.perf_counter() - t0
 

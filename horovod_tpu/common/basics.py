@@ -18,6 +18,7 @@ but TPU-native underneath:
 from __future__ import annotations
 
 import os
+import subprocess
 import threading
 from typing import Optional, Sequence
 
@@ -66,15 +67,24 @@ class _HorovodTpuContext:
             try:
                 self.mesh = mesh_lib.build_mesh(mesh_spec, devices)
                 if start_engine is None:
-                    # Engine is required for the multi-process eager path; a
-                    # pure single-process SPMD program doesn't need it.
-                    start_engine = self.size > 1
+                    # The engine serves the eager multi-process path. A
+                    # jax.distributed SPMD job (process_count > 1) does its
+                    # collectives inside jit and doesn't need it.
+                    start_engine = self.size > 1 and jax.process_count() == 1
                 if start_engine:
                     from horovod_tpu.common import engine_client
-                    self.engine = engine_client.start(
-                        rank=self.rank, size=self.size,
-                        local_rank=self.local_rank,
-                        local_size=self.local_size)
+                    try:
+                        self.engine = engine_client.start(
+                            rank=self.rank, size=self.size,
+                            local_rank=self.local_rank,
+                            local_size=self.local_size)
+                    except (ImportError, OSError,
+                            subprocess.CalledProcessError) as e:
+                        raise RuntimeError(
+                            "the native coordination engine could not be "
+                            "loaded/built (run `make -C horovod_tpu/engine`); "
+                            "pass init(start_engine=False) for a pure-SPMD "
+                            f"run without the eager path. Cause: {e}") from e
                 self.initialized = True
             except BaseException:
                 self.mesh = None

@@ -1,0 +1,13 @@
+from horovod_tpu.engine.bindings import (  # noqa: F401
+    DTYPE_IDS,
+    DTYPE_NAMES,
+    OP_ALLGATHER,
+    OP_ALLREDUCE,
+    OP_ALLTOALL,
+    OP_BARRIER,
+    OP_BROADCAST,
+    OP_JOIN,
+    EngineSession,
+    build_library,
+    load_library,
+)

@@ -1,0 +1,287 @@
+"""ctypes bindings to the native coordination engine (libhvdtpu_core.so).
+
+Reference analog: horovod/common/basics.py loading the framework .so via
+ctypes (basics.py:27-65) — here the library is framework-neutral and
+session-based, so a single test process can host N engine ranks coordinating
+over the in-process loopback transport.
+
+Env knobs honored (same names as the reference, common/common.h:65-93):
+HOROVOD_CYCLE_TIME (ms), HOROVOD_FUSION_THRESHOLD (bytes),
+HOROVOD_CACHE_CAPACITY, HOROVOD_STALL_CHECK_TIME_SECONDS,
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, HOROVOD_STALL_CHECK_DISABLE,
+HOROVOD_TIMELINE, HOROVOD_TIMELINE_MARK_CYCLES,
+HOROVOD_CONTROLLER_TIMEOUT_SECONDS (TCP transport recv timeout; plays the
+role of HOROVOD_GLOO_TIMEOUT_SECONDS).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Callable, Optional, Sequence
+
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+# Engine wire dtype ids (engine/src/common.h DataType).
+DTYPE_IDS = {
+    "uint8": 0, "int8": 1, "uint16": 2, "int16": 3, "int32": 4,
+    "int64": 5, "float16": 6, "float32": 7, "float64": 8, "bool": 9,
+    "bfloat16": 10,
+}
+DTYPE_NAMES = {v: k for k, v in DTYPE_IDS.items()}
+
+# Op ids (engine/src/common.h OpType).
+OP_ALLREDUCE = 0
+OP_ALLGATHER = 1
+OP_BROADCAST = 2
+OP_ALLTOALL = 3
+OP_JOIN = 4
+OP_BARRIER = 5
+
+_EXECUTE_CB = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_char_p,
+                               ctypes.c_void_p)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _lib_path() -> Path:
+    return Path(__file__).parent / "build" / "libhvdtpu_core.so"
+
+
+def build_library(force: bool = False) -> Path:
+    path = _lib_path()
+    if path.exists() and not force:
+        return path
+    subprocess.run(["make", "-C", str(Path(__file__).parent)], check=True,
+                   capture_output=True)
+    return path
+
+
+def load_library():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = build_library()
+        lib = ctypes.CDLL(str(path))
+        lib.hvdtpu_create_session.restype = ctypes.c_int64
+        lib.hvdtpu_create_session.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int64,
+            ctypes.c_uint32, ctypes.c_int32, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_int32,
+        ]
+        lib.hvdtpu_destroy_session.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_shutdown.argtypes = [ctypes.c_int64]
+        for fn in ("hvdtpu_rank", "hvdtpu_size", "hvdtpu_local_rank",
+                   "hvdtpu_local_size", "hvdtpu_healthy"):
+            getattr(lib, fn).argtypes = [ctypes.c_int64]
+            getattr(lib, fn).restype = ctypes.c_int32
+        lib.hvdtpu_set_execute_callback.argtypes = [
+            ctypes.c_int64, _EXECUTE_CB, ctypes.c_void_p]
+        lib.hvdtpu_enqueue.restype = ctypes.c_int32
+        lib.hvdtpu_enqueue.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.hvdtpu_join.argtypes = [ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_int64)]
+        lib.hvdtpu_poll.restype = ctypes.c_int32
+        lib.hvdtpu_poll.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.c_char_p, ctypes.c_int32]
+        lib.hvdtpu_wait.restype = ctypes.c_int32
+        lib.hvdtpu_wait.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.c_double, ctypes.c_char_p,
+                                    ctypes.c_int32]
+        lib.hvdtpu_start_timeline.argtypes = [ctypes.c_int64,
+                                              ctypes.c_char_p,
+                                              ctypes.c_int32]
+        lib.hvdtpu_stop_timeline.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_last_error.restype = ctypes.c_char_p
+        _lib = lib
+        return _lib
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    return float(v) if v not in (None, "") else default
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+class EngineSession:
+    """One engine rank: background coordination thread + async handles."""
+
+    def __init__(self,
+                 rank: int,
+                 size: int,
+                 local_rank: int = 0,
+                 local_size: int = 1,
+                 transport: str = "tcp",
+                 group: str = "default",
+                 addr: Optional[str] = None,
+                 port: Optional[int] = None,
+                 cycle_time_ms: Optional[float] = None,
+                 fusion_threshold: Optional[int] = None,
+                 cache_capacity: Optional[int] = None,
+                 stall_warning_sec: Optional[float] = None,
+                 stall_shutdown_sec: Optional[float] = None,
+                 timeout_sec: Optional[float] = None):
+        self._lib = load_library()
+        addr = addr or os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
+        port = port if port is not None else \
+            _env_int("HOROVOD_CONTROLLER_PORT", 0)
+        cycle_time_ms = cycle_time_ms if cycle_time_ms is not None else \
+            _env_float("HOROVOD_CYCLE_TIME", 1.0)
+        fusion_threshold = fusion_threshold if fusion_threshold is not None \
+            else _env_int("HOROVOD_FUSION_THRESHOLD", 64 << 20)
+        cache_capacity = cache_capacity if cache_capacity is not None else \
+            _env_int("HOROVOD_CACHE_CAPACITY", 1024)
+        stall_warning_sec = stall_warning_sec if stall_warning_sec is not None\
+            else _env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0)
+        stall_shutdown_sec = stall_shutdown_sec if stall_shutdown_sec is not \
+            None else _env_float("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0)
+        stall_disable = os.environ.get("HOROVOD_STALL_CHECK_DISABLE",
+                                       "0") == "1"
+        timeout_sec = timeout_sec if timeout_sec is not None else \
+            _env_float("HOROVOD_CONTROLLER_TIMEOUT_SECONDS", 30.0)
+        timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
+        timeline_cycles = os.environ.get("HOROVOD_TIMELINE_MARK_CYCLES",
+                                         "0") == "1"
+
+        self._session = self._lib.hvdtpu_create_session(
+            rank, size, local_rank, local_size,
+            transport.encode(),
+            (group if transport == "loopback" else addr).encode(),
+            port, timeout_sec, cycle_time_ms, fusion_threshold,
+            cache_capacity, 1 if cache_capacity > 0 else 0,
+            stall_warning_sec, stall_shutdown_sec,
+            1 if stall_disable else 0,
+            timeline_path.encode() if timeline_path else None,
+            1 if timeline_cycles else 0)
+        if self._session <= 0:
+            raise HorovodInternalError(
+                "engine init failed: " +
+                self._lib.hvdtpu_last_error().decode())
+        self._cb_ref = None  # keep the CFUNCTYPE alive
+        self._destroyed = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self):
+        if not self._destroyed:
+            self._lib.hvdtpu_shutdown(self._session)
+            self.destroy()
+
+    def destroy(self):
+        if not self._destroyed:
+            self._lib.hvdtpu_destroy_session(self._session)
+            self._destroyed = True
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def rank(self):
+        return self._lib.hvdtpu_rank(self._session)
+
+    @property
+    def size(self):
+        return self._lib.hvdtpu_size(self._session)
+
+    @property
+    def healthy(self):
+        return self._lib.hvdtpu_healthy(self._session) == 1
+
+    # -- data plane hookup --------------------------------------------------
+
+    def set_execute_callback(self, fn: Callable[[dict], int]):
+        """Register the data-plane executor. ``fn`` receives the fused
+        response dict {type, names, dtypes, shapes, sizes, joined_ranks,
+        reduce_op, root_rank, prescale, postscale} and returns 0 on
+        success."""
+
+        def c_callback(json_bytes, _user):
+            try:
+                return int(fn(json.loads(json_bytes.decode())))
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                return 1
+
+        self._cb_ref = _EXECUTE_CB(c_callback)
+        self._lib.hvdtpu_set_execute_callback(self._session, self._cb_ref,
+                                              None)
+
+    # -- async op surface ---------------------------------------------------
+
+    def enqueue(self, name: str, op_type: int, dtype: str,
+                shape: Sequence[int], root_rank: int = 0,
+                reduce_op: int = 0, prescale_factor: float = 1.0,
+                postscale_factor: float = 1.0, group_id: int = -1,
+                group_size: int = 0,
+                splits: Optional[Sequence[int]] = None) -> int:
+        dims = (ctypes.c_int64 * len(shape))(*shape)
+        csplits = None
+        nsplits = 0
+        if splits:
+            csplits = (ctypes.c_int64 * len(splits))(*splits)
+            nsplits = len(splits)
+        handle = ctypes.c_int64(-1)
+        rc = self._lib.hvdtpu_enqueue(
+            self._session, name.encode(), op_type, DTYPE_IDS[dtype], dims,
+            len(shape), root_rank, reduce_op, prescale_factor,
+            postscale_factor, group_id, group_size, csplits, nsplits,
+            ctypes.byref(handle))
+        if rc != 0:
+            raise HorovodInternalError(
+                self._lib.hvdtpu_last_error().decode())
+        return handle.value
+
+    def join(self) -> int:
+        handle = ctypes.c_int64(-1)
+        rc = self._lib.hvdtpu_join(self._session, ctypes.byref(handle))
+        if rc != 0:
+            raise HorovodInternalError(
+                self._lib.hvdtpu_last_error().decode())
+        return handle.value
+
+    def poll(self, handle: int):
+        buf = ctypes.create_string_buffer(4096)
+        rc = self._lib.hvdtpu_poll(self._session, handle, buf, len(buf))
+        if rc < 0:
+            raise HorovodInternalError(
+                self._lib.hvdtpu_last_error().decode())
+        return rc == 1, buf.value.decode()
+
+    def wait(self, handle: int, timeout: float = 0.0):
+        """Blocks until the op completes; raises HorovodInternalError on
+        coordination/validation/data-plane failure."""
+        buf = ctypes.create_string_buffer(8192)
+        rc = self._lib.hvdtpu_wait(self._session, handle, timeout, buf,
+                                   len(buf))
+        if rc != 0:
+            raise HorovodInternalError(buf.value.decode() or
+                                       "collective failed")
+
+    # -- timeline -----------------------------------------------------------
+
+    def start_timeline(self, path: str, mark_cycles: bool = False):
+        self._lib.hvdtpu_start_timeline(self._session, path.encode(),
+                                        1 if mark_cycles else 0)
+
+    def stop_timeline(self):
+        self._lib.hvdtpu_stop_timeline(self._session)

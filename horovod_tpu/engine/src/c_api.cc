@@ -1,0 +1,235 @@
+// C ABI for ctypes (reference analog: horovod/common/operations.cc:710-898 —
+// the horovod_* C functions loaded by common/basics.py).
+//
+// Session-based rather than singleton so one test process can host N engine
+// instances coordinating over the loopback transport (the reference needs a
+// real multi-process harness for this; SURVEY §7.2 calls out the
+// single-process N-rank testability win).
+
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "engine.h"
+
+using namespace hvdtpu;
+
+namespace {
+
+std::mutex g_mu;
+std::map<int64_t, std::unique_ptr<Engine>> g_sessions;
+int64_t g_next_session = 1;
+thread_local std::string g_last_error;
+
+Engine* GetSession(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_sessions.find(id);
+  return it == g_sessions.end() ? nullptr : it->second.get();
+}
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+}  // namespace
+
+extern "C" {
+
+// Returns session id > 0, or <= 0 on failure (error via
+// hvdtpu_last_error()). transport_kind: "loopback" or "tcp".
+int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,
+                              int32_t local_size, const char* transport_kind,
+                              const char* group_or_addr, int32_t port,
+                              double timeout_sec, double cycle_time_ms,
+                              int64_t fusion_threshold_bytes,
+                              uint32_t cache_capacity,
+                              int32_t cache_enabled,
+                              double stall_warning_sec,
+                              double stall_shutdown_sec,
+                              int32_t stall_check_disable,
+                              const char* timeline_path,
+                              int32_t timeline_mark_cycles) {
+  EngineOptions opts;
+  opts.cycle_time_ms = cycle_time_ms;
+  opts.fusion_threshold_bytes = fusion_threshold_bytes;
+  opts.cache_capacity = cache_capacity;
+  opts.cache_enabled = cache_enabled != 0;
+  opts.stall_warning_time_sec = stall_warning_sec;
+  opts.stall_shutdown_time_sec = stall_shutdown_sec;
+  opts.stall_check_disable = stall_check_disable != 0;
+  if (timeline_path != nullptr) opts.timeline_path = timeline_path;
+  opts.timeline_mark_cycles = timeline_mark_cycles != 0;
+
+  TransportConfig tcfg;
+  tcfg.kind = transport_kind ? transport_kind : "loopback";
+  if (tcfg.kind == "loopback") {
+    tcfg.group = group_or_addr ? group_or_addr : "default";
+  } else {
+    tcfg.addr = group_or_addr ? group_or_addr : "127.0.0.1";
+  }
+  tcfg.port = port;
+  tcfg.timeout_sec = timeout_sec;
+
+  auto engine = std::make_unique<Engine>(rank, size, local_rank, local_size,
+                                         opts, tcfg);
+  auto st = engine->Init();
+  if (!st.ok()) {
+    SetError(st.reason);
+    return -1;
+  }
+  std::lock_guard<std::mutex> lock(g_mu);
+  int64_t id = g_next_session++;
+  g_sessions[id] = std::move(engine);
+  return id;
+}
+
+int32_t hvdtpu_destroy_session(int64_t session) {
+  std::unique_ptr<Engine> engine;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_sessions.find(session);
+    if (it == g_sessions.end()) return -1;
+    engine = std::move(it->second);
+    g_sessions.erase(it);
+  }
+  engine->Finalize();
+  return 0;
+}
+
+int32_t hvdtpu_shutdown(int64_t session) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  e->RequestShutdown();
+  return 0;
+}
+
+int32_t hvdtpu_rank(int64_t session) {
+  Engine* e = GetSession(session);
+  return e ? e->rank() : -1;
+}
+
+int32_t hvdtpu_size(int64_t session) {
+  Engine* e = GetSession(session);
+  return e ? e->size() : -1;
+}
+
+int32_t hvdtpu_local_rank(int64_t session) {
+  Engine* e = GetSession(session);
+  return e ? e->local_rank() : -1;
+}
+
+int32_t hvdtpu_local_size(int64_t session) {
+  Engine* e = GetSession(session);
+  return e ? e->local_size() : -1;
+}
+
+int32_t hvdtpu_healthy(int64_t session) {
+  Engine* e = GetSession(session);
+  return e ? (e->healthy() ? 1 : 0) : -1;
+}
+
+int32_t hvdtpu_set_execute_callback(int64_t session, ExecuteFn fn,
+                                    void* user_data) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  e->SetExecuteCallback(fn, user_data);
+  return 0;
+}
+
+// op_type: 0=allreduce 1=allgather 2=broadcast 3=alltoall 5=barrier.
+// Returns 0 and sets *handle, or nonzero (error via hvdtpu_last_error).
+int32_t hvdtpu_enqueue(int64_t session, const char* name, int32_t op_type,
+                       int32_t dtype, const int64_t* dims, int32_t ndims,
+                       int32_t root_rank, int32_t reduce_op,
+                       double prescale_factor, double postscale_factor,
+                       int32_t group_id, int32_t group_size,
+                       const int64_t* splits, int32_t nsplits,
+                       int64_t* handle) {
+  Engine* e = GetSession(session);
+  if (!e) {
+    SetError("invalid session");
+    return -1;
+  }
+  TensorTableEntry entry;
+  entry.name = name;
+  entry.op_type = static_cast<OpType>(op_type);
+  entry.dtype = static_cast<DataType>(dtype);
+  entry.shape.dims.assign(dims, dims + ndims);
+  entry.root_rank = root_rank;
+  entry.reduce_op = reduce_op;
+  entry.prescale_factor = prescale_factor;
+  entry.postscale_factor = postscale_factor;
+  entry.group_id = group_id;
+  entry.group_size = group_size;
+  if (splits != nullptr && nsplits > 0) {
+    entry.splits.assign(splits, splits + nsplits);
+  }
+  auto st = e->EnqueueTensor(std::move(entry), handle);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return static_cast<int32_t>(st.type);
+  }
+  return 0;
+}
+
+int32_t hvdtpu_join(int64_t session, int64_t* handle) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  auto st = e->EnqueueJoin(handle);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return static_cast<int32_t>(st.type);
+  }
+  return 0;
+}
+
+// Returns 1 done, 0 in-flight, <0 error. error_buf receives failure reason.
+int32_t hvdtpu_poll(int64_t session, int64_t handle, char* error_buf,
+                    int32_t error_buf_len) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  bool done = false;
+  std::string err;
+  auto st = e->PollHandle(handle, &done, &err);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return -1;
+  }
+  if (error_buf != nullptr && error_buf_len > 0) {
+    std::strncpy(error_buf, err.c_str(), error_buf_len - 1);
+    error_buf[error_buf_len - 1] = '\0';
+  }
+  return done ? 1 : 0;
+}
+
+// Returns 0 on success; nonzero failure with message in error_buf.
+int32_t hvdtpu_wait(int64_t session, int64_t handle, double timeout_sec,
+                    char* error_buf, int32_t error_buf_len) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  auto st = e->WaitHandle(handle, timeout_sec);
+  if (error_buf != nullptr && error_buf_len > 0) {
+    std::strncpy(error_buf, st.reason.c_str(), error_buf_len - 1);
+    error_buf[error_buf_len - 1] = '\0';
+  }
+  return st.ok() ? 0 : static_cast<int32_t>(st.type);
+}
+
+int32_t hvdtpu_start_timeline(int64_t session, const char* path,
+                              int32_t mark_cycles) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  e->timeline().Initialize(path, mark_cycles != 0);
+  return 0;
+}
+
+int32_t hvdtpu_stop_timeline(int64_t session) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  e->timeline().Shutdown();
+  return 0;
+}
+
+const char* hvdtpu_last_error() { return g_last_error.c_str(); }
+
+}  // extern "C"

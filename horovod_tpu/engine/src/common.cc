@@ -1,0 +1,47 @@
+#include "common.h"
+
+#include <sstream>
+
+namespace hvdtpu {
+
+const char* DataTypeName(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8: return "uint8";
+    case DataType::INT8: return "int8";
+    case DataType::UINT16: return "uint16";
+    case DataType::INT16: return "int16";
+    case DataType::INT32: return "int32";
+    case DataType::INT64: return "int64";
+    case DataType::FLOAT16: return "float16";
+    case DataType::FLOAT32: return "float32";
+    case DataType::FLOAT64: return "float64";
+    case DataType::BOOL: return "bool";
+    case DataType::BFLOAT16: return "bfloat16";
+  }
+  return "unknown";
+}
+
+const char* OpTypeName(OpType t) {
+  switch (t) {
+    case OpType::ALLREDUCE: return "allreduce";
+    case OpType::ALLGATHER: return "allgather";
+    case OpType::BROADCAST: return "broadcast";
+    case OpType::ALLTOALL: return "alltoall";
+    case OpType::JOIN: return "join";
+    case OpType::BARRIER: return "barrier";
+  }
+  return "unknown";
+}
+
+std::string TensorShape::DebugString() const {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < dims.size(); ++i) {
+    if (i) os << ", ";
+    os << dims[i];
+  }
+  os << "]";
+  return os.str();
+}
+
+}  // namespace hvdtpu

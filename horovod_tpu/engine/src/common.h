@@ -1,0 +1,154 @@
+// Core engine types — the framework-neutral tensor/metadata abstraction.
+//
+// Reference analog: horovod/common/common.h:18-271 (Status, TensorShape,
+// TensorTableEntry, env knob names). One deliberate difference for the TPU
+// build: the engine never holds tensor *data* — XLA owns device buffers, so
+// entries carry metadata only and the data plane executes in the frontend
+// via a registered callback (see engine.h).
+
+#ifndef HVD_TPU_COMMON_H
+#define HVD_TPU_COMMON_H
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class StatusType : int32_t {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+struct Status {
+  StatusType type = StatusType::OK;
+  std::string reason;
+
+  static Status OK() { return Status(); }
+  static Status Unknown(std::string msg) {
+    return Status{StatusType::UNKNOWN_ERROR, std::move(msg)};
+  }
+  static Status Precondition(std::string msg) {
+    return Status{StatusType::PRECONDITION_ERROR, std::move(msg)};
+  }
+  static Status Aborted(std::string msg) {
+    return Status{StatusType::ABORTED, std::move(msg)};
+  }
+  static Status InvalidArgument(std::string msg) {
+    return Status{StatusType::INVALID_ARGUMENT, std::move(msg)};
+  }
+  static Status InProgress() { return Status{StatusType::IN_PROGRESS, ""}; }
+  bool ok() const { return type == StatusType::OK; }
+  bool in_progress() const { return type == StatusType::IN_PROGRESS; }
+};
+
+// Wire dtype ids (reference: common/message.h DataType). The engine only
+// needs element sizes for fusion planning.
+enum class DataType : int32_t {
+  UINT8 = 0,
+  INT8 = 1,
+  UINT16 = 2,
+  INT16 = 3,
+  INT32 = 4,
+  INT64 = 5,
+  FLOAT16 = 6,
+  FLOAT32 = 7,
+  FLOAT64 = 8,
+  BOOL = 9,
+  BFLOAT16 = 10,
+};
+
+inline int64_t DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8:
+    case DataType::INT8:
+    case DataType::BOOL:
+      return 1;
+    case DataType::UINT16:
+    case DataType::INT16:
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      return 2;
+    case DataType::INT32:
+    case DataType::FLOAT32:
+      return 4;
+    case DataType::INT64:
+    case DataType::FLOAT64:
+      return 8;
+  }
+  return 0;
+}
+
+const char* DataTypeName(DataType dt);
+
+// Collective kinds (reference: message.h Request::RequestType).
+enum class OpType : int32_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  JOIN = 4,
+  BARRIER = 5,
+};
+
+const char* OpTypeName(OpType t);
+
+struct TensorShape {
+  std::vector<int64_t> dims;
+
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+  bool operator==(const TensorShape& o) const { return dims == o.dims; }
+  bool operator!=(const TensorShape& o) const { return dims != o.dims; }
+  std::string DebugString() const;
+};
+
+// Metadata-only table entry (reference: common.h:238-261 TensorTableEntry,
+// minus the data/ready-event members the TPU engine doesn't own).
+struct TensorTableEntry {
+  std::string name;
+  DataType dtype = DataType::FLOAT32;
+  TensorShape shape;
+  OpType op_type = OpType::ALLREDUCE;
+  int32_t root_rank = 0;
+  int32_t device = 0;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  int32_t reduce_op = 0;  // frontend-defined (Average/Sum/Adasum/...)
+  int32_t group_id = -1;   // grouped allreduce (reference: group_table.h)
+  int32_t group_size = 0;  // member count of that group
+  std::vector<int64_t> splits;  // alltoall send splits
+  int64_t handle = -1;    // frontend completion handle
+
+  int64_t size_bytes() const {
+    return shape.num_elements() * DataTypeSize(dtype);
+  }
+};
+
+// Engine tuning knobs (reference env list: common/common.h:65-93, parsed in
+// operations.cc:399-536).
+struct EngineOptions {
+  double cycle_time_ms = 1.0;              // HOROVOD_CYCLE_TIME
+  int64_t fusion_threshold_bytes = 64 << 20;  // HOROVOD_FUSION_THRESHOLD
+  uint32_t cache_capacity = 1024;          // HOROVOD_CACHE_CAPACITY
+  bool cache_enabled = true;
+  double stall_warning_time_sec = 60.0;    // HOROVOD_STALL_CHECK_TIME_SECONDS
+  double stall_shutdown_time_sec = 0.0;    // HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
+  bool stall_check_disable = false;        // HOROVOD_STALL_CHECK_DISABLE
+  std::string timeline_path;               // HOROVOD_TIMELINE
+  bool timeline_mark_cycles = false;       // HOROVOD_TIMELINE_MARK_CYCLES
+  bool elastic = false;                    // HOROVOD_ELASTIC
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_COMMON_H

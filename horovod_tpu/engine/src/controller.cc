@@ -1,0 +1,493 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <sstream>
+
+namespace hvdtpu {
+
+namespace {
+
+constexpr uint64_t kFlagUncached = 1ull << 0;
+constexpr uint64_t kFlagShutdown = 1ull << 1;
+constexpr uint64_t kFlagJoin = 1ull << 2;
+
+Response::Type OpToResponseType(OpType t) {
+  switch (t) {
+    case OpType::ALLREDUCE: return Response::Type::ALLREDUCE;
+    case OpType::ALLGATHER: return Response::Type::ALLGATHER;
+    case OpType::BROADCAST: return Response::Type::BROADCAST;
+    case OpType::ALLTOALL: return Response::Type::ALLTOALL;
+    case OpType::JOIN: return Response::Type::JOIN;
+    case OpType::BARRIER: return Response::Type::BARRIER;
+  }
+  return Response::Type::ERROR;
+}
+
+// Reconstruct negotiation params from a (single-tensor) response so every
+// rank — including ones that never enqueued the tensor — updates its cache
+// identically.
+Request ParamsFromResponse(const Response& r) {
+  Request req;
+  req.tensor_name = r.tensor_names[0];
+  switch (r.type) {
+    case Response::Type::ALLREDUCE: req.op_type = OpType::ALLREDUCE; break;
+    case Response::Type::ALLGATHER: req.op_type = OpType::ALLGATHER; break;
+    case Response::Type::BROADCAST: req.op_type = OpType::BROADCAST; break;
+    case Response::Type::ALLTOALL: req.op_type = OpType::ALLTOALL; break;
+    default: req.op_type = OpType::ALLREDUCE; break;
+  }
+  req.dtype = static_cast<DataType>(r.tensor_dtypes[0]);
+  int32_t nd = r.tensor_ndims[0];
+  req.shape.dims.assign(r.tensor_dims_flat.begin(),
+                        r.tensor_dims_flat.begin() + nd);
+  req.root_rank = r.root_rank;
+  req.reduce_op = r.reduce_op;
+  req.prescale_factor = r.prescale_factor;
+  req.postscale_factor = r.postscale_factor;
+  req.group_id = r.group_id;
+  return req;
+}
+
+bool Cacheable(const Response& r) {
+  // Allgather responses carry per-cycle first-dim sizes which may change
+  // between submissions on the reference too — it caches them with sizes
+  // revalidated via params; we cache only shape-stable ops plus allgather
+  // (params include the submitting rank's shape; a shape change flips the
+  // cache state to INVALID and renegotiates).
+  return (r.type == Response::Type::ALLREDUCE ||
+          r.type == Response::Type::ALLGATHER ||
+          r.type == Response::Type::BROADCAST ||
+          r.type == Response::Type::ALLTOALL) &&
+         r.error_message.empty() && r.tensor_names.size() == 1;
+}
+
+}  // namespace
+
+Controller::Controller(std::shared_ptr<ControllerTransport> transport,
+                       const EngineOptions& opts, Timeline* timeline)
+    : transport_(std::move(transport)), opts_(opts), timeline_(timeline) {
+  cache_.set_capacity(opts_.cache_enabled ? opts_.cache_capacity : 0);
+  stall_.set_warning_time_sec(opts_.stall_warning_time_sec);
+  stall_.set_shutdown_time_sec(opts_.stall_shutdown_time_sec);
+  stall_.set_disabled(opts_.stall_check_disable);
+}
+
+bool Controller::IncrementTensorCount(const Request& msg, int joined_count) {
+  auto it = message_table_.find(msg.tensor_name);
+  if (it == message_table_.end()) {
+    auto& tc = message_table_[msg.tensor_name];
+    tc.first = msg;
+    tc.ranks.insert(msg.request_rank);
+    if (msg.op_type == OpType::ALLGATHER && !msg.shape.dims.empty()) {
+      tc.first_dims[msg.request_rank] = msg.shape.dims[0];
+    }
+    stall_.RecordUncachedTensorRank(msg.tensor_name, msg.request_rank);
+    if (timeline_ && rank() == 0) {
+      timeline_->NegotiateStart(msg.tensor_name, msg.op_type);
+      timeline_->NegotiateRankReady(msg.tensor_name, msg.request_rank);
+    }
+    return tc.ranks.size() + joined_count >= static_cast<size_t>(size());
+  }
+  auto& tc = it->second;
+  // Validate agreement with the first announcement (reference:
+  // controller.cc:471-748 error construction).
+  std::ostringstream err;
+  if (msg.op_type != tc.first.op_type) {
+    err << "Mismatched collective operations: rank " << tc.first.request_rank
+        << " performs " << OpTypeName(tc.first.op_type) << ", rank "
+        << msg.request_rank << " performs " << OpTypeName(msg.op_type)
+        << " on tensor " << msg.tensor_name << ".";
+  } else if (msg.dtype != tc.first.dtype) {
+    err << "Mismatched data types: rank " << tc.first.request_rank << " has "
+        << DataTypeName(tc.first.dtype) << ", rank " << msg.request_rank
+        << " has " << DataTypeName(msg.dtype) << " for tensor "
+        << msg.tensor_name << ".";
+  } else if (msg.op_type == OpType::ALLREDUCE ||
+             msg.op_type == OpType::BROADCAST) {
+    if (msg.shape != tc.first.shape) {
+      err << "Mismatched " << OpTypeName(msg.op_type)
+          << " tensor shapes: rank " << tc.first.request_rank << " has "
+          << tc.first.shape.DebugString() << ", rank " << msg.request_rank
+          << " has " << msg.shape.DebugString() << " for tensor "
+          << msg.tensor_name << ".";
+    } else if (msg.op_type == OpType::BROADCAST &&
+               msg.root_rank != tc.first.root_rank) {
+      err << "Mismatched broadcast root ranks: rank " << tc.first.request_rank
+          << " uses root " << tc.first.root_rank << ", rank "
+          << msg.request_rank << " uses root " << msg.root_rank
+          << " for tensor " << msg.tensor_name << ".";
+    }
+  } else if (msg.op_type == OpType::ALLGATHER) {
+    // First dim may differ; rank (ndim) and trailing dims must match
+    // (reference: controller.cc:576-648).
+    bool bad = msg.shape.dims.size() != tc.first.shape.dims.size();
+    if (!bad) {
+      for (size_t d = 1; d < msg.shape.dims.size(); ++d) {
+        if (msg.shape.dims[d] != tc.first.shape.dims[d]) bad = true;
+      }
+    }
+    if (bad) {
+      err << "Mismatched allgather tensor shapes: all dimensions except the "
+          << "first must match across ranks for tensor " << msg.tensor_name
+          << " (rank " << tc.first.request_rank << ": "
+          << tc.first.shape.DebugString() << ", rank " << msg.request_rank
+          << ": " << msg.shape.DebugString() << ").";
+    }
+  }
+  if (msg.reduce_op != tc.first.reduce_op && err.str().empty()) {
+    err << "Mismatched reduction ops for tensor " << msg.tensor_name << ".";
+  }
+  if (!err.str().empty() && tc.validation_error.empty()) {
+    tc.validation_error = err.str();
+  }
+  tc.ranks.insert(msg.request_rank);
+  if (msg.op_type == OpType::ALLGATHER && !msg.shape.dims.empty()) {
+    tc.first_dims[msg.request_rank] = msg.shape.dims[0];
+  }
+  stall_.RecordUncachedTensorRank(msg.tensor_name, msg.request_rank);
+  if (timeline_ && rank() == 0) {
+    timeline_->NegotiateRankReady(msg.tensor_name, msg.request_rank);
+  }
+  return tc.ranks.size() + joined_count >= static_cast<size_t>(size());
+}
+
+Response Controller::ConstructResponse(const std::string& name) {
+  auto it = message_table_.find(name);
+  Response resp;
+  resp.tensor_names.push_back(name);
+  if (it == message_table_.end()) {
+    resp.type = Response::Type::ERROR;
+    resp.error_message = "internal: tensor missing from message table";
+    return resp;
+  }
+  auto& tc = it->second;
+  if (!tc.validation_error.empty()) {
+    resp.type = Response::Type::ERROR;
+    resp.error_message = tc.validation_error;
+  } else {
+    resp.type = OpToResponseType(tc.first.op_type);
+    resp.tensor_dtypes.push_back(static_cast<int32_t>(tc.first.dtype));
+    resp.tensor_ndims.push_back(
+        static_cast<int32_t>(tc.first.shape.dims.size()));
+    resp.tensor_dims_flat.insert(resp.tensor_dims_flat.end(),
+                                 tc.first.shape.dims.begin(),
+                                 tc.first.shape.dims.end());
+    resp.reduce_op = tc.first.reduce_op;
+    resp.root_rank = tc.first.root_rank;
+    resp.prescale_factor = tc.first.prescale_factor;
+    resp.postscale_factor = tc.first.postscale_factor;
+    resp.group_id = tc.first.group_id;
+    resp.joined_ranks.assign(joined_ranks_.begin(), joined_ranks_.end());
+    if (tc.first.op_type == OpType::ALLGATHER) {
+      // Per-rank first-dim sizes in rank order; joined ranks contribute 0
+      // rows (reference: controller.cc:576-648 + join zero semantics).
+      resp.tensor_sizes.resize(size(), 0);
+      for (auto& kv : tc.first_dims) resp.tensor_sizes[kv.first] = kv.second;
+    }
+  }
+  stall_.RemoveUncachedTensor(name);
+  if (timeline_ && rank() == 0) timeline_->NegotiateEnd(name);
+  message_table_.erase(it);
+  return resp;
+}
+
+int64_t Controller::ResponseBytes(const Response& r) const {
+  int64_t total = 0;
+  size_t dim_off = 0;
+  for (size_t i = 0; i < r.tensor_names.size(); ++i) {
+    int64_t elems = 1;
+    for (int32_t d = 0; d < r.tensor_ndims[i]; ++d) {
+      elems *= r.tensor_dims_flat[dim_off + d];
+    }
+    dim_off += r.tensor_ndims[i];
+    total += elems * DataTypeSize(static_cast<DataType>(r.tensor_dtypes[i]));
+  }
+  return total;
+}
+
+void Controller::FuseResponses(std::vector<Response>* responses) {
+  // Greedy fusion with look-ahead (reference: controller.cc:777-914):
+  // merge ALLREDUCE responses sharing reduce params until the threshold;
+  // same-group responses merge unconditionally (atomicity). Mixed dtypes
+  // are allowed in one fused response — the data plane packs per dtype.
+  std::vector<Response> fused;
+  std::vector<bool> used(responses->size(), false);
+  for (size_t i = 0; i < responses->size(); ++i) {
+    if (used[i]) continue;
+    Response& base = (*responses)[i];
+    used[i] = true;
+    if (base.type != Response::Type::ALLREDUCE) {
+      fused.push_back(std::move(base));
+      continue;
+    }
+    int64_t bytes = ResponseBytes(base);
+    for (size_t j = i + 1; j < responses->size(); ++j) {
+      if (used[j]) continue;
+      Response& cand = (*responses)[j];
+      if (cand.type != Response::Type::ALLREDUCE) continue;
+      bool same_group = base.group_id >= 0 && cand.group_id == base.group_id;
+      bool same_params = cand.reduce_op == base.reduce_op &&
+                         cand.prescale_factor == base.prescale_factor &&
+                         cand.postscale_factor == base.postscale_factor;
+      if (!same_params) continue;
+      int64_t cand_bytes = ResponseBytes(cand);
+      if (!same_group && bytes + cand_bytes > opts_.fusion_threshold_bytes) {
+        continue;
+      }
+      // Merge cand into base.
+      base.tensor_names.insert(base.tensor_names.end(),
+                               cand.tensor_names.begin(),
+                               cand.tensor_names.end());
+      base.tensor_dtypes.insert(base.tensor_dtypes.end(),
+                                cand.tensor_dtypes.begin(),
+                                cand.tensor_dtypes.end());
+      base.tensor_ndims.insert(base.tensor_ndims.end(),
+                               cand.tensor_ndims.begin(),
+                               cand.tensor_ndims.end());
+      base.tensor_dims_flat.insert(base.tensor_dims_flat.end(),
+                                   cand.tensor_dims_flat.begin(),
+                                   cand.tensor_dims_flat.end());
+      for (int32_t jr : cand.joined_ranks) {
+        if (std::find(base.joined_ranks.begin(), base.joined_ranks.end(),
+                      jr) == base.joined_ranks.end()) {
+          base.joined_ranks.push_back(jr);
+        }
+      }
+      bytes += cand_bytes;
+      used[j] = true;
+    }
+    fused.push_back(std::move(base));
+  }
+  *responses = std::move(fused);
+}
+
+Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
+  // --- 1. classify fresh messages by cache state -------------------------
+  std::vector<uint32_t> my_invalid;
+  for (const auto& msg : in.messages) {
+    switch (cache_.Cached(msg)) {
+      case ResponseCache::CacheState::HIT:
+        cached_pending_.push_back(msg);
+        break;
+      case ResponseCache::CacheState::INVALID:
+        // Parameters changed (e.g. a new allgather first-dim): every rank
+        // must evict this entry or its fast-path bit deadlocks against our
+        // slow-path renegotiation (reference: CacheCoordinator invalid
+        // bits, response_cache.h:107-169).
+        my_invalid.push_back(cache_.PeekPosition(msg.tensor_name));
+        cache_.Erase(msg.tensor_name);
+        uncached_pending_.push_back(msg);
+        break;
+      case ResponseCache::CacheState::MISS:
+        uncached_pending_.push_back(msg);
+        break;
+    }
+  }
+
+  // --- 2. one combined AND-allreduce: inverted OR-flags in word 0, cache
+  //        hit bits after ------------------------------------------------
+  uint64_t flags = 0;
+  if (!uncached_pending_.empty()) flags |= kFlagUncached;
+  if (in.shutdown_requested) flags |= kFlagShutdown;
+  if (in.join_requested) flags |= kFlagJoin;
+  // Stall scan every cycle on the coordinator (reference: controller.cc
+  // invokes the inspector from ComputeResponseList each cycle); a shutdown
+  // verdict rides the OR'd flags so every rank stops together.
+  if (rank() == 0 && stall_.CheckForStalledTensors(size())) {
+    flags |= kFlagShutdown;
+  }
+
+  // Layout: word 0 = ~flags (AND of inverted = inverted OR); then
+  // slot_words of cache-hit bits (AND); then slot_words of inverted
+  // invalidation bits (→ OR). One collective where the reference needs two
+  // (mpi_controller.cc:88-106).
+  size_t slot_words = cache_.num_slots() / 64 + 1;
+  std::vector<uint64_t> bits(1 + 2 * slot_words, 0);
+  bits[0] = ~flags;
+  for (const auto& msg : cached_pending_) {
+    uint32_t pos = cache_.PeekPosition(msg.tensor_name);
+    bits[1 + pos / 64] |= 1ull << (pos % 64);
+  }
+  for (size_t w = 0; w < slot_words; ++w) bits[1 + slot_words + w] = ~0ull;
+  for (uint32_t pos : my_invalid) {
+    bits[1 + slot_words + pos / 64] &= ~(1ull << (pos % 64));
+  }
+  auto st = transport_->BitAllreduce(&bits, /*is_and=*/true);
+  if (!st.ok()) return st;
+  uint64_t or_flags = ~bits[0];
+  bool any_uncached = or_flags & kFlagUncached;
+  bool any_shutdown = or_flags & kFlagShutdown;
+  bool any_join = or_flags & kFlagJoin;
+
+  // Apply coordinated invalidations: evict and re-announce anything we had
+  // riding the fast path on a now-stale entry.
+  for (size_t w = 0; w < slot_words && 1 + slot_words + w < bits.size();
+       ++w) {
+    uint64_t inval = ~bits[1 + slot_words + w];
+    while (inval) {
+      int b = __builtin_ctzll(inval);
+      inval &= inval - 1;
+      uint32_t pos = static_cast<uint32_t>(w * 64 + b);
+      if (pos >= cache_.num_slots()) continue;
+      const std::string name = cache_.SlotName(pos);
+      if (name.empty()) continue;  // we evicted it ourselves already
+      cache_.Erase(name);
+      for (auto it = cached_pending_.begin(); it != cached_pending_.end();
+           ++it) {
+        if (it->tensor_name == name) {
+          uncached_pending_.push_back(*it);
+          cached_pending_.erase(it);
+          break;
+        }
+      }
+    }
+  }
+
+  std::vector<Response> responses;
+  if (any_join) {
+    // Join epoch: the cache fast path can't make progress (a joined rank
+    // has no pending bits, so the AND is empty) — renegotiate everything
+    // through the slow path where joined ranks count toward completion.
+    for (auto& msg : cached_pending_) uncached_pending_.push_back(msg);
+    cached_pending_.clear();
+  } else {
+    // --- 3. fast path: cached tensors pending on every rank -------------
+    std::vector<uint32_t> common_positions;
+    for (size_t w = 1; w < 1 + slot_words && w < bits.size(); ++w) {
+      uint64_t word = bits[w];
+      while (word) {
+        int b = __builtin_ctzll(word);
+        word &= word - 1;
+        common_positions.push_back(static_cast<uint32_t>((w - 1) * 64 + b));
+      }
+    }
+    std::sort(common_positions.begin(), common_positions.end());
+    for (uint32_t pos : common_positions) {
+      Response resp = cache_.GetResponse(pos);  // touches LRU, all ranks alike
+      const std::string& name = resp.tensor_names[0];
+      for (auto it = cached_pending_.begin(); it != cached_pending_.end();
+           ++it) {
+        if (it->tensor_name == name) {
+          cached_pending_.erase(it);
+          break;
+        }
+      }
+      responses.push_back(std::move(resp));
+    }
+  }
+
+  // --- 4. slow path: full negotiation ------------------------------------
+  bool join_completed = false;
+  if (any_uncached || any_join) {
+    RequestList rl;
+    rl.requests.assign(uncached_pending_.begin(), uncached_pending_.end());
+    rl.shutdown = in.shutdown_requested;
+    rl.join = in.join_requested;
+    uncached_pending_.clear();
+    std::string payload;
+    rl.SerializeTo(&payload);
+
+    std::string response_payload;
+    if (rank() == 0) {
+      std::vector<std::string> all;
+      st = transport_->Gather(payload, &all);
+      if (!st.ok()) return st;
+      for (int r = 0; r < size(); ++r) {
+        RequestList list = RequestList::Deserialize(all[r]);
+        if (list.join) joined_ranks_.insert(r);
+        for (auto& req : list.requests) {
+          IncrementTensorCount(req, 0);
+        }
+      }
+      // Completion scan (joined ranks count toward every tensor).
+      std::vector<std::string> ready;
+      for (auto& kv : message_table_) {
+        size_t have = kv.second.ranks.size();
+        for (int32_t jr : joined_ranks_) {
+          if (!kv.second.ranks.count(jr)) ++have;
+        }
+        if (have >= static_cast<size_t>(size())) ready.push_back(kv.first);
+      }
+      std::sort(ready.begin(), ready.end());
+      // Grouped tensors: hold until the whole group is ready
+      // (reference: controller.cc:199-223).
+      std::vector<std::string> emit;
+      for (auto& name : ready) {
+        auto& tc = message_table_[name];
+        if (tc.first.group_id >= 0 && tc.first.group_size > 0) {
+          auto& got = complete_groups_[tc.first.group_id];
+          got.insert(name);
+          if (got.size() < static_cast<size_t>(tc.first.group_size)) continue;
+          for (auto& member : got) emit.push_back(member);
+          complete_groups_.erase(tc.first.group_id);
+        } else {
+          emit.push_back(name);
+        }
+      }
+      std::vector<Response> slow;
+      for (auto& name : emit) slow.push_back(ConstructResponse(name));
+
+      // Join completes when every rank has joined.
+      if (!joined_ranks_.empty() &&
+          joined_ranks_.size() == static_cast<size_t>(size())) {
+        Response jr;
+        jr.type = Response::Type::JOIN;
+        jr.last_joined_rank = *joined_ranks_.rbegin();
+        slow.push_back(std::move(jr));
+        joined_ranks_.clear();
+      }
+
+      // Cache new single-tensor responses BEFORE fusing (all ranks repeat
+      // this on receipt, keeping caches identical).
+      ResponseList rlist;
+      rlist.shutdown = any_shutdown;
+      rlist.responses = std::move(slow);
+      rlist.SerializeTo(&response_payload);
+      st = transport_->Bcast(&response_payload);
+      if (!st.ok()) return st;
+    } else {
+      st = transport_->Gather(payload, nullptr);
+      if (!st.ok()) return st;
+      st = transport_->Bcast(&response_payload);
+      if (!st.ok()) return st;
+    }
+    ResponseList rlist = ResponseList::Deserialize(response_payload);
+    any_shutdown = any_shutdown || rlist.shutdown;
+    for (auto& resp : rlist.responses) {
+      if (resp.type == Response::Type::JOIN) {
+        join_completed = true;
+        continue;
+      }
+      if (Cacheable(resp) && cache_.capacity() > 0) {
+        // Cache without join-epoch state: joined_ranks/tensor_sizes reflect
+        // the *construction* cycle; a cached replay happens only outside a
+        // join epoch, where those must be empty / recomputed. Allgather is
+        // recached each time its sizes change via the INVALID path.
+        Response cached = resp;
+        cached.joined_ranks.clear();
+        cache_.Put(cached, ParamsFromResponse(resp));
+      }
+      responses.push_back(std::move(resp));
+    }
+    // Capacity evictions during the Puts above may have dropped entries
+    // other pending tensors were riding on — re-announce those.
+    for (auto it = cached_pending_.begin(); it != cached_pending_.end();) {
+      if (cache_.Cached(*it) != ResponseCache::CacheState::HIT) {
+        uncached_pending_.push_back(*it);
+        it = cached_pending_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  FuseResponses(&responses);
+
+  out->responses.responses = std::move(responses);
+  out->responses.shutdown = any_shutdown;
+  out->join_completed = join_completed;
+  out->should_shut_down = any_shutdown;
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

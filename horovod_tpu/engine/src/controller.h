@@ -1,0 +1,109 @@
+// Coordination protocol: establishes a globally consistent execution order
+// for collectives that may be submitted in different orders on different
+// ranks — the reference's central design invariant
+// (reference: horovod/common/controller.h:69-104 and the rationale comment
+// operations.cc:336-355).
+//
+// Protocol per cycle (reference: controller.cc:69-449 ComputeResponseList):
+//   1. Pop this rank's newly submitted requests.
+//   2. Cache check: tensors negotiated before skip the master-worker
+//      exchange; one bitwise-AND allreduce finds tensors pending on ALL
+//      ranks (fast path, controller.cc:180-237). This build folds the OR
+//      flags (uncached-work-exists / shutdown) into the same collective by
+//      carrying them inverted in word 0.
+//   3. Slow path when any rank has uncached work: workers Gather their
+//      request lists to rank 0; rank 0 counts readiness per tensor
+//      (IncrementTensorCount, controller.cc:942-965), validates metadata
+//      agreement, constructs responses (ConstructResponse,
+//      controller.cc:471-748), fuses them (FuseResponses,
+//      controller.cc:777-914), and Bcasts the final list all ranks execute.
+//   4. Join handling: joined ranks count as ready for every tensor; when
+//      all ranks joined, a JOIN response completes the join collective
+//      (reference: controller.cc:254-308).
+
+#ifndef HVD_TPU_CONTROLLER_H
+#define HVD_TPU_CONTROLLER_H
+
+#include <deque>
+#include <memory>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+class Controller {
+ public:
+  Controller(std::shared_ptr<ControllerTransport> transport,
+             const EngineOptions& opts, Timeline* timeline);
+
+  struct CycleInput {
+    std::vector<Request> messages;
+    bool shutdown_requested = false;
+    bool join_requested = false;  // this rank sits in hvd.join()
+  };
+
+  struct CycleOutput {
+    ResponseList responses;
+    bool join_completed = false;
+    bool should_shut_down = false;
+  };
+
+  Status RunCycle(const CycleInput& in, CycleOutput* out);
+
+  int rank() const { return transport_->rank(); }
+  int size() const { return transport_->size(); }
+
+  StallInspector& stall_inspector() { return stall_; }
+  ResponseCache& response_cache() { return cache_; }
+
+ private:
+  // Rank-0 bookkeeping of how many ranks announced each tensor.
+  struct TensorCount {
+    Request first;                 // metadata from the first announcement
+    std::set<int32_t> ranks;
+    std::string validation_error;  // non-empty → ERROR response when complete
+    // Allgather: per-rank first-dim extents (reference: controller.cc:576-648).
+    std::unordered_map<int32_t, int64_t> first_dims;
+  };
+
+  // Returns true when all (non-joined) ranks are in (reference:
+  // controller.cc:942-965).
+  bool IncrementTensorCount(const Request& msg, int joined_count);
+
+  Response ConstructResponse(const std::string& name);
+  void FuseResponses(std::vector<Response>* responses);
+  int64_t ResponseBytes(const Response& r) const;
+
+  std::shared_ptr<ControllerTransport> transport_;
+  EngineOptions opts_;
+  Timeline* timeline_;
+  ResponseCache cache_;
+  StallInspector stall_;
+
+  // Tensors that hit cache and wait for the common bit (order-preserving).
+  std::deque<Request> cached_pending_;
+  // This rank's uncached requests not yet sent (slow path input).
+  std::deque<Request> uncached_pending_;
+
+  // Rank 0 only.
+  std::unordered_map<std::string, TensorCount> message_table_;
+  std::vector<std::string> ready_order_;  // completion order for determinism
+  std::set<int32_t> joined_ranks_;
+
+  // Grouped-op bookkeeping: group members ready but held until the whole
+  // group completes (reference: controller.cc:199-223 group handling).
+  std::unordered_map<int32_t, std::set<std::string>> complete_groups_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_CONTROLLER_H

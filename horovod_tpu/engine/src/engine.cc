@@ -1,0 +1,316 @@
+#include "engine.h"
+
+#include <chrono>
+#include <sstream>
+
+namespace hvdtpu {
+
+// ---------------------------------------------------------------------------
+// HandleManager
+
+int64_t HandleManager::Allocate() {
+  std::lock_guard<std::mutex> lock(mu_);
+  int64_t h = next_++;
+  results_[h] = Result{};
+  return h;
+}
+
+void HandleManager::MarkDone(int64_t handle, const std::string& error) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = results_.find(handle);
+  if (it == results_.end()) return;
+  it->second.done = true;
+  it->second.error = error;
+  cv_.notify_all();
+}
+
+Status HandleManager::Poll(int64_t handle, bool* done, std::string* error) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = results_.find(handle);
+  if (it == results_.end()) {
+    return Status::InvalidArgument("unknown handle " + std::to_string(handle));
+  }
+  *done = it->second.done;
+  if (error) *error = it->second.error;
+  return Status::OK();
+}
+
+Status HandleManager::Wait(int64_t handle, double timeout_sec) {
+  std::unique_lock<std::mutex> lock(mu_);
+  auto it = results_.find(handle);
+  if (it == results_.end()) {
+    return Status::InvalidArgument("unknown handle " + std::to_string(handle));
+  }
+  auto pred = [&] { return results_[handle].done; };
+  if (timeout_sec > 0) {
+    if (!cv_.wait_for(lock, std::chrono::duration<double>(timeout_sec),
+                      pred)) {
+      return Status::Unknown("timed out waiting for handle " +
+                             std::to_string(handle));
+    }
+  } else {
+    cv_.wait(lock, pred);
+  }
+  std::string err = results_[handle].error;
+  results_.erase(handle);
+  if (!err.empty()) return Status::Unknown(err);
+  return Status::OK();
+}
+
+void HandleManager::FailAll(const std::string& error) {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto& kv : results_) {
+    if (!kv.second.done) {
+      kv.second.done = true;
+      kv.second.error = error;
+    }
+  }
+  cv_.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// Engine
+
+Engine::Engine(int rank, int size, int local_rank, int local_size,
+               const EngineOptions& opts, const TransportConfig& tcfg)
+    : rank_(rank), size_(size), local_rank_(local_rank),
+      local_size_(local_size), opts_(opts), tcfg_(tcfg) {}
+
+Engine::~Engine() { Finalize(); }
+
+Status Engine::Init() {
+  if (tcfg_.kind == "loopback") {
+    auto hub = GetOrCreateLoopbackHub(tcfg_.group, size_);
+    transport_ = std::make_shared<LoopbackTransport>(hub, rank_);
+  } else if (tcfg_.kind == "tcp") {
+    auto tcp = std::make_shared<TcpTransport>(rank_, size_, tcfg_.addr,
+                                              tcfg_.port, tcfg_.timeout_sec);
+    auto st = tcp->Init();
+    if (!st.ok()) return st;
+    transport_ = tcp;
+  } else {
+    return Status::InvalidArgument("unknown transport: " + tcfg_.kind);
+  }
+  if (!opts_.timeline_path.empty()) {
+    timeline_.Initialize(opts_.timeline_path, opts_.timeline_mark_cycles);
+  }
+  controller_ = std::make_unique<Controller>(transport_, opts_, &timeline_);
+  background_ = std::thread([this] { BackgroundLoop(); });
+  return Status::OK();
+}
+
+void Engine::SetExecuteCallback(ExecuteFn fn, void* user_data) {
+  execute_fn_ = fn;
+  execute_user_data_ = user_data;
+}
+
+Status Engine::EnqueueTensor(TensorTableEntry entry, int64_t* handle) {
+  if (stopped_.load()) {
+    return Status::Aborted("engine has been shut down");
+  }
+  *handle = handles_.Allocate();
+  entry.handle = *handle;
+
+  Request msg;
+  msg.request_rank = rank_;
+  msg.op_type = entry.op_type;
+  msg.tensor_name = entry.name;
+  msg.dtype = entry.dtype;
+  msg.shape = entry.shape;
+  msg.root_rank = entry.root_rank;
+  msg.device = entry.device;
+  msg.prescale_factor = entry.prescale_factor;
+  msg.postscale_factor = entry.postscale_factor;
+  msg.reduce_op = entry.reduce_op;
+  msg.group_id = entry.group_id;
+  msg.group_size = entry.group_size;
+
+  auto st = queue_.AddToTensorQueue(entry, msg);
+  if (!st.ok()) {
+    handles_.MarkDone(*handle, st.reason);
+    return st;
+  }
+  {
+    std::lock_guard<std::mutex> lock(cycle_mu_);
+    work_available_ = true;
+    cycle_cv_.notify_one();
+  }
+  return Status::OK();
+}
+
+Status Engine::EnqueueJoin(int64_t* handle) {
+  if (stopped_.load()) return Status::Aborted("engine has been shut down");
+  *handle = handles_.Allocate();
+  join_handle_ = *handle;
+  join_pending_.store(true);
+  {
+    std::lock_guard<std::mutex> lock(cycle_mu_);
+    work_available_ = true;
+    cycle_cv_.notify_one();
+  }
+  return Status::OK();
+}
+
+Status Engine::PollHandle(int64_t handle, bool* done, std::string* error) {
+  return handles_.Poll(handle, done, error);
+}
+
+Status Engine::WaitHandle(int64_t handle, double timeout_sec) {
+  return handles_.Wait(handle, timeout_sec);
+}
+
+void Engine::RequestShutdown() {
+  shutdown_requested_.store(true);
+  std::lock_guard<std::mutex> lock(cycle_mu_);
+  work_available_ = true;
+  cycle_cv_.notify_one();
+}
+
+void Engine::Finalize() {
+  RequestShutdown();
+  if (background_.joinable()) background_.join();
+  timeline_.Shutdown();
+}
+
+std::string Engine::ResponseToJson(const Response& r) {
+  auto escape = [](const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+      if (c == '"' || c == '\\') {
+        out += '\\';
+        out += c;
+      } else if (c == '\n') {
+        out += "\\n";
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  };
+  std::ostringstream os;
+  os << "{\"type\":\"" << ResponseTypeName(r.type) << "\",\"names\":[";
+  for (size_t i = 0; i < r.tensor_names.size(); ++i) {
+    if (i) os << ",";
+    os << "\"" << escape(r.tensor_names[i]) << "\"";
+  }
+  os << "],\"error\":\"" << escape(r.error_message) << "\",\"dtypes\":[";
+  for (size_t i = 0; i < r.tensor_dtypes.size(); ++i) {
+    if (i) os << ",";
+    os << r.tensor_dtypes[i];
+  }
+  os << "],\"shapes\":[";
+  size_t off = 0;
+  for (size_t i = 0; i < r.tensor_ndims.size(); ++i) {
+    if (i) os << ",";
+    os << "[";
+    for (int32_t d = 0; d < r.tensor_ndims[i]; ++d) {
+      if (d) os << ",";
+      os << r.tensor_dims_flat[off + d];
+    }
+    off += r.tensor_ndims[i];
+    os << "]";
+  }
+  os << "],\"sizes\":[";
+  for (size_t i = 0; i < r.tensor_sizes.size(); ++i) {
+    if (i) os << ",";
+    os << r.tensor_sizes[i];
+  }
+  os << "],\"joined_ranks\":[";
+  for (size_t i = 0; i < r.joined_ranks.size(); ++i) {
+    if (i) os << ",";
+    os << r.joined_ranks[i];
+  }
+  os << "],\"reduce_op\":" << r.reduce_op
+     << ",\"root_rank\":" << r.root_rank
+     << ",\"prescale\":" << r.prescale_factor
+     << ",\"postscale\":" << r.postscale_factor
+     << ",\"last_joined\":" << r.last_joined_rank << "}";
+  return os.str();
+}
+
+void Engine::PerformOperation(const Response& response) {
+  // reference: operations.cc:255-334 — fetch entries, execute, fire
+  // callbacks. Data execution is delegated to the frontend.
+  std::string err = response.error_message;
+  int32_t rc = 0;
+  if (response.type != Response::Type::ERROR) {
+    for (const auto& name : response.tensor_names) {
+      timeline_.ActivityStart(name,
+                              std::string("EXEC_") +
+                                  ResponseTypeName(response.type));
+    }
+    if (execute_fn_ != nullptr) {
+      std::string json = ResponseToJson(response);
+      rc = execute_fn_(json.c_str(), execute_user_data_);
+      if (rc != 0) {
+        err = "data plane execution failed (rc=" + std::to_string(rc) + ")";
+      }
+    }
+    for (const auto& name : response.tensor_names) {
+      timeline_.ActivityEnd(name);
+    }
+  }
+  for (const auto& name : response.tensor_names) {
+    TensorTableEntry entry;
+    auto st = queue_.GetTensorEntry(name, &entry);
+    if (!st.ok()) continue;  // joined rank: no local entry
+    handles_.MarkDone(entry.handle, err);
+  }
+}
+
+void Engine::BackgroundLoop() {
+  try {
+    BackgroundLoopImpl();
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "[hvdtpu] FATAL background loop exception: %s\n",
+                 e.what());
+    healthy_.store(false);
+    stopped_.store(true);
+    handles_.FailAll(std::string("engine crashed: ") + e.what());
+  }
+}
+
+void Engine::BackgroundLoopImpl() {
+  // reference: operations.cc:589-647 RunLoopOnce, driven at cycle_time.
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lock(cycle_mu_);
+      cycle_cv_.wait_for(
+          lock,
+          std::chrono::duration<double>(opts_.cycle_time_ms / 1000.0),
+          [&] { return work_available_; });
+      work_available_ = false;
+    }
+    timeline_.MarkCycleStart();
+
+    Controller::CycleInput in;
+    queue_.PopMessagesFromQueue(&in.messages);
+    in.shutdown_requested = shutdown_requested_.load();
+    in.join_requested = join_pending_.load();
+
+    Controller::CycleOutput out;
+    auto st = controller_->RunCycle(in, &out);
+    if (!st.ok()) {
+      healthy_.store(false);
+      handles_.FailAll("coordination failure: " + st.reason +
+                       " (HorovodInternalError)");
+      break;
+    }
+    for (const auto& response : out.responses.responses) {
+      PerformOperation(response);
+    }
+    if (out.join_completed && join_pending_.load()) {
+      join_pending_.store(false);
+      handles_.MarkDone(join_handle_, "");
+    }
+    if (out.should_shut_down) break;
+  }
+  stopped_.store(true);
+  auto aborted = queue_.AbortAll();
+  for (auto& entry : aborted) {
+    handles_.MarkDone(entry.handle, "Horovod has been shut down");
+  }
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,131 @@
+// The engine: background coordination thread + async handle surface.
+//
+// Reference analog: horovod/common/operations.cc —
+// InitializeHorovodOnce/BackgroundThreadLoop (:651-699, :358-587),
+// RunLoopOnce (:589-647), PerformOperation (:255-334), Enqueue* (:902-1190).
+//
+// TPU-shaped difference: PerformOperation does not touch tensor memory. XLA
+// owns device buffers, so the engine emits an "execute order" (the fused
+// Response, serialized as JSON) to a callback registered by the frontend;
+// the frontend's data plane runs the actual collective (jax.lax under jit,
+// or the host TCP data plane for eager CPU tensors) and its return status
+// completes the handles. The negotiation/fusion/caching/stall machinery is
+// exactly the reference's.
+
+#ifndef HVD_TPU_ENGINE_H
+#define HVD_TPU_ENGINE_H
+
+#include <atomic>
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "common.h"
+#include "controller.h"
+#include "message.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+// int64 handle -> completion state (reference analog:
+// horovod/torch/handle_manager.{h,cc}).
+class HandleManager {
+ public:
+  int64_t Allocate();
+  void MarkDone(int64_t handle, const std::string& error);
+  // done=false if still in flight. Unknown handles error.
+  Status Poll(int64_t handle, bool* done, std::string* error);
+  // Blocks; timeout_sec<=0 waits forever. Returns op status.
+  Status Wait(int64_t handle, double timeout_sec);
+  void FailAll(const std::string& error);
+
+ private:
+  struct Result {
+    bool done = false;
+    std::string error;
+  };
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int64_t next_ = 0;
+  std::unordered_map<int64_t, Result> results_;
+};
+
+// Execute callback: receives one fused response as JSON; returns 0 on
+// success, nonzero on data-plane failure.
+using ExecuteFn = int32_t (*)(const char* response_json, void* user_data);
+
+struct TransportConfig {
+  // "loopback" (in-process, for tests/single-host multi-rank) or "tcp".
+  std::string kind = "loopback";
+  std::string group = "default";  // loopback hub name
+  std::string addr = "127.0.0.1";
+  int port = 0;
+  double timeout_sec = 30.0;
+};
+
+class Engine {
+ public:
+  Engine(int rank, int size, int local_rank, int local_size,
+         const EngineOptions& opts, const TransportConfig& tcfg);
+  ~Engine();
+
+  Status Init();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  int local_rank() const { return local_rank_; }
+  int local_size() const { return local_size_; }
+
+  void SetExecuteCallback(ExecuteFn fn, void* user_data);
+
+  // Returns handle (>=0) or a failed status for duplicate names.
+  Status EnqueueTensor(TensorTableEntry entry, int64_t* handle);
+  Status EnqueueJoin(int64_t* handle);
+
+  Status PollHandle(int64_t handle, bool* done, std::string* error);
+  Status WaitHandle(int64_t handle, double timeout_sec);
+
+  void RequestShutdown();
+  void Finalize();  // join background thread (idempotent)
+  bool healthy() const { return healthy_.load(); }
+
+  Timeline& timeline() { return timeline_; }
+  Controller& controller() { return *controller_; }
+
+ private:
+  void BackgroundLoop();
+  void BackgroundLoopImpl();
+  void PerformOperation(const Response& response);
+  std::string ResponseToJson(const Response& response);
+
+  int rank_, size_, local_rank_, local_size_;
+  EngineOptions opts_;
+  TransportConfig tcfg_;
+  std::shared_ptr<ControllerTransport> transport_;
+  std::unique_ptr<Controller> controller_;
+  TensorQueue queue_;
+  HandleManager handles_;
+  Timeline timeline_;
+
+  std::thread background_;
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> stopped_{false};
+  std::atomic<bool> healthy_{true};
+  std::atomic<bool> join_pending_{false};
+  int64_t join_handle_ = -1;
+  std::mutex cycle_mu_;
+  std::condition_variable cycle_cv_;
+  bool work_available_ = false;
+
+  ExecuteFn execute_fn_ = nullptr;
+  void* execute_user_data_ = nullptr;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_ENGINE_H

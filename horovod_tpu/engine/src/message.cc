@@ -1,0 +1,211 @@
+#include "message.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace hvdtpu {
+
+namespace {
+
+// Minimal binary writer/reader: little-endian PODs, u32-length-prefixed
+// strings/vectors.
+class Writer {
+ public:
+  explicit Writer(std::string* out) : out_(out) {}
+  template <typename T>
+  void Pod(T v) {
+    out_->append(reinterpret_cast<const char*>(&v), sizeof(T));
+  }
+  void Str(const std::string& s) {
+    Pod<uint32_t>(static_cast<uint32_t>(s.size()));
+    out_->append(s);
+  }
+  template <typename T>
+  void Vec(const std::vector<T>& v) {
+    Pod<uint32_t>(static_cast<uint32_t>(v.size()));
+    for (const T& x : v) Pod<T>(x);
+  }
+
+ private:
+  std::string* out_;
+};
+
+class Reader {
+ public:
+  Reader(const char* data, size_t len) : data_(data), len_(len) {}
+  template <typename T>
+  T Pod() {
+    Check(sizeof(T));
+    T v;
+    std::memcpy(&v, data_ + pos_, sizeof(T));
+    pos_ += sizeof(T);
+    return v;
+  }
+  std::string Str() {
+    uint32_t n = Pod<uint32_t>();
+    Check(n);
+    std::string s(data_ + pos_, n);
+    pos_ += n;
+    return s;
+  }
+  template <typename T>
+  std::vector<T> Vec() {
+    uint32_t n = Pod<uint32_t>();
+    std::vector<T> v;
+    v.reserve(n);
+    for (uint32_t i = 0; i < n; ++i) v.push_back(Pod<T>());
+    return v;
+  }
+  size_t pos() const { return pos_; }
+
+ private:
+  void Check(size_t need) {
+    if (pos_ + need > len_) {
+      throw std::runtime_error("hvdtpu message: truncated buffer");
+    }
+  }
+  const char* data_;
+  size_t len_;
+  size_t pos_ = 0;
+};
+
+}  // namespace
+
+void Request::SerializeTo(std::string* out) const {
+  Writer w(out);
+  w.Pod<int32_t>(request_rank);
+  w.Pod<int32_t>(static_cast<int32_t>(op_type));
+  w.Str(tensor_name);
+  w.Pod<int32_t>(static_cast<int32_t>(dtype));
+  w.Vec<int64_t>(shape.dims);
+  w.Pod<int32_t>(root_rank);
+  w.Pod<int32_t>(device);
+  w.Pod<double>(prescale_factor);
+  w.Pod<double>(postscale_factor);
+  w.Pod<int32_t>(reduce_op);
+  w.Pod<int32_t>(group_id);
+  w.Pod<int32_t>(group_size);
+}
+
+Request Request::Deserialize(const char* data, size_t len, size_t* consumed) {
+  Reader r(data, len);
+  Request req;
+  req.request_rank = r.Pod<int32_t>();
+  req.op_type = static_cast<OpType>(r.Pod<int32_t>());
+  req.tensor_name = r.Str();
+  req.dtype = static_cast<DataType>(r.Pod<int32_t>());
+  req.shape.dims = r.Vec<int64_t>();
+  req.root_rank = r.Pod<int32_t>();
+  req.device = r.Pod<int32_t>();
+  req.prescale_factor = r.Pod<double>();
+  req.postscale_factor = r.Pod<double>();
+  req.reduce_op = r.Pod<int32_t>();
+  req.group_id = r.Pod<int32_t>();
+  req.group_size = r.Pod<int32_t>();
+  if (consumed) *consumed = r.pos();
+  return req;
+}
+
+void RequestList::SerializeTo(std::string* out) const {
+  Writer w(out);
+  w.Pod<uint8_t>(shutdown ? 1 : 0);
+  w.Pod<uint8_t>(join ? 1 : 0);
+  w.Pod<uint32_t>(static_cast<uint32_t>(requests.size()));
+  for (const auto& r : requests) r.SerializeTo(out);
+}
+
+RequestList RequestList::Deserialize(const std::string& buf) {
+  Reader r(buf.data(), buf.size());
+  RequestList list;
+  list.shutdown = r.Pod<uint8_t>() != 0;
+  list.join = r.Pod<uint8_t>() != 0;
+  uint32_t n = r.Pod<uint32_t>();
+  size_t offset = r.pos();
+  for (uint32_t i = 0; i < n; ++i) {
+    size_t consumed = 0;
+    list.requests.push_back(
+        Request::Deserialize(buf.data() + offset, buf.size() - offset,
+                             &consumed));
+    offset += consumed;
+  }
+  return list;
+}
+
+void Response::SerializeTo(std::string* out) const {
+  Writer w(out);
+  w.Pod<int32_t>(static_cast<int32_t>(type));
+  w.Pod<uint32_t>(static_cast<uint32_t>(tensor_names.size()));
+  for (const auto& n : tensor_names) w.Str(n);
+  w.Str(error_message);
+  w.Vec<int64_t>(tensor_sizes);
+  w.Vec<int32_t>(joined_ranks);
+  w.Pod<int32_t>(last_joined_rank);
+  w.Vec<int32_t>(tensor_dtypes);
+  w.Vec<int32_t>(tensor_ndims);
+  w.Vec<int64_t>(tensor_dims_flat);
+  w.Pod<int32_t>(reduce_op);
+  w.Pod<int32_t>(root_rank);
+  w.Pod<double>(prescale_factor);
+  w.Pod<double>(postscale_factor);
+  w.Pod<int32_t>(group_id);
+}
+
+Response Response::Deserialize(const char* data, size_t len,
+                               size_t* consumed) {
+  Reader r(data, len);
+  Response resp;
+  resp.type = static_cast<Type>(r.Pod<int32_t>());
+  uint32_t n = r.Pod<uint32_t>();
+  for (uint32_t i = 0; i < n; ++i) resp.tensor_names.push_back(r.Str());
+  resp.error_message = r.Str();
+  resp.tensor_sizes = r.Vec<int64_t>();
+  resp.joined_ranks = r.Vec<int32_t>();
+  resp.last_joined_rank = r.Pod<int32_t>();
+  resp.tensor_dtypes = r.Vec<int32_t>();
+  resp.tensor_ndims = r.Vec<int32_t>();
+  resp.tensor_dims_flat = r.Vec<int64_t>();
+  resp.reduce_op = r.Pod<int32_t>();
+  resp.root_rank = r.Pod<int32_t>();
+  resp.prescale_factor = r.Pod<double>();
+  resp.postscale_factor = r.Pod<double>();
+  resp.group_id = r.Pod<int32_t>();
+  if (consumed) *consumed = r.pos();
+  return resp;
+}
+
+void ResponseList::SerializeTo(std::string* out) const {
+  Writer w(out);
+  w.Pod<uint8_t>(shutdown ? 1 : 0);
+  w.Pod<uint32_t>(static_cast<uint32_t>(responses.size()));
+  for (const auto& resp : responses) resp.SerializeTo(out);
+}
+
+ResponseList ResponseList::Deserialize(const std::string& buf) {
+  Reader r(buf.data(), buf.size());
+  ResponseList list;
+  list.shutdown = r.Pod<uint8_t>() != 0;
+  uint32_t n = r.Pod<uint32_t>();
+  size_t offset = r.pos();
+  for (uint32_t i = 0; i < n; ++i) {
+    size_t consumed = 0;
+    list.responses.push_back(Response::Deserialize(
+        buf.data() + offset, buf.size() - offset, &consumed));
+    offset += consumed;
+  }
+  return list;
+}
+
+const char* ResponseTypeName(Response::Type t) {
+  switch (t) {
+    case Response::Type::ALLREDUCE: return "ALLREDUCE";
+    case Response::Type::ALLGATHER: return "ALLGATHER";
+    case Response::Type::BROADCAST: return "BROADCAST";
+    case Response::Type::ALLTOALL: return "ALLTOALL";
+    case Response::Type::JOIN: return "JOIN";
+    case Response::Type::BARRIER: return "BARRIER";
+    case Response::Type::ERROR: return "ERROR";
+  }
+  return "UNKNOWN";
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,103 @@
+// Wire protocol: Request / Response messages between workers and the rank-0
+// coordinator.
+//
+// Reference analog: horovod/common/message.h:50-251 + wire/message.fbs. The
+// reference serializes with FlatBuffers; this engine uses a dependency-free
+// length-prefixed binary format (the control messages are tiny and
+// latency-bound, not throughput-bound).
+
+#ifndef HVD_TPU_MESSAGE_H
+#define HVD_TPU_MESSAGE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// A worker's announcement that one tensor is ready (reference: message.h
+// Request).
+struct Request {
+  int32_t request_rank = 0;
+  OpType op_type = OpType::ALLREDUCE;
+  std::string tensor_name;
+  DataType dtype = DataType::FLOAT32;
+  TensorShape shape;
+  int32_t root_rank = 0;
+  int32_t device = 0;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  int32_t reduce_op = 0;
+  int32_t group_id = -1;
+  int32_t group_size = 0;  // number of tensors in the group (grouped ops)
+
+  void SerializeTo(std::string* out) const;
+  static Request Deserialize(const char* data, size_t len, size_t* consumed);
+};
+
+// A whole cycle's worth of requests from one rank, plus engine state bits
+// (reference: message.h RequestList with shutdown/joined flags).
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+  bool join = false;  // this rank has entered hvd.join()
+
+  void SerializeTo(std::string* out) const;
+  static RequestList Deserialize(const std::string& buf);
+};
+
+// Coordinator's verdict: a fused set of tensors every rank must now execute
+// (reference: message.h Response).
+struct Response {
+  enum class Type : int32_t {
+    ALLREDUCE = 0,
+    ALLGATHER = 1,
+    BROADCAST = 2,
+    ALLTOALL = 3,
+    JOIN = 4,
+    BARRIER = 5,
+    ERROR = 6,
+  };
+
+  Type type = Type::ALLREDUCE;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  // Allgather: per-rank first-dim sizes, rank-major then tensor-major
+  // (reference: controller.cc:576-648).
+  std::vector<int64_t> tensor_sizes;
+  // Ranks currently joined (data plane substitutes zeros for them).
+  std::vector<int32_t> joined_ranks;
+  int32_t last_joined_rank = -1;
+  // Per-tensor metadata so ranks that never enqueued a tensor (joined ranks)
+  // can still participate with correctly-shaped zeros. Parallel to
+  // tensor_names; dims flattened with ndims giving the split.
+  std::vector<int32_t> tensor_dtypes;
+  std::vector<int32_t> tensor_ndims;
+  std::vector<int64_t> tensor_dims_flat;
+  // Op params — uniform across a fused response (fusion only merges
+  // same-param tensors).
+  int32_t reduce_op = 0;
+  int32_t root_rank = 0;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  int32_t group_id = -1;  // grouped ops fuse atomically
+
+  void SerializeTo(std::string* out) const;
+  static Response Deserialize(const char* data, size_t len, size_t* consumed);
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+
+  void SerializeTo(std::string* out) const;
+  static ResponseList Deserialize(const std::string& buf);
+};
+
+const char* ResponseTypeName(Response::Type t);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_MESSAGE_H

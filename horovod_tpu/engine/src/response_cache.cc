@@ -1,0 +1,101 @@
+#include "response_cache.h"
+
+#include <stdexcept>
+
+namespace hvdtpu {
+
+namespace {
+bool SameParams(const Request& a, const Request& b) {
+  return a.op_type == b.op_type && a.dtype == b.dtype &&
+         a.shape == b.shape && a.root_rank == b.root_rank &&
+         a.device == b.device && a.prescale_factor == b.prescale_factor &&
+         a.postscale_factor == b.postscale_factor &&
+         a.reduce_op == b.reduce_op;
+}
+}  // namespace
+
+ResponseCache::CacheState ResponseCache::Cached(const Request& message) const {
+  auto it = cache_.find(message.tensor_name);
+  if (it == cache_.end()) return CacheState::MISS;
+  return SameParams(it->second.params, message) ? CacheState::HIT
+                                                : CacheState::INVALID;
+}
+
+void ResponseCache::Put(const Response& response, const Request& params) {
+  const std::string& name = params.tensor_name;
+  auto it = cache_.find(name);
+  if (it != cache_.end()) {
+    it->second.response = response;
+    it->second.params = params;
+    TouchLRU(name);
+    return;
+  }
+  if (cache_.size() >= capacity_) {
+    // Evict LRU — identical decision on every rank.
+    const std::string victim = lru_.back();
+    Erase(victim);
+  }
+  // Claim the lowest free slot for a stable bit position.
+  uint32_t pos = 0;
+  bool found = false;
+  for (uint32_t i = 0; i < slots_.size(); ++i) {
+    if (slots_[i].empty()) {
+      pos = i;
+      found = true;
+      break;
+    }
+  }
+  if (!found) {
+    pos = static_cast<uint32_t>(slots_.size());
+    slots_.emplace_back();
+  }
+  slots_[pos] = name;
+  cache_[name] = Entry{response, params, pos};
+  lru_.push_front(name);
+  lru_pos_[name] = lru_.begin();
+}
+
+const Response& ResponseCache::GetResponse(uint32_t position) {
+  if (position >= slots_.size() || slots_[position].empty()) {
+    throw std::runtime_error("response cache: bad position");
+  }
+  const std::string& name = slots_[position];
+  TouchLRU(name);
+  return cache_.at(name).response;
+}
+
+uint32_t ResponseCache::PeekPosition(const std::string& name) const {
+  auto it = cache_.find(name);
+  if (it == cache_.end()) {
+    throw std::runtime_error("response cache: name not cached: " + name);
+  }
+  return it->second.position;
+}
+
+void ResponseCache::Erase(const std::string& name) {
+  auto it = cache_.find(name);
+  if (it == cache_.end()) return;
+  slots_[it->second.position].clear();
+  auto lit = lru_pos_.find(name);
+  if (lit != lru_pos_.end()) {
+    lru_.erase(lit->second);
+    lru_pos_.erase(lit);
+  }
+  cache_.erase(it);
+}
+
+void ResponseCache::Clear() {
+  cache_.clear();
+  slots_.clear();
+  lru_.clear();
+  lru_pos_.clear();
+}
+
+void ResponseCache::TouchLRU(const std::string& name) {
+  auto lit = lru_pos_.find(name);
+  if (lit != lru_pos_.end()) lru_.erase(lit->second);
+  lru_.push_front(name);
+  lru_pos_[name] = lru_.begin();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,74 @@
+// LRU cache of negotiated responses — the steady-state fast path.
+//
+// Reference analog: horovod/common/response_cache.{h,cc} (:107-169
+// CacheCoordinator). After the first negotiation of a tensor, subsequent
+// cycles skip the rank-0 master/worker exchange entirely: each rank marks a
+// bit per cached pending tensor, one bitwise-AND allreduce finds the tensors
+// ready on *every* rank, and those execute straight from cache
+// (reference: controller.cc:180-237). Cache state stays identical across
+// ranks because every rank applies the same response stream in the same
+// order.
+
+#ifndef HVD_TPU_RESPONSE_CACHE_H
+#define HVD_TPU_RESPONSE_CACHE_H
+
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+class ResponseCache {
+ public:
+  enum class CacheState { MISS = 0, HIT = 1, INVALID = 2 };
+
+  void set_capacity(uint32_t capacity) { capacity_ = capacity; }
+  uint32_t capacity() const { return capacity_; }
+  size_t num_active_bits() const { return cache_.size(); }
+  // Bit-vector domain: includes freed slots (stable positions).
+  size_t num_slots() const { return slots_.size(); }
+  // Name at a slot ("" if free) — for coordinated invalidation.
+  const std::string& SlotName(uint32_t position) const {
+    static const std::string empty;
+    return position < slots_.size() ? slots_[position] : empty;
+  }
+
+  // HIT if name cached with identical parameters, INVALID if cached but
+  // parameters changed (must renegotiate + evict), MISS otherwise.
+  CacheState Cached(const Request& message) const;
+
+  // Store a freshly negotiated single-tensor response (moves to MRU).
+  void Put(const Response& response, const Request& params);
+
+  const Response& GetResponse(uint32_t position);
+  uint32_t PeekPosition(const std::string& name) const;
+
+  void Erase(const std::string& name);
+  void Clear();
+
+ private:
+  struct Entry {
+    Response response;
+    Request params;
+    uint32_t position;  // stable bit index
+  };
+
+  void TouchLRU(const std::string& name);
+
+  uint32_t capacity_ = 1024;
+  // name -> entry; positions are stable indices into a slot table so the
+  // coordination bit vector is consistent across ranks.
+  std::unordered_map<std::string, Entry> cache_;
+  std::vector<std::string> slots_;        // position -> name ("" = free)
+  std::list<std::string> lru_;            // front = most recent
+  std::unordered_map<std::string, std::list<std::string>::iterator> lru_pos_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_RESPONSE_CACHE_H

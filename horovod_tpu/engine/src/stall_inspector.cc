@@ -1,0 +1,78 @@
+#include "stall_inspector.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <sstream>
+
+namespace hvdtpu {
+
+void StallInspector::RecordUncachedTensorRank(const std::string& name,
+                                              int32_t rank) {
+  if (disabled_) return;
+  auto it = uncached_.find(name);
+  if (it == uncached_.end()) {
+    uncached_[name] = Info{{rank}, Clock::now(), false};
+    return;
+  }
+  auto& ranks = it->second.ranks;
+  if (std::find(ranks.begin(), ranks.end(), rank) == ranks.end()) {
+    ranks.push_back(rank);
+  }
+}
+
+void StallInspector::RemoveUncachedTensor(const std::string& name) {
+  uncached_.erase(name);
+}
+
+bool StallInspector::CheckForStalledTensors(int32_t global_size) {
+  if (disabled_) return false;
+  bool should_shut_down = false;
+  auto now = Clock::now();
+  std::ostringstream warn;
+  int n_stalled = 0;
+  for (auto& kv : uncached_) {
+    auto& info = kv.second;
+    double waited =
+        std::chrono::duration<double>(now - info.first_seen).count();
+    if (waited < warning_time_sec_) continue;
+    if (shutdown_time_sec_ > 0 && waited > shutdown_time_sec_) {
+      should_shut_down = true;
+    }
+    if (info.warned) continue;
+    info.warned = true;
+    ++n_stalled;
+    std::vector<int32_t> missing;
+    std::vector<int32_t> ready = info.ranks;
+    std::sort(ready.begin(), ready.end());
+    for (int32_t r = 0; r < global_size; ++r) {
+      if (!std::binary_search(ready.begin(), ready.end(), r)) {
+        missing.push_back(r);
+      }
+    }
+    warn << "  " << kv.first << " [ready ranks:";
+    for (auto r : ready) warn << " " << r;
+    warn << "] [missing ranks:";
+    for (auto r : missing) warn << " " << r;
+    warn << "]\n";
+  }
+  if (n_stalled > 0) {
+    std::string msg =
+        "One or more tensors were submitted to be reduced, gathered or "
+        "broadcasted by subset of ranks and are waiting for remainder of "
+        "ranks for more than " +
+        std::to_string(static_cast<int>(warning_time_sec_)) + " seconds. "
+        "This may indicate that different ranks are trying to submit "
+        "different tensors or that only subset of ranks is submitting "
+        "tensors.\nStalled ops:\n" + warn.str();
+    if (log_fn_) {
+      log_fn_(msg);
+    } else {
+      std::fprintf(stderr, "[hvdtpu] WARNING: %s", msg.c_str());
+    }
+  }
+  return should_shut_down;
+}
+
+void StallInspector::Clear() { uncached_.clear(); }
+
+}  // namespace hvdtpu

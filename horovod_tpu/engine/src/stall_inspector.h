@@ -1,0 +1,61 @@
+// Stalled-negotiation detection.
+//
+// Reference analog: horovod/common/stall_inspector.{h,cc}:30-96 — rank 0
+// warns when a tensor has been submitted by some ranks but not all for
+// longer than the warning interval, naming ready vs missing ranks; can
+// optionally shut the job down after a longer deadline. Worker ranks track
+// their own uncompleted tensors for reporting.
+
+#ifndef HVD_TPU_STALL_INSPECTOR_H
+#define HVD_TPU_STALL_INSPECTOR_H
+
+#include <chrono>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class StallInspector {
+ public:
+  using Clock = std::chrono::steady_clock;
+  using LogFn = std::function<void(const std::string&)>;
+
+  void set_warning_time_sec(double t) { warning_time_sec_ = t; }
+  void set_shutdown_time_sec(double t) { shutdown_time_sec_ = t; }
+  void set_disabled(bool d) { disabled_ = d; }
+  void set_log_fn(LogFn fn) { log_fn_ = std::move(fn); }
+
+  // Rank 0: record that `rank` reported `name` ready.
+  void RecordUncachedTensorRank(const std::string& name, int32_t rank);
+  // Rank 0: tensor completed — forget it.
+  void RemoveUncachedTensor(const std::string& name);
+
+  // Rank 0: scan; emit warnings listing ready/missing ranks per stalled
+  // tensor. Returns true if the shutdown deadline has been exceeded
+  // (reference: stall_inspector.h:74-80 → engine aborts).
+  bool CheckForStalledTensors(int32_t global_size);
+
+  void Clear();
+
+ private:
+  double warning_time_sec_ = 60.0;
+  double shutdown_time_sec_ = 0.0;  // 0 = never shut down
+  bool disabled_ = false;
+  LogFn log_fn_;
+
+  struct Info {
+    std::vector<int32_t> ranks;
+    Clock::time_point first_seen;
+    bool warned = false;
+  };
+  std::unordered_map<std::string, Info> uncached_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_STALL_INSPECTOR_H

@@ -1,0 +1,58 @@
+#include "tensor_queue.h"
+
+namespace hvdtpu {
+
+Status TensorQueue::AddToTensorQueue(TensorTableEntry entry, Request message) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto name = entry.name;
+  if (!table_.emplace(name, std::move(entry)).second) {
+    return Status::InvalidArgument(
+        "Requested to " + std::string(OpTypeName(message.op_type)) +
+        " a tensor with the same name as another tensor that is currently "
+        "being processed: " + name);
+  }
+  message_queue_.push_back(std::move(message));
+  return Status::OK();
+}
+
+void TensorQueue::PopMessagesFromQueue(std::vector<Request>* messages) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  while (!message_queue_.empty()) {
+    messages->push_back(std::move(message_queue_.front()));
+    message_queue_.pop_front();
+  }
+}
+
+Status TensorQueue::GetTensorEntry(const std::string& name,
+                                   TensorTableEntry* entry) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto it = table_.find(name);
+  if (it == table_.end()) {
+    return Status::Unknown("tensor not found in queue: " + name);
+  }
+  *entry = std::move(it->second);
+  table_.erase(it);
+  return Status::OK();
+}
+
+bool TensorQueue::HasEntry(const std::string& name) const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return table_.count(name) != 0;
+}
+
+std::vector<TensorTableEntry> TensorQueue::AbortAll() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  std::vector<TensorTableEntry> out;
+  out.reserve(table_.size());
+  for (auto& kv : table_) out.push_back(std::move(kv.second));
+  table_.clear();
+  message_queue_.clear();
+  return out;
+}
+
+size_t TensorQueue::size() const {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return table_.size();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,50 @@
+// Thread-safe staging area between frontend enqueue calls and the background
+// cycle loop.
+//
+// Reference analog: horovod/common/tensor_queue.{h,cc}:28-64 — pending
+// TensorTableEntry table + message queue, duplicate-name rejection.
+
+#ifndef HVD_TPU_TENSOR_QUEUE_H
+#define HVD_TPU_TENSOR_QUEUE_H
+
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+class TensorQueue {
+ public:
+  // Stages an entry + its negotiation request. Fails on duplicate name
+  // (reference: common.h:166-169 DUPLICATE_NAME_ERROR).
+  Status AddToTensorQueue(TensorTableEntry entry, Request message);
+
+  // Drains all pending negotiation messages (called once per cycle,
+  // reference: controller.cc:85 PopMessagesFromQueue).
+  void PopMessagesFromQueue(std::vector<Request>* messages);
+
+  // Removes and returns the entry for a finalized tensor.
+  Status GetTensorEntry(const std::string& name, TensorTableEntry* entry);
+
+  bool HasEntry(const std::string& name) const;
+
+  // Abort everything in flight (elastic reset / shutdown): returns all
+  // pending entries so their handles can be failed.
+  std::vector<TensorTableEntry> AbortAll();
+
+  size_t size() const;
+
+ private:
+  mutable std::mutex mutex_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+  std::deque<Request> message_queue_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_TENSOR_QUEUE_H

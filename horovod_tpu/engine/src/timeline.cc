@@ -1,0 +1,110 @@
+#include "timeline.h"
+
+namespace hvdtpu {
+
+Timeline::~Timeline() { Shutdown(); }
+
+void Timeline::Initialize(const std::string& path, bool mark_cycles) {
+  if (initialized_.load()) return;
+  file_ = std::fopen(path.c_str(), "w");
+  if (file_ == nullptr) return;
+  mark_cycles_ = mark_cycles;
+  start_ = std::chrono::steady_clock::now();
+  std::fputs("[\n", file_);
+  first_event_ = true;
+  stop_.store(false);
+  writer_ = std::thread([this] { WriterLoop(); });
+  initialized_.store(true);
+}
+
+void Timeline::Shutdown() {
+  if (!initialized_.load()) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    stop_.store(true);
+    cv_.notify_all();
+  }
+  if (writer_.joinable()) writer_.join();
+  if (file_ != nullptr) {
+    std::fputs("\n]\n", file_);
+    std::fclose(file_);
+    file_ = nullptr;
+  }
+  initialized_.store(false);
+}
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+void Timeline::Enqueue(Event e) {
+  if (!initialized_.load()) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  queue_.push(std::move(e));
+  cv_.notify_one();
+}
+
+void Timeline::NegotiateStart(const std::string& name, OpType op_type) {
+  Enqueue({'B', std::string("NEGOTIATE_") + OpTypeName(op_type), name,
+           NowUs()});
+}
+
+void Timeline::NegotiateRankReady(const std::string& name, int rank) {
+  Enqueue({'i', std::to_string(rank), name, NowUs()});
+}
+
+void Timeline::NegotiateEnd(const std::string& name) {
+  Enqueue({'E', "", name, NowUs()});
+}
+
+void Timeline::ActivityStart(const std::string& name,
+                             const std::string& activity) {
+  Enqueue({'B', activity, name, NowUs()});
+}
+
+void Timeline::ActivityEnd(const std::string& name) {
+  Enqueue({'E', "", name, NowUs()});
+}
+
+void Timeline::MarkCycleStart() {
+  if (!mark_cycles_) return;
+  Enqueue({'i', "CYCLE_START", "cycles", NowUs()});
+}
+
+void Timeline::WriterLoop() {
+  while (true) {
+    std::queue<Event> batch;
+    {
+      std::unique_lock<std::mutex> lock(mu_);
+      cv_.wait(lock, [&] { return stop_.load() || !queue_.empty(); });
+      std::swap(batch, queue_);
+      if (batch.empty() && stop_.load()) break;
+    }
+    while (!batch.empty()) {
+      const Event& e = batch.front();
+      if (!first_event_) std::fputs(",\n", file_);
+      first_event_ = false;
+      // tid = tensor name lane; pid 0 — matches the reference's
+      // one-lane-per-tensor rendering.
+      if (e.ph == 'i') {
+        std::fprintf(file_,
+                     "{\"ph\":\"i\",\"name\":\"%s\",\"pid\":0,\"tid\":\"%s\","
+                     "\"ts\":%lld,\"s\":\"t\"}",
+                     e.name.c_str(), e.tid.c_str(),
+                     static_cast<long long>(e.ts_us));
+      } else {
+        std::fprintf(file_,
+                     "{\"ph\":\"%c\",\"name\":\"%s\",\"pid\":0,\"tid\":\"%s\","
+                     "\"ts\":%lld}",
+                     e.ph, e.name.c_str(), e.tid.c_str(),
+                     static_cast<long long>(e.ts_us));
+      }
+      batch.pop();
+    }
+    std::fflush(file_);
+  }
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,73 @@
+// Chrome-tracing timeline writer.
+//
+// Reference analog: horovod/common/timeline.{h,cc} — per-tensor state
+// machine (NEGOTIATING → TOP_LEVEL → ACTIVITY), a dedicated writer thread
+// draining a producer queue, incremental chrome://tracing JSON output,
+// optional cycle markers. This implementation keeps the same event
+// vocabulary (NEGOTIATE_<OP>, the op activities, CYCLE_START) with a
+// mutex-guarded queue (control-plane event rates are tiny next to the data
+// plane, so a lock-free SPSC ring isn't warranted).
+
+#ifndef HVD_TPU_TIMELINE_H
+#define HVD_TPU_TIMELINE_H
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  ~Timeline();
+
+  void Initialize(const std::string& path, bool mark_cycles);
+  void Shutdown();
+  bool Initialized() const { return initialized_.load(); }
+
+  // Negotiation phase (reference: controller.cc:950-963 instrumentation).
+  void NegotiateStart(const std::string& tensor_name, OpType op_type);
+  void NegotiateRankReady(const std::string& tensor_name, int rank);
+  void NegotiateEnd(const std::string& tensor_name);
+
+  // Execution phase.
+  void ActivityStart(const std::string& tensor_name,
+                     const std::string& activity);
+  void ActivityEnd(const std::string& tensor_name);
+  void MarkCycleStart();
+
+ private:
+  struct Event {
+    char ph;  // 'B', 'E', 'i'
+    std::string name;
+    std::string tid;
+    int64_t ts_us;
+  };
+
+  void Enqueue(Event e);
+  void WriterLoop();
+  int64_t NowUs() const;
+
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> stop_{false};
+  bool mark_cycles_ = false;
+  std::FILE* file_ = nullptr;
+  std::thread writer_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::queue<Event> queue_;
+  std::chrono::steady_clock::time_point start_;
+  bool first_event_ = true;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_TIMELINE_H

@@ -1,0 +1,359 @@
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cerrno>
+#include <cstring>
+#include <thread>
+#include <unordered_map>
+
+namespace hvdtpu {
+
+// ---------------------------------------------------------------------------
+// Loopback
+
+LoopbackHub::LoopbackHub(int size_in) : size(size_in), slots(size_in) {}
+
+void LoopbackHub::BarrierWait() {
+  std::unique_lock<std::mutex> lock(mu);
+  uint64_t gen = generation;
+  if (++arrived == size) {
+    arrived = 0;
+    ++generation;
+    cv.notify_all();
+  } else {
+    cv.wait(lock, [&] { return generation != gen || aborted; });
+  }
+}
+
+void LoopbackHub::Abort() {
+  std::lock_guard<std::mutex> lock(mu);
+  aborted = true;
+  cv.notify_all();
+}
+
+LoopbackTransport::LoopbackTransport(std::shared_ptr<LoopbackHub> hub,
+                                     int rank)
+    : hub_(std::move(hub)), rank_(rank) {}
+
+Status LoopbackTransport::Gather(const std::string& mine,
+                                 std::vector<std::string>* out) {
+  {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+    if (hub_->aborted) return Status::Aborted("loopback hub aborted");
+    hub_->slots[rank_] = mine;
+  }
+  hub_->BarrierWait();
+  if (rank_ == 0 && out != nullptr) *out = hub_->slots;
+  hub_->BarrierWait();  // don't reuse slots until root has copied
+  return hub_->aborted ? Status::Aborted("loopback hub aborted") : Status::OK();
+}
+
+Status LoopbackTransport::Bcast(std::string* payload) {
+  if (rank_ == 0) {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+    hub_->bcast_buf = *payload;
+  }
+  hub_->BarrierWait();
+  if (rank_ != 0) {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+    *payload = hub_->bcast_buf;
+  }
+  hub_->BarrierWait();
+  return hub_->aborted ? Status::Aborted("loopback hub aborted") : Status::OK();
+}
+
+Status LoopbackTransport::BitAllreduce(std::vector<uint64_t>* bits,
+                                       bool is_and) {
+  {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+    if (hub_->aborted) return Status::Aborted("loopback hub aborted");
+    if (hub_->bits_arrived == 0) {
+      hub_->bits = *bits;
+    } else {
+      if (hub_->bits.size() < bits->size()) {
+        hub_->bits.resize(bits->size(), is_and ? ~0ull : 0ull);
+      }
+      for (size_t i = 0; i < bits->size(); ++i) {
+        if (is_and) {
+          hub_->bits[i] &= (*bits)[i];
+        } else {
+          hub_->bits[i] |= (*bits)[i];
+        }
+      }
+    }
+    ++hub_->bits_arrived;
+  }
+  hub_->BarrierWait();
+  {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+    *bits = hub_->bits;
+  }
+  hub_->BarrierWait();
+  {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+    hub_->bits_arrived = 0;
+  }
+  hub_->BarrierWait();
+  return hub_->aborted ? Status::Aborted("loopback hub aborted") : Status::OK();
+}
+
+Status LoopbackTransport::Barrier() {
+  hub_->BarrierWait();
+  return hub_->aborted ? Status::Aborted("loopback hub aborted") : Status::OK();
+}
+
+namespace {
+std::mutex g_hub_mu;
+std::unordered_map<std::string, std::shared_ptr<LoopbackHub>> g_hubs;
+}  // namespace
+
+std::shared_ptr<LoopbackHub> GetOrCreateLoopbackHub(const std::string& group,
+                                                    int size) {
+  std::lock_guard<std::mutex> lock(g_hub_mu);
+  auto it = g_hubs.find(group);
+  if (it != g_hubs.end()) return it->second;
+  auto hub = std::make_shared<LoopbackHub>(size);
+  g_hubs[group] = hub;
+  return hub;
+}
+
+void ReleaseLoopbackHub(const std::string& group) {
+  std::lock_guard<std::mutex> lock(g_hub_mu);
+  g_hubs.erase(group);
+}
+
+// ---------------------------------------------------------------------------
+// TCP
+
+namespace {
+
+Status SetTimeout(int fd, double timeout_sec) {
+  if (timeout_sec <= 0) return Status::OK();
+  struct timeval tv;
+  tv.tv_sec = static_cast<long>(timeout_sec);
+  tv.tv_usec = static_cast<long>((timeout_sec - tv.tv_sec) * 1e6);
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) != 0 ||
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv)) != 0) {
+    return Status::Unknown("setsockopt timeout failed");
+  }
+  return Status::OK();
+}
+
+Status WriteAll(int fd, const char* data, size_t len) {
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd, data + sent, len - sent, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("send failed: ") + strerror(errno));
+    }
+    sent += static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+Status ReadAll(int fd, char* data, size_t len) {
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::recv(fd, data + got, len - got, 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("recv failed: ") + strerror(errno));
+    }
+    if (n == 0) return Status::Aborted("peer closed connection");
+    got += static_cast<size_t>(n);
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+TcpTransport::TcpTransport(int rank, int size, const std::string& addr,
+                           int port, double timeout_sec)
+    : rank_(rank), size_(size), addr_(addr), port_(port),
+      timeout_sec_(timeout_sec) {}
+
+TcpTransport::~TcpTransport() {
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+  if (root_fd_ >= 0) ::close(root_fd_);
+  for (int fd : worker_fds_) {
+    if (fd >= 0 && fd != root_fd_) ::close(fd);
+  }
+}
+
+Status TcpTransport::Init() {
+  if (rank_ == 0) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return Status::Unknown("socket() failed");
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = INADDR_ANY;
+    sa.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+      return Status::Unknown(std::string("bind failed: ") + strerror(errno));
+    }
+    if (::listen(listen_fd_, size_) != 0) {
+      return Status::Unknown("listen failed");
+    }
+    worker_fds_.assign(size_, -1);
+    for (int i = 0; i < size_ - 1; ++i) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return Status::Unknown("accept failed");
+      int one2 = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+      SetTimeout(fd, timeout_sec_);
+      uint32_t peer_rank = 0;
+      auto st = ReadAll(fd, reinterpret_cast<char*>(&peer_rank),
+                        sizeof(peer_rank));
+      if (!st.ok()) return st;
+      if (peer_rank >= static_cast<uint32_t>(size_)) {
+        return Status::InvalidArgument("bad peer rank");
+      }
+      worker_fds_[peer_rank] = fd;
+    }
+  } else {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(
+                        timeout_sec_ > 0 ? timeout_sec_ : 60.0);
+    while (true) {
+      root_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (root_fd_ < 0) return Status::Unknown("socket() failed");
+      sockaddr_in sa{};
+      sa.sin_family = AF_INET;
+      sa.sin_port = htons(static_cast<uint16_t>(port_));
+      if (inet_pton(AF_INET, addr_.c_str(), &sa.sin_addr) != 1) {
+        // resolve hostname
+        struct addrinfo hints{};
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        struct addrinfo* res = nullptr;
+        if (getaddrinfo(addr_.c_str(), nullptr, &hints, &res) != 0 || !res) {
+          ::close(root_fd_);
+          return Status::Unknown("cannot resolve controller address " + addr_);
+        }
+        sa.sin_addr =
+            reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+        freeaddrinfo(res);
+      }
+      if (::connect(root_fd_, reinterpret_cast<sockaddr*>(&sa),
+                    sizeof(sa)) == 0) {
+        break;
+      }
+      ::close(root_fd_);
+      root_fd_ = -1;
+      if (std::chrono::steady_clock::now() > deadline) {
+        return Status::Unknown("timed out connecting to controller at " +
+                               addr_ + ":" + std::to_string(port_));
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    int one = 1;
+    setsockopt(root_fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    SetTimeout(root_fd_, timeout_sec_);
+    uint32_t my_rank = static_cast<uint32_t>(rank_);
+    auto st = WriteAll(root_fd_, reinterpret_cast<const char*>(&my_rank),
+                       sizeof(my_rank));
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status TcpTransport::SendFrame(int fd, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  auto st = WriteAll(fd, reinterpret_cast<const char*>(&len), sizeof(len));
+  if (!st.ok()) return st;
+  return WriteAll(fd, payload.data(), payload.size());
+}
+
+Status TcpTransport::RecvFrame(int fd, std::string* payload) {
+  uint32_t len = 0;
+  auto st = ReadAll(fd, reinterpret_cast<char*>(&len), sizeof(len));
+  if (!st.ok()) return st;
+  payload->resize(len);
+  if (len > 0) return ReadAll(fd, payload->data(), len);
+  return Status::OK();
+}
+
+Status TcpTransport::Gather(const std::string& mine,
+                            std::vector<std::string>* out) {
+  if (rank_ == 0) {
+    if (out != nullptr) {
+      out->assign(size_, std::string());
+      (*out)[0] = mine;
+      for (int r = 1; r < size_; ++r) {
+        auto st = RecvFrame(worker_fds_[r], &(*out)[r]);
+        if (!st.ok()) return st;
+      }
+    }
+    return Status::OK();
+  }
+  return SendFrame(root_fd_, mine);
+}
+
+Status TcpTransport::Bcast(std::string* payload) {
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; ++r) {
+      auto st = SendFrame(worker_fds_[r], *payload);
+      if (!st.ok()) return st;
+    }
+    return Status::OK();
+  }
+  return RecvFrame(root_fd_, payload);
+}
+
+Status TcpTransport::BitAllreduce(std::vector<uint64_t>* bits, bool is_and) {
+  std::string mine(reinterpret_cast<const char*>(bits->data()),
+                   bits->size() * sizeof(uint64_t));
+  std::vector<std::string> all;
+  auto st = Gather(mine, rank_ == 0 ? &all : nullptr);
+  if (!st.ok()) return st;
+  std::string result;
+  if (rank_ == 0) {
+    // Combine; payloads may differ in length — pad with identity.
+    size_t max_words = bits->size();
+    for (auto& p : all) {
+      max_words = std::max(max_words, p.size() / sizeof(uint64_t));
+    }
+    std::vector<uint64_t> acc(max_words, is_and ? ~0ull : 0ull);
+    for (auto& p : all) {
+      size_t words = p.size() / sizeof(uint64_t);
+      const uint64_t* w = reinterpret_cast<const uint64_t*>(p.data());
+      for (size_t i = 0; i < max_words; ++i) {
+        uint64_t v = i < words ? w[i] : (is_and ? ~0ull : 0ull);
+        if (is_and) {
+          acc[i] &= v;
+        } else {
+          acc[i] |= v;
+        }
+      }
+    }
+    result.assign(reinterpret_cast<const char*>(acc.data()),
+                  acc.size() * sizeof(uint64_t));
+  }
+  st = Bcast(&result);
+  if (!st.ok()) return st;
+  bits->assign(reinterpret_cast<const uint64_t*>(result.data()),
+               reinterpret_cast<const uint64_t*>(result.data()) +
+                   result.size() / sizeof(uint64_t));
+  return Status::OK();
+}
+
+Status TcpTransport::Barrier() {
+  std::vector<std::string> ignore;
+  auto st = Gather("", rank_ == 0 ? &ignore : nullptr);
+  if (!st.ok()) return st;
+  std::string empty;
+  return Bcast(&empty);
+}
+
+}  // namespace hvdtpu

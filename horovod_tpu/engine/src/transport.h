@@ -1,0 +1,132 @@
+// Controller-plane transports.
+//
+// Reference analog: the controller's pure-virtual transport surface
+// (horovod/common/controller.h:140-161 — RecvReadyTensors / SendReadyTensors
+// / SendFinalTensors / RecvFinalTensors / Bcast / Barrier /
+// CrossRankBitwiseAnd/Or), implemented over MPI (mpi_controller.cc:88-200)
+// or Gloo (gloo_controller.cc).
+//
+// This engine needs four primitives, provided by two implementations:
+// - LoopbackTransport: N ranks inside one process sharing a hub —
+//   the "single-process N-rank" harness SURVEY §7.2 calls for, enabling
+//   full protocol tests with no cluster.
+// - TcpTransport: rank 0 accepts size-1 framed-message connections
+//   (the Gloo-controller analog; rendezvous via launcher-provided addr).
+
+#ifndef HVD_TPU_TRANSPORT_H
+#define HVD_TPU_TRANSPORT_H
+
+#include <condition_variable>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class ControllerTransport {
+ public:
+  virtual ~ControllerTransport() = default;
+
+  virtual int rank() const = 0;
+  virtual int size() const = 0;
+
+  // Root receives every rank's payload (out->size() == size, index = rank);
+  // non-roots contribute and get an empty out.
+  virtual Status Gather(const std::string& mine,
+                        std::vector<std::string>* out) = 0;
+
+  // Root's payload is delivered to every rank.
+  virtual Status Bcast(std::string* payload) = 0;
+
+  // Elementwise bitwise AND/OR across ranks (cache-coordination bit vectors,
+  // reference: mpi_controller.cc:88-106).
+  virtual Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) = 0;
+
+  virtual Status Barrier() = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Loopback
+
+struct LoopbackHub {
+  explicit LoopbackHub(int size);
+
+  int size;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<std::string> slots;
+  std::string bcast_buf;
+  std::vector<uint64_t> bits;
+  int bits_arrived = 0;
+  int arrived = 0;
+  uint64_t generation = 0;
+  bool aborted = false;
+
+  void BarrierWait();
+  void Abort();
+};
+
+class LoopbackTransport : public ControllerTransport {
+ public:
+  LoopbackTransport(std::shared_ptr<LoopbackHub> hub, int rank);
+
+  int rank() const override { return rank_; }
+  int size() const override { return hub_->size; }
+  Status Gather(const std::string& mine,
+                std::vector<std::string>* out) override;
+  Status Bcast(std::string* payload) override;
+  Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) override;
+  Status Barrier() override;
+
+ private:
+  std::shared_ptr<LoopbackHub> hub_;
+  int rank_;
+};
+
+// Process-wide registry so N sessions in one process find the same hub.
+std::shared_ptr<LoopbackHub> GetOrCreateLoopbackHub(const std::string& group,
+                                                    int size);
+void ReleaseLoopbackHub(const std::string& group);
+
+// ---------------------------------------------------------------------------
+// TCP
+
+class TcpTransport : public ControllerTransport {
+ public:
+  // Rank 0 binds addr:port and accepts; others connect (with retry until
+  // timeout — covers launcher start skew).
+  TcpTransport(int rank, int size, const std::string& addr, int port,
+               double timeout_sec);
+  ~TcpTransport() override;
+
+  Status Init();  // establish the star topology
+
+  int rank() const override { return rank_; }
+  int size() const override { return size_; }
+  Status Gather(const std::string& mine,
+                std::vector<std::string>* out) override;
+  Status Bcast(std::string* payload) override;
+  Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) override;
+  Status Barrier() override;
+
+ private:
+  Status SendFrame(int fd, const std::string& payload);
+  Status RecvFrame(int fd, std::string* payload);
+
+  int rank_;
+  int size_;
+  std::string addr_;
+  int port_;
+  double timeout_sec_;
+  int listen_fd_ = -1;
+  int root_fd_ = -1;                 // worker→root socket (workers)
+  std::vector<int> worker_fds_;      // root's sockets indexed by rank
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_TRANSPORT_H

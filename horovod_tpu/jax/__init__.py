@@ -27,7 +27,12 @@ from horovod_tpu.parallel.collectives import (  # noqa: F401
     allgather, allreduce, alltoall, barrier, broadcast, grouped_allreduce,
     reducescatter,
 )
-from horovod_tpu.parallel.dp import DP_AXES, make_eval_step, make_train_step  # noqa: F401
+from horovod_tpu.parallel.dp import (  # noqa: F401
+    DP_AXES,
+    make_eval_step,
+    make_stateful_train_step,
+    make_train_step,
+)
 
 
 class _DistOptState(NamedTuple):
@@ -64,6 +69,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         raise ValueError("backward_passes_per_step must be >= 1")
 
     def _reduce(tree):
+        if op is Adasum:
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            outs = collectives.grouped_allreduce(
+                leaves, op=op, axis=_axes_in_scope(axis))
+            return jax.tree_util.tree_unflatten(treedef, outs)
         if gradient_predivide_factor != 1.0:
             pre = 1.0 / gradient_predivide_factor
             # Average = sum * (1/size); split the divisor around the wire.

@@ -40,6 +40,57 @@ def _adasum_combine(a: jax.Array, b: jax.Array) -> jax.Array:
     return out.astype(a.dtype)
 
 
+def adasum_allreduce_group(xs, axis: str = "data"):
+    """Adasum a list of tensors with ONE ppermute exchange per level but
+    per-tensor combination coefficients.
+
+    This matches the reference's fused Adasum: the exchange buffer is packed,
+    but dot products and norms are computed per tensor so each gradient keeps
+    its own scale-invariant coefficients (reference: adasum.h
+    DispatchComputeDotAndNormSqrds over per-tensor offsets/counts in the
+    fused buffer). Naively fusing Adasum elementwise would collapse all
+    tensors into one coefficient pair — different math.
+    """
+    xs = list(xs)
+    if not xs:
+        return []
+    n = lax.axis_size(axis)
+    if n & (n - 1):
+        raise ValueError(
+            f"Adasum requires a power-of-two axis size, got {n} "
+            "(same restriction as the reference)")
+    idx = lax.axis_index(axis)
+    shapes = [x.shape for x in xs]
+    dtypes = [x.dtype for x in xs]
+    sizes = [int(jnp.size(x)) for x in xs]
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+    fused = jnp.concatenate([x.astype(jnp.float32).ravel() for x in xs])
+
+    level = 1
+    while level < n:
+        perm = [(i, i ^ level) for i in range(n)]
+        other = lax.ppermute(fused, axis, perm)
+        is_lower = (idx & level) == 0
+        a = jnp.where(is_lower, fused, other)
+        b = jnp.where(is_lower, other, fused)
+        pieces = []
+        for t in range(len(xs)):
+            at = a[offsets[t]:offsets[t + 1]]
+            bt = b[offsets[t]:offsets[t + 1]]
+            dot = jnp.dot(at, bt)
+            na = jnp.dot(at, at)
+            nb = jnp.dot(bt, bt)
+            ac = jnp.where(na == 0, 1.0, 1.0 - dot / (2.0 * na))
+            bc = jnp.where(nb == 0, 1.0, 1.0 - dot / (2.0 * nb))
+            pieces.append(ac * at + bc * bt)
+        fused = jnp.concatenate(pieces)
+        level <<= 1
+    return [fused[offsets[t]:offsets[t + 1]].reshape(shapes[t])
+            .astype(dtypes[t]) for t in range(len(xs))]
+
+
 def adasum_allreduce(x: jax.Array, axis: str = "data") -> jax.Array:
     """Recursive distance-doubling Adasum across the named axis.
 

@@ -132,12 +132,22 @@ def grouped_allreduce(xs: Sequence[jax.Array],
     unit (reference: GroupTable, horovod/common/operations.cc:1008-1015). Here
     we concatenate flattened tensors per dtype-class into a single psum — one
     ICI collective instead of len(xs).
+
+    Adasum is NOT elementwise-fusable (its coefficients are per-tensor dot
+    products); it routes to the packed-exchange group variant that keeps
+    per-tensor coefficients (reference: adasum.h fused-buffer offsets).
     """
+    xs = list(xs)
+    if op is Adasum:
+        from horovod_tpu.parallel.adasum import adasum_allreduce_group
+        xs = [_scale(x, prescale_factor) for x in xs]
+        outs = adasum_allreduce_group(xs, axis)
+        return [_scale(o, postscale_factor) for o in outs]
     from horovod_tpu.ops.fusion import fused_apply
     fn = functools.partial(allreduce, op=op, axis=axis,
                            prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor)
-    return fused_apply(fn, list(xs))
+    return fused_apply(fn, xs)
 
 
 def allgather(x: jax.Array, axis=DEFAULT_AXIS) -> jax.Array:
@@ -182,6 +192,8 @@ def reducescatter(x: jax.Array, op: Op = Average, axis=DEFAULT_AXIS) -> jax.Arra
     (NCCLHierarchicalAllreduce's intra-node phase,
     ops/nccl_operations.cc:186-398); we expose it first-class because
     psum_scatter is the natural TPU gradient-sharding primitive."""
+    if op not in (Average, Sum):
+        raise ValueError(f"reducescatter supports Sum/Average, got {op}")
     out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
     if op is Average:
         out = (out.astype(jnp.float32) / axis_size(axis)).astype(x.dtype)

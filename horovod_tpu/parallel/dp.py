@@ -20,6 +20,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.ops.fusion import fused_apply_tree
@@ -33,6 +34,14 @@ DP_AXES = ("data", "fsdp")
 class TrainStepOutput(NamedTuple):
     params: Any
     opt_state: Any
+    loss: jax.Array
+    aux: Any
+
+
+class StatefulTrainStepOutput(NamedTuple):
+    params: Any
+    opt_state: Any
+    model_state: Any  # non-gradient model collections (batch_stats, ...)
     loss: jax.Array
     aux: Any
 
@@ -68,6 +77,14 @@ def make_train_step(loss_fn: Callable,
         compression = None
 
     def _allreduce_grads(tree):
+        if op is collectives.Adasum:
+            # Per-tensor coefficients — must not be elementwise-fused.
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            outs = collectives.grouped_allreduce(
+                leaves, op=op, axis=axes, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            return jax.tree_util.tree_unflatten(treedef, outs)
+
         def red(v):
             if compression is not None:
                 v, ctx = compression.compress(v)
@@ -99,9 +116,7 @@ def make_train_step(loss_fn: Callable,
             params, batch, rng)
         grads = _allreduce_grads(grads)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
-        new_params = jax.tree_util.tree_map(
-            lambda p, u: (p + u.astype(p.dtype)) if u is not None else p,
-            params, updates)
+        new_params = optax.apply_updates(params, updates)
         loss = collectives.allreduce(loss, op=Average, axis=axes)
         return TrainStepOutput(new_params, new_opt_state, loss, _sync_aux(aux))
 
@@ -114,6 +129,80 @@ def make_train_step(loss_fn: Callable,
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()
+    return jax.jit(mapped, donate_argnums=donate_argnums)
+
+
+def make_stateful_train_step(loss_fn: Callable,
+                             optimizer,
+                             mesh: Mesh,
+                             *,
+                             op: Op = Average,
+                             compression=None,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0,
+                             axes: Tuple[str, ...] = DP_AXES,
+                             donate: bool = True) -> Callable:
+    """Train step for models with non-gradient state (BatchNorm running
+    statistics etc.).
+
+    ``loss_fn(params, model_state, batch, rng) -> (loss, (new_model_state,
+    aux))``. The returned step has signature ``step(params, opt_state,
+    model_state, batch, rng) -> StatefulTrainStepOutput``. Floating leaves of
+    ``new_model_state`` are averaged across replicas — the cross-replica
+    statistics sync the reference provides via SyncBatchNormalization
+    (reference: horovod/torch/sync_batch_norm.py).
+    """
+    axes = tuple(a for a in axes if a in mesh.shape)
+    from horovod_tpu.jax.compression import Compression
+    if compression is Compression.none:
+        compression = None
+
+    def _allreduce_grads(tree):
+        if op is collectives.Adasum:
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            outs = collectives.grouped_allreduce(
+                leaves, op=op, axis=axes, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            return jax.tree_util.tree_unflatten(treedef, outs)
+
+        def red(v):
+            if compression is not None:
+                v, ctx = compression.compress(v)
+            out = collectives.allreduce(v, op=op, axis=axes,
+                                        prescale_factor=prescale_factor,
+                                        postscale_factor=postscale_factor,
+                                        accumulate_in_fp32=compression is None)
+            if compression is not None:
+                out = compression.decompress(out, ctx)
+            return out
+        return fused_apply_tree(red, tree)
+
+    def _sync_state(tree):
+        def sync(v):
+            if isinstance(v, jax.Array) and jnp.issubdtype(v.dtype,
+                                                           jnp.floating):
+                return collectives.allreduce(v, op=Average, axis=axes)
+            return v
+        return jax.tree_util.tree_map(sync, tree)
+
+    def _local_step(params, opt_state, model_state, batch, rng):
+        rng = jax.random.fold_in(rng, collectives.axis_rank(axes))
+        (loss, (new_model_state, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, model_state, batch, rng)
+        grads = _allreduce_grads(grads)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        loss = collectives.allreduce(loss, op=Average, axis=axes)
+        return StatefulTrainStepOutput(new_params, new_opt_state,
+                                       _sync_state(new_model_state), loss,
+                                       _sync_state(aux))
+
+    mapped = jax.shard_map(
+        _local_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P(axes), P()),
+        out_specs=StatefulTrainStepOutput(P(), P(), P(), P(), P()),
+        check_vma=False)
+    donate_argnums = (0, 1, 2) if donate else ()
     return jax.jit(mapped, donate_argnums=donate_argnums)
 
 

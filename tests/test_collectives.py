@@ -204,3 +204,30 @@ def test_barrier_compiles(dp_mesh):
 
     out = run_spmd(fn, dp_mesh, x)
     np.testing.assert_allclose(out, np.asarray(x).sum(0), rtol=1e-5)
+
+
+def test_grouped_adasum_keeps_per_tensor_coefficients(devices):
+    """Fused Adasum must match per-tensor Adasum exactly (reference:
+    adasum.h computes dots/norms per tensor inside the fused buffer)."""
+    from horovod_tpu.parallel import mesh as mesh_lib
+    mesh2 = mesh_lib.data_parallel_mesh(devices[:2])
+    rng = np.random.RandomState(1)
+    xs = [jnp.asarray(rng.uniform(-1, 1, size=(2, 5)), jnp.float32),
+          jnp.asarray(rng.uniform(-10, 10, size=(2, 3)), jnp.float32)]
+
+    def grouped(a, b):
+        return tuple(c.grouped_allreduce([a, b], op=c.Adasum))
+
+    def single(a, b):
+        return (c.allreduce(a, op=c.Adasum), c.allreduce(b, op=c.Adasum))
+
+    got = run_spmd(grouped, mesh2, *xs, out_specs=(P(), P()))
+    want = run_spmd(single, mesh2, *xs, out_specs=(P(), P()))
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-5)
+
+
+def test_reducescatter_rejects_unsupported_op(dp_mesh):
+    with pytest.raises(ValueError, match="reducescatter"):
+        run_spmd(lambda v: c.reducescatter(v, op=c.Min), dp_mesh,
+                 per_rank_values((8, 2), jnp.float32), out_specs=P("data"))

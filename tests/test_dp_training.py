@@ -216,3 +216,47 @@ def test_metric_average(dp_mesh):
                            out_specs=P(), check_vma=False)
     out = jax.jit(mapped)(vals)
     np.testing.assert_allclose(float(out), 3.5)
+
+
+def test_stateful_train_step_threads_batch_stats(dp_mesh):
+    """BatchNorm running stats update each step and stay replicated
+    (make_stateful_train_step)."""
+    import flax.linen as nn
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Dense(8)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            return nn.Dense(3)(x)
+
+    model = TinyBN()
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 4)), train=False)
+    params, bstats = variables["params"], variables["batch_stats"]
+    opt = optax.sgd(0.1)
+
+    def loss_fn(params, model_state, batch, rng):
+        logits, new_state = model.apply(
+            {"params": params, "batch_stats": model_state}, batch["x"],
+            train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+        return loss, (new_state["batch_stats"], {})
+
+    step = dp.make_stateful_train_step(loss_fn, opt, dp_mesh, donate=False)
+    rs = np.random.RandomState(0)
+    batch = {"x": dp.shard_batch(jnp.asarray(rs.rand(16, 4), jnp.float32),
+                                 dp_mesh),
+             "y": dp.shard_batch(jnp.asarray(rs.randint(0, 3, 16)), dp_mesh)}
+    p = dp.replicate(params, dp_mesh)
+    s = dp.replicate(opt.init(params), dp_mesh)
+    b = dp.replicate(bstats, dp_mesh)
+    prev = jax.tree_util.tree_map(np.asarray, bstats)
+    for i in range(3):
+        out = step(p, s, b, batch, jax.random.key(i))
+        p, s, b = out.params, out.opt_state, out.model_state
+    cur = jax.tree_util.tree_map(np.asarray, b)
+    moved = jax.tree_util.tree_map(
+        lambda a, bb: not np.allclose(a, bb), prev, cur)
+    assert any(jax.tree_util.tree_leaves(moved)), "batch stats never updated"
+    assert np.isfinite(float(out.loss))

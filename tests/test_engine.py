@@ -1,0 +1,289 @@
+"""Native engine protocol tests over the in-process loopback transport.
+
+Reference analog: the coordination semantics asserted across
+test/parallel/test_torch.py (error handling for mismatched shapes/types,
+out-of-order submission safety, join) — here exercised with N engine ranks
+inside one process, which the reference cannot do (it needs mpirun).
+"""
+
+import json
+import os
+import threading
+import time
+import uuid
+
+import pytest
+
+from horovod_tpu.engine import (
+    OP_ALLGATHER, OP_ALLREDUCE, OP_BARRIER, OP_BROADCAST,
+    EngineSession,
+)
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+N = 4
+
+
+def make_group(n=N, **kwargs):
+    """N loopback engine sessions sharing a fresh hub."""
+    group = f"test-{uuid.uuid4().hex[:8]}"
+    kwargs.setdefault("cycle_time_ms", 1.0)
+    kwargs.setdefault("stall_warning_sec", 60.0)
+    sessions = [
+        EngineSession(rank=r, size=n, transport="loopback", group=group,
+                      **kwargs)
+        for r in range(n)
+    ]
+    return sessions
+
+
+def destroy_all(sessions):
+    # Request shutdown on all ranks first (the shutdown flag must be
+    # OR-reduced in a cycle all ranks still run), then destroy.
+    for s in sessions:
+        s._lib.hvdtpu_shutdown(s._session)
+    for s in sessions:
+        s.destroy()
+
+
+@pytest.fixture
+def group():
+    sessions = make_group()
+    yield sessions
+    destroy_all(sessions)
+
+
+def test_basic_allreduce_negotiation(group):
+    handles = [s.enqueue("t0", OP_ALLREDUCE, "float32", [4, 4])
+               for s in group]
+    for s, h in zip(group, handles):
+        s.wait(h, timeout=10.0)
+
+
+def test_out_of_order_submission(group):
+    """Ranks submit tensors in different orders; negotiation establishes a
+    consistent global order (the reference's central invariant,
+    operations.cc:336-355)."""
+    names = [f"ooo{i}" for i in range(6)]
+
+    def submit(s, order):
+        hs = {}
+        for i in order:
+            hs[i] = s.enqueue(names[i], OP_ALLREDUCE, "float32", [8])
+        return hs
+
+    all_handles = []
+    for r, s in enumerate(group):
+        order = list(range(6))
+        # rotate per rank → different submission orders
+        order = order[r:] + order[:r]
+        all_handles.append(submit(s, order))
+    for s, hs in zip(group, all_handles):
+        for h in hs.values():
+            s.wait(h, timeout=10.0)
+
+
+def test_shape_mismatch_rejected(group):
+    handles = []
+    for r, s in enumerate(group):
+        shape = [4, 4] if r != 2 else [5, 4]
+        handles.append(s.enqueue("bad", OP_ALLREDUCE, "float32", shape))
+    for s, h in zip(group, handles):
+        with pytest.raises(HorovodInternalError, match="[Mm]ismatch"):
+            s.wait(h, timeout=10.0)
+
+
+def test_dtype_mismatch_rejected(group):
+    handles = []
+    for r, s in enumerate(group):
+        dtype = "float32" if r != 1 else "int32"
+        handles.append(s.enqueue("baddtype", OP_ALLREDUCE, dtype, [4]))
+    for s, h in zip(group, handles):
+        with pytest.raises(HorovodInternalError, match="[Mm]ismatch"):
+            s.wait(h, timeout=10.0)
+
+
+def test_duplicate_name_rejected(group):
+    s0 = group[0]
+    s0.enqueue("dup", OP_ALLREDUCE, "float32", [4])
+    with pytest.raises(HorovodInternalError, match="same name"):
+        s0.enqueue("dup", OP_ALLREDUCE, "float32", [4])
+    # Unblock the first: everyone else submits it too.
+    for s in group[1:]:
+        s.enqueue("dup", OP_ALLREDUCE, "float32", [4])
+    # drain
+    time.sleep(0.2)
+
+
+def test_cache_fast_path_steady_state(group):
+    """Same tensor re-negotiated many times: after the first slow-path
+    round, completion should ride the cache bit vector."""
+    for it in range(20):
+        handles = [s.enqueue("steady", OP_ALLREDUCE, "float32", [16])
+                   for s in group]
+        for s, h in zip(group, handles):
+            s.wait(h, timeout=10.0)
+
+
+def test_allgather_sizes(group):
+    """Per-rank first dims propagate in the response (reference:
+    controller.cc:576-648)."""
+    seen = {}
+    lock = threading.Lock()
+
+    def make_cb(rank):
+        def cb(resp):
+            with lock:
+                if resp["type"] == "ALLGATHER":
+                    seen[rank] = resp["sizes"]
+            return 0
+        return cb
+
+    for r, s in enumerate(group):
+        s.set_execute_callback(make_cb(r))
+    handles = [s.enqueue("ag", OP_ALLGATHER, "float32", [r + 1, 3])
+               for r, s in enumerate(group)]
+    for s, h in zip(group, handles):
+        s.wait(h, timeout=10.0)
+    for r in range(N):
+        assert seen[r] == [1, 2, 3, 4], seen
+
+
+def test_broadcast_root_mismatch_rejected(group):
+    handles = []
+    for r, s in enumerate(group):
+        root = 0 if r != 3 else 1
+        handles.append(s.enqueue("bcast", OP_BROADCAST, "float32", [4],
+                                 root_rank=root))
+    for s, h in zip(group, handles):
+        with pytest.raises(HorovodInternalError, match="root"):
+            s.wait(h, timeout=10.0)
+
+
+def test_fusion_batches_small_tensors():
+    """Many small same-param tensors submitted together arrive at the data
+    plane as fused responses (reference: FuseResponses,
+    controller.cc:777-914)."""
+    sessions = make_group(cycle_time_ms=50.0)
+    try:
+        fused_counts = []
+        lock = threading.Lock()
+
+        def cb(resp):
+            with lock:
+                fused_counts.append(len(resp["names"]))
+            return 0
+
+        sessions[0].set_execute_callback(cb)
+        n_tensors = 8
+        all_handles = []
+        for s in sessions:
+            hs = [s.enqueue(f"fuse{i}", OP_ALLREDUCE, "float32", [4])
+                  for i in range(n_tensors)]
+            all_handles.append(hs)
+        for s, hs in zip(sessions, all_handles):
+            for h in hs:
+                s.wait(h, timeout=10.0)
+        assert max(fused_counts) > 1, (
+            f"expected fusion to batch tensors, saw counts {fused_counts}")
+        assert sum(fused_counts) == n_tensors
+    finally:
+        destroy_all(sessions)
+
+
+def test_join_with_uneven_work(group):
+    """Rank 3 joins early; remaining ranks' allreduce completes with the
+    joined rank substituting zeros (reference: operations.cc:1166-1190,
+    controller.cc:254-308)."""
+    join_resp = {}
+
+    def cb3(resp):
+        join_resp.setdefault("responses", []).append(resp)
+        return 0
+
+    group[3].set_execute_callback(cb3)
+    join_handle = group[3].join()
+    handles = [s.enqueue("uneven", OP_ALLREDUCE, "float32", [4])
+               for s in group[:3]]
+    for s, h in zip(group[:3], handles):
+        s.wait(h, timeout=10.0)
+    # Now everyone else joins → join completes on all ranks.
+    other_joins = [s.join() for s in group[:3]]
+    group[3].wait(join_handle, timeout=10.0)
+    for s, h in zip(group[:3], other_joins):
+        s.wait(h, timeout=10.0)
+    # The joined rank was told to participate (zero-substitution) in the
+    # allreduce it never enqueued.
+    types = [r["type"] for r in join_resp.get("responses", [])]
+    assert "ALLREDUCE" in types, types
+
+
+def test_grouped_allreduce_atomic(group):
+    """Group members complete together even when submitted across cycles."""
+    gid = 7
+    all_handles = []
+    for s in group:
+        hs = [s.enqueue(f"grp{i}", OP_ALLREDUCE, "float32", [4],
+                        group_id=gid, group_size=3) for i in range(3)]
+        all_handles.append(hs)
+    for s, hs in zip(group, all_handles):
+        for h in hs:
+            s.wait(h, timeout=10.0)
+
+
+def test_barrier(group):
+    handles = [s.enqueue("bar", OP_BARRIER, "uint8", [])
+               for s in group]
+    for s, h in zip(group, handles):
+        s.wait(h, timeout=10.0)
+
+
+def test_timeline_writes_chrome_trace(tmp_path):
+    sessions = make_group()
+    try:
+        path = str(tmp_path / "timeline.json")
+        sessions[0].start_timeline(path)
+        handles = [s.enqueue("tl", OP_ALLREDUCE, "float32", [4])
+                   for s in sessions]
+        for s, h in zip(sessions, handles):
+            s.wait(h, timeout=10.0)
+        time.sleep(0.1)
+        sessions[0].stop_timeline()
+        events = json.load(open(path))
+        assert any(e.get("name", "").startswith("NEGOTIATE_") for e in events)
+        assert any(e.get("name", "").startswith("EXEC_") for e in events)
+    finally:
+        destroy_all(sessions)
+
+
+def test_shutdown_fails_pending(group):
+    # Only rank 0 submits → never completes; shutdown must fail the handle.
+    h = group[0].enqueue("orphan", OP_ALLREDUCE, "float32", [4])
+    for s in group:
+        s._lib.hvdtpu_shutdown(s._session)
+    with pytest.raises(HorovodInternalError, match="shut down"):
+        group[0].wait(h, timeout=10.0)
+
+
+def test_data_plane_failure_propagates(group):
+    def failing_cb(resp):
+        return 3
+
+    for s in group:
+        s.set_execute_callback(failing_cb)
+    handles = [s.enqueue("dperr", OP_ALLREDUCE, "float32", [4])
+               for s in group]
+    for s, h in zip(group, handles):
+        with pytest.raises(HorovodInternalError, match="data plane"):
+            s.wait(h, timeout=10.0)
+
+
+def test_stall_inspector_warns(capfd):
+    sessions = make_group(stall_warning_sec=0.2)
+    try:
+        sessions[0].enqueue("stalled", OP_ALLREDUCE, "float32", [4])
+        time.sleep(0.8)
+        err = capfd.readouterr().err
+        assert "stalled" in err.lower() or "waiting" in err.lower(), err
+        assert all(s.healthy for s in sessions)
+    finally:
+        destroy_all(sessions)

@@ -1,0 +1,81 @@
+"""Multi-process engine coordination over the TCP transport — the analog of
+the reference's real-multi-process parallel tests (SURVEY §4: multiple
+processes on one machine, env-var rank injection)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    from horovod_tpu.engine import EngineSession, OP_ALLREDUCE, OP_ALLGATHER
+    from horovod_tpu.common.exceptions import HorovodInternalError
+
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    port = int(os.environ["HOROVOD_CONTROLLER_PORT"])
+    s = EngineSession(rank=rank, size=size, transport="tcp",
+                      addr="127.0.0.1", port=port, timeout_sec=20.0)
+    seen = []
+    s.set_execute_callback(lambda r: (seen.append(r), 0)[1])
+
+    # out-of-order submission across processes
+    names = [f"t{{i}}" for i in range(4)]
+    order = names[rank:] + names[:rank]
+    handles = [s.enqueue(n, OP_ALLREDUCE, "float32", [8]) for n in order]
+    for h in handles:
+        s.wait(h, timeout=20.0)
+
+    # allgather with per-rank sizes
+    h = s.enqueue("ag", OP_ALLGATHER, "float32", [rank + 1, 2])
+    s.wait(h, timeout=20.0)
+    sizes = [r["sizes"] for r in seen if r["type"] == "ALLGATHER"]
+    assert sizes and sizes[0] == [1, 2, 3], sizes
+
+    # mismatch detection across processes
+    shape = [4] if rank != 1 else [5]
+    h = s.enqueue("bad", OP_ALLREDUCE, "float32", shape)
+    try:
+        s.wait(h, timeout=20.0)
+        raise AssertionError("mismatch not detected")
+    except HorovodInternalError as e:
+        assert "ismatch" in str(e), e
+
+    s.shutdown()
+    print(f"worker {{rank}} OK")
+""")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_tcp_three_process_coordination(tmp_path):
+    size = 3
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO))
+    procs = []
+    for r in range(size):
+        env = dict(os.environ,
+                   HOROVOD_RANK=str(r), HOROVOD_SIZE=str(size),
+                   HOROVOD_CONTROLLER_PORT=str(port))
+        procs.append(subprocess.Popen([sys.executable, str(script)], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=90)
+        outs.append(out.decode())
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"worker {r} OK" in out
