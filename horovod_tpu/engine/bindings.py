@@ -73,6 +73,7 @@ def load_library():
         lib.hvdtpu_create_session.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_int32,
             ctypes.c_double, ctypes.c_double, ctypes.c_int64,
             ctypes.c_uint32, ctypes.c_int32, ctypes.c_double,
             ctypes.c_double, ctypes.c_int32, ctypes.c_char_p,
@@ -109,6 +110,26 @@ def load_library():
                                               ctypes.c_int32]
         lib.hvdtpu_stop_timeline.argtypes = [ctypes.c_int64]
         lib.hvdtpu_last_error.restype = ctypes.c_char_p
+        # data plane (callback-thread only)
+        lib.hvdtpu_data_allreduce.restype = ctypes.c_int32
+        lib.hvdtpu_data_allreduce.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_double]
+        lib.hvdtpu_data_allgatherv.restype = ctypes.c_int64
+        lib.hvdtpu_data_allgatherv.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.hvdtpu_data_bcast.restype = ctypes.c_int32
+        lib.hvdtpu_data_bcast.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
+        lib.hvdtpu_data_alltoallv.restype = ctypes.c_int64
+        lib.hvdtpu_data_alltoallv.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.hvdtpu_data_fetch.restype = ctypes.c_int32
+        lib.hvdtpu_data_fetch.argtypes = [ctypes.c_int64, ctypes.c_void_p,
+                                          ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -135,6 +156,7 @@ class EngineSession:
                  group: str = "default",
                  addr: Optional[str] = None,
                  port: Optional[int] = None,
+                 data_port: Optional[int] = None,
                  cycle_time_ms: Optional[float] = None,
                  fusion_threshold: Optional[int] = None,
                  cache_capacity: Optional[int] = None,
@@ -145,6 +167,12 @@ class EngineSession:
         addr = addr or os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
         port = port if port is not None else \
             _env_int("HOROVOD_CONTROLLER_PORT", 0)
+        if transport == "tcp" and port <= 0:
+            raise ValueError(
+                "tcp transport needs HOROVOD_CONTROLLER_PORT (the launcher "
+                "exports it; set it manually for hand-rolled runs)")
+        data_port = data_port if data_port is not None else \
+            _env_int("HOROVOD_CONTROLLER_DATA_PORT", 0)
         cycle_time_ms = cycle_time_ms if cycle_time_ms is not None else \
             _env_float("HOROVOD_CYCLE_TIME", 1.0)
         fusion_threshold = fusion_threshold if fusion_threshold is not None \
@@ -167,7 +195,7 @@ class EngineSession:
             rank, size, local_rank, local_size,
             transport.encode(),
             (group if transport == "loopback" else addr).encode(),
-            port, timeout_sec, cycle_time_ms, fusion_threshold,
+            port, data_port, timeout_sec, cycle_time_ms, fusion_threshold,
             cache_capacity, 1 if cache_capacity > 0 else 0,
             stall_warning_sec, stall_shutdown_sec,
             1 if stall_disable else 0,

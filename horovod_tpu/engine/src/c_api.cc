@@ -40,6 +40,7 @@ extern "C" {
 int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,
                               int32_t local_size, const char* transport_kind,
                               const char* group_or_addr, int32_t port,
+                              int32_t data_port,
                               double timeout_sec, double cycle_time_ms,
                               int64_t fusion_threshold_bytes,
                               uint32_t cache_capacity,
@@ -68,6 +69,7 @@ int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,
     tcfg.addr = group_or_addr ? group_or_addr : "127.0.0.1";
   }
   tcfg.port = port;
+  tcfg.data_port = data_port;
   tcfg.timeout_sec = timeout_sec;
 
   auto engine = std::make_unique<Engine>(rank, size, local_rank, local_size,
@@ -231,5 +233,77 @@ int32_t hvdtpu_stop_timeline(int64_t session) {
 }
 
 const char* hvdtpu_last_error() { return g_last_error.c_str(); }
+
+// --- data plane (callback-thread only; see Engine::data_plane) -----------
+
+namespace {
+thread_local std::string g_scratch;
+}
+
+int32_t hvdtpu_data_allreduce(int64_t session, void* buffer,
+                              int64_t num_elements, int32_t dtype,
+                              int32_t kind, double prescale,
+                              double postscale) {
+  Engine* e = GetSession(session);
+  if (!e || !e->data_plane()) return -1;
+  auto st = e->data_plane()->Allreduce(
+      buffer, num_elements, static_cast<DataType>(dtype),
+      static_cast<ReduceKind>(kind), prescale, postscale);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return static_cast<int32_t>(st.type);
+  }
+  return 0;
+}
+
+// Gathers variable-size blobs; per-rank byte counts written to rank_bytes
+// (length = size). Total bytes returned; fetch with hvdtpu_data_fetch.
+int64_t hvdtpu_data_allgatherv(int64_t session, const void* in,
+                               int64_t in_bytes, int64_t* rank_bytes) {
+  Engine* e = GetSession(session);
+  if (!e || !e->data_plane()) return -1;
+  std::vector<int64_t> sizes;
+  auto st = e->data_plane()->Allgatherv(in, in_bytes, &g_scratch, &sizes);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return -1;
+  }
+  for (size_t r = 0; r < sizes.size(); ++r) rank_bytes[r] = sizes[r];
+  return static_cast<int64_t>(g_scratch.size());
+}
+
+int32_t hvdtpu_data_bcast(int64_t session, void* buffer, int64_t nbytes,
+                          int32_t root) {
+  Engine* e = GetSession(session);
+  if (!e || !e->data_plane()) return -1;
+  auto st = e->data_plane()->Bcast(buffer, nbytes, root);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return static_cast<int32_t>(st.type);
+  }
+  return 0;
+}
+
+int64_t hvdtpu_data_alltoallv(int64_t session, const void* in,
+                              const int64_t* send_bytes, int32_t nsend,
+                              int64_t* recv_bytes) {
+  Engine* e = GetSession(session);
+  if (!e || !e->data_plane()) return -1;
+  std::vector<int64_t> sends(send_bytes, send_bytes + nsend);
+  std::vector<int64_t> recvs;
+  auto st = e->data_plane()->Alltoallv(in, sends, &g_scratch, &recvs);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return -1;
+  }
+  for (size_t r = 0; r < recvs.size(); ++r) recv_bytes[r] = recvs[r];
+  return static_cast<int64_t>(g_scratch.size());
+}
+
+int32_t hvdtpu_data_fetch(int64_t session, void* dst, int64_t nbytes) {
+  if (static_cast<size_t>(nbytes) > g_scratch.size()) return -1;
+  std::memcpy(dst, g_scratch.data(), nbytes);
+  return 0;
+}
 
 }  // extern "C"

@@ -1,0 +1,396 @@
+#include "data_plane.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+#include "half.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+namespace {
+
+template <typename T>
+void CombineTyped(T* acc, const T* src, int64_t n, ReduceKind kind) {
+  switch (kind) {
+    case ReduceKind::SUM:
+    case ReduceKind::AVERAGE:
+      for (int64_t i = 0; i < n; ++i) acc[i] += src[i];
+      break;
+    case ReduceKind::MIN:
+      for (int64_t i = 0; i < n; ++i) acc[i] = std::min(acc[i], src[i]);
+      break;
+    case ReduceKind::MAX:
+      for (int64_t i = 0; i < n; ++i) acc[i] = std::max(acc[i], src[i]);
+      break;
+    case ReduceKind::PRODUCT:
+      for (int64_t i = 0; i < n; ++i) acc[i] *= src[i];
+      break;
+    case ReduceKind::ADASUM:
+      break;  // handled separately
+  }
+}
+
+void CombineHalf(uint16_t* acc, const uint16_t* src, int64_t n,
+                 ReduceKind kind, bool bf16) {
+  auto to_f = bf16 ? Bfloat16ToFloat : HalfToFloat;
+  auto from_f = bf16 ? FloatToBfloat16 : FloatToHalf;
+  for (int64_t i = 0; i < n; ++i) {
+    float a = to_f(acc[i]);
+    float b = to_f(src[i]);
+    float r = a;
+    switch (kind) {
+      case ReduceKind::SUM:
+      case ReduceKind::AVERAGE: r = a + b; break;
+      case ReduceKind::MIN: r = std::min(a, b); break;
+      case ReduceKind::MAX: r = std::max(a, b); break;
+      case ReduceKind::PRODUCT: r = a * b; break;
+      case ReduceKind::ADASUM: break;
+    }
+    acc[i] = from_f(r);
+  }
+}
+
+void Combine(void* acc, const void* src, int64_t n, DataType dtype,
+             ReduceKind kind) {
+  switch (dtype) {
+    case DataType::FLOAT32:
+      CombineTyped(static_cast<float*>(acc),
+                   static_cast<const float*>(src), n, kind);
+      break;
+    case DataType::FLOAT64:
+      CombineTyped(static_cast<double*>(acc),
+                   static_cast<const double*>(src), n, kind);
+      break;
+    case DataType::INT32:
+      CombineTyped(static_cast<int32_t*>(acc),
+                   static_cast<const int32_t*>(src), n, kind);
+      break;
+    case DataType::INT64:
+      CombineTyped(static_cast<int64_t*>(acc),
+                   static_cast<const int64_t*>(src), n, kind);
+      break;
+    case DataType::UINT8:
+      CombineTyped(static_cast<uint8_t*>(acc),
+                   static_cast<const uint8_t*>(src), n, kind);
+      break;
+    case DataType::INT8:
+      CombineTyped(static_cast<int8_t*>(acc),
+                   static_cast<const int8_t*>(src), n, kind);
+      break;
+    case DataType::UINT16:
+      CombineTyped(static_cast<uint16_t*>(acc),
+                   static_cast<const uint16_t*>(src), n, kind);
+      break;
+    case DataType::INT16:
+      CombineTyped(static_cast<int16_t*>(acc),
+                   static_cast<const int16_t*>(src), n, kind);
+      break;
+    case DataType::FLOAT16:
+      CombineHalf(static_cast<uint16_t*>(acc),
+                  static_cast<const uint16_t*>(src), n, kind, false);
+      break;
+    case DataType::BFLOAT16:
+      CombineHalf(static_cast<uint16_t*>(acc),
+                  static_cast<const uint16_t*>(src), n, kind, true);
+      break;
+    case DataType::BOOL:
+      // logical OR for sum-like, AND for min/product
+      CombineTyped(static_cast<uint8_t*>(acc),
+                   static_cast<const uint8_t*>(src), n, kind);
+      break;
+  }
+}
+
+// Convert any float dtype to a double working vector (Adasum + scaling).
+void ToDouble(const void* src, int64_t n, DataType dtype, double* out) {
+  switch (dtype) {
+    case DataType::FLOAT32: {
+      auto* p = static_cast<const float*>(src);
+      for (int64_t i = 0; i < n; ++i) out[i] = p[i];
+      break;
+    }
+    case DataType::FLOAT64:
+      std::memcpy(out, src, n * sizeof(double));
+      break;
+    case DataType::FLOAT16: {
+      auto* p = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < n; ++i) out[i] = HalfToFloat(p[i]);
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* p = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < n; ++i) out[i] = Bfloat16ToFloat(p[i]);
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+void FromDouble(const double* src, int64_t n, DataType dtype, void* out) {
+  switch (dtype) {
+    case DataType::FLOAT32: {
+      auto* p = static_cast<float*>(out);
+      for (int64_t i = 0; i < n; ++i) p[i] = static_cast<float>(src[i]);
+      break;
+    }
+    case DataType::FLOAT64:
+      std::memcpy(out, src, n * sizeof(double));
+      break;
+    case DataType::FLOAT16: {
+      auto* p = static_cast<uint16_t*>(out);
+      for (int64_t i = 0; i < n; ++i) {
+        p[i] = FloatToHalf(static_cast<float>(src[i]));
+      }
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* p = static_cast<uint16_t*>(out);
+      for (int64_t i = 0; i < n; ++i) {
+        p[i] = FloatToBfloat16(static_cast<float>(src[i]));
+      }
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+bool IsFloatType(DataType dtype) {
+  return dtype == DataType::FLOAT16 || dtype == DataType::BFLOAT16 ||
+         dtype == DataType::FLOAT32 || dtype == DataType::FLOAT64;
+}
+
+template <typename T>
+void ScaleTyped(T* p, int64_t n, double factor) {
+  for (int64_t i = 0; i < n; ++i) {
+    p[i] = static_cast<T>(p[i] * factor);
+  }
+}
+
+void ScaleBuffer(void* buf, int64_t n, DataType dtype, double factor) {
+  if (factor == 1.0) return;
+  if (IsFloatType(dtype)) {
+    std::vector<double> tmp(n);
+    ToDouble(buf, n, dtype, tmp.data());
+    for (auto& v : tmp) v *= factor;
+    FromDouble(tmp.data(), n, dtype, buf);
+    return;
+  }
+  switch (dtype) {
+    case DataType::INT32:
+      ScaleTyped(static_cast<int32_t*>(buf), n, factor);
+      break;
+    case DataType::INT64:
+      ScaleTyped(static_cast<int64_t*>(buf), n, factor);
+      break;
+    case DataType::INT16:
+      ScaleTyped(static_cast<int16_t*>(buf), n, factor);
+      break;
+    case DataType::UINT16:
+      ScaleTyped(static_cast<uint16_t*>(buf), n, factor);
+      break;
+    case DataType::INT8:
+      ScaleTyped(static_cast<int8_t*>(buf), n, factor);
+      break;
+    case DataType::UINT8:
+    case DataType::BOOL:
+      ScaleTyped(static_cast<uint8_t*>(buf), n, factor);
+      break;
+    default:
+      break;
+  }
+}
+
+// Pairwise Adasum combine over double vectors
+// (reference math: adasum.h — a' = (1 - a.b/2||a||²)a + (1 - a.b/2||b||²)b).
+void AdasumPair(std::vector<double>& a, const std::vector<double>& b) {
+  double dot = 0, na = 0, nb = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    dot += a[i] * b[i];
+    na += a[i] * a[i];
+    nb += b[i] * b[i];
+  }
+  double ac = na == 0 ? 1.0 : 1.0 - dot / (2.0 * na);
+  double bc = nb == 0 ? 1.0 : 1.0 - dot / (2.0 * nb);
+  for (size_t i = 0; i < a.size(); ++i) a[i] = ac * a[i] + bc * b[i];
+}
+
+}  // namespace
+
+Status DataPlane::Allreduce(void* buffer, int64_t num_elements,
+                            DataType dtype, ReduceKind kind, double prescale,
+                            double postscale) {
+  const int size = transport_->size();
+  const int64_t nbytes = num_elements * DataTypeSize(dtype);
+  if (kind == ReduceKind::ADASUM && !IsFloatType(dtype)) {
+    return Status::InvalidArgument(
+        "Adasum requires a floating-point dtype, got " +
+        std::string(DataTypeName(dtype)));
+  }
+  if (prescale != 1.0) ScaleBuffer(buffer, num_elements, dtype, prescale);
+  if (size > 1) {
+    std::string mine(static_cast<const char*>(buffer), nbytes);
+    std::vector<std::string> all;
+    auto st = transport_->Gather(mine, transport_->rank() == 0 ? &all
+                                                               : nullptr);
+    if (!st.ok()) return st;
+    std::string result;
+    if (transport_->rank() == 0) {
+      if (kind == ReduceKind::ADASUM && IsFloatType(dtype)) {
+        // Binary-tree pairwise combine — the same reduction tree VHDD
+        // produces (level l pairs r with r^2^l).
+        std::vector<std::vector<double>> vecs(size);
+        for (int r = 0; r < size; ++r) {
+          vecs[r].resize(num_elements);
+          ToDouble(all[r].data(), num_elements, dtype, vecs[r].data());
+        }
+        for (int level = 1; level < size; level <<= 1) {
+          for (int r = 0; r + level < size; r += 2 * level) {
+            AdasumPair(vecs[r], vecs[r + level]);
+          }
+        }
+        result.resize(nbytes);
+        FromDouble(vecs[0].data(), num_elements, dtype, result.data());
+      } else {
+        result = all[0];
+        for (int r = 1; r < size; ++r) {
+          Combine(result.data(), all[r].data(), num_elements, dtype, kind);
+        }
+      }
+    }
+    st = transport_->Bcast(&result);
+    if (!st.ok()) return st;
+    std::memcpy(buffer, result.data(), nbytes);
+  }
+  if (kind == ReduceKind::AVERAGE) {
+    ScaleBuffer(buffer, num_elements, dtype, 1.0 / size);
+  }
+  if (postscale != 1.0) ScaleBuffer(buffer, num_elements, dtype, postscale);
+  return Status::OK();
+}
+
+Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
+                             std::string* out,
+                             std::vector<int64_t>* rank_bytes) {
+  std::string mine(static_cast<const char*>(in), in_bytes);
+  std::vector<std::string> all;
+  auto st = transport_->Gather(mine, transport_->rank() == 0 ? &all
+                                                             : nullptr);
+  if (!st.ok()) return st;
+  std::string packed;
+  if (transport_->rank() == 0) {
+    // [u32 count][i64 sizes...][data...]
+    uint32_t count = static_cast<uint32_t>(all.size());
+    packed.append(reinterpret_cast<const char*>(&count), sizeof(count));
+    for (auto& p : all) {
+      int64_t sz = static_cast<int64_t>(p.size());
+      packed.append(reinterpret_cast<const char*>(&sz), sizeof(sz));
+    }
+    for (auto& p : all) packed.append(p);
+  }
+  st = transport_->Bcast(&packed);
+  if (!st.ok()) return st;
+  uint32_t count = 0;
+  std::memcpy(&count, packed.data(), sizeof(count));
+  rank_bytes->resize(count);
+  size_t off = sizeof(count);
+  int64_t total = 0;
+  for (uint32_t r = 0; r < count; ++r) {
+    std::memcpy(&(*rank_bytes)[r], packed.data() + off, sizeof(int64_t));
+    off += sizeof(int64_t);
+    total += (*rank_bytes)[r];
+  }
+  out->assign(packed.data() + off, total);
+  return Status::OK();
+}
+
+Status DataPlane::Bcast(void* buffer, int64_t nbytes, int32_t root) {
+  // Star topology with rank-0 hub: non-zero roots relay through rank 0.
+  const int rank = transport_->rank();
+  if (root != 0) {
+    std::string mine;
+    if (rank == root) {
+      mine.assign(static_cast<const char*>(buffer), nbytes);
+    }
+    std::vector<std::string> all;
+    auto st = transport_->Gather(mine, rank == 0 ? &all : nullptr);
+    if (!st.ok()) return st;
+    std::string payload;
+    if (rank == 0) payload = all[root];
+    st = transport_->Bcast(&payload);
+    if (!st.ok()) return st;
+    std::memcpy(buffer, payload.data(),
+                std::min<int64_t>(nbytes, payload.size()));
+    return Status::OK();
+  }
+  std::string payload;
+  if (rank == 0) payload.assign(static_cast<const char*>(buffer), nbytes);
+  auto st = transport_->Bcast(&payload);
+  if (!st.ok()) return st;
+  if (rank != 0) {
+    std::memcpy(buffer, payload.data(),
+                std::min<int64_t>(nbytes, payload.size()));
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Alltoallv(const void* in,
+                            const std::vector<int64_t>& send_bytes,
+                            std::string* out,
+                            std::vector<int64_t>* recv_bytes) {
+  const int size = transport_->size();
+  const int rank = transport_->rank();
+  // Pack [i64 sizes...][data] and gather at root; root reshuffles and
+  // scatters each rank its incoming chunks in source-rank order.
+  std::string mine;
+  for (int64_t sz : send_bytes) {
+    mine.append(reinterpret_cast<const char*>(&sz), sizeof(sz));
+  }
+  int64_t total = 0;
+  for (int64_t sz : send_bytes) total += sz;
+  mine.append(static_cast<const char*>(in), total);
+
+  std::vector<std::string> all;
+  auto st = transport_->Gather(mine, rank == 0 ? &all : nullptr);
+  if (!st.ok()) return st;
+
+  std::vector<std::string> outgoing;
+  if (rank == 0) {
+    // per source rank: sizes + chunk offsets
+    std::vector<std::vector<int64_t>> sizes(size);
+    std::vector<size_t> data_off(size);
+    for (int src = 0; src < size; ++src) {
+      sizes[src].resize(size);
+      std::memcpy(sizes[src].data(), all[src].data(),
+                  size * sizeof(int64_t));
+      data_off[src] = size * sizeof(int64_t);
+    }
+    outgoing.resize(size);
+    for (int dst = 0; dst < size; ++dst) {
+      std::string& pkt = outgoing[dst];
+      for (int src = 0; src < size; ++src) {
+        pkt.append(reinterpret_cast<const char*>(&sizes[src][dst]),
+                   sizeof(int64_t));
+      }
+      for (int src = 0; src < size; ++src) {
+        size_t off = data_off[src];
+        for (int d = 0; d < dst; ++d) off += sizes[src][d];
+        pkt.append(all[src].data() + off, sizes[src][dst]);
+      }
+    }
+  }
+  std::string packet;
+  st = transport_->Scatter(rank == 0 ? &outgoing : nullptr, &packet);
+  if (!st.ok()) return st;
+  recv_bytes->resize(size);
+  std::memcpy(recv_bytes->data(), packet.data(), size * sizeof(int64_t));
+  out->assign(packet.data() + size * sizeof(int64_t),
+              packet.size() - size * sizeof(int64_t));
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,64 @@
+// Host (CPU) data plane for eager collectives.
+//
+// Reference analog: the CPU op implementations —
+// horovod/common/ops/mpi_operations.cc (MPI_Allreduce/Allgatherv/Bcast/
+// Alltoallv on host buffers) and gloo_operations.cc. The TPU framework's hot
+// path is in-XLA collectives over ICI; this plane serves the eager surface
+// (broadcast_object, metric averaging, optimizer-state sync, CPU-staged
+// tensors) the way the reference's MPI/Gloo CPU ops do.
+//
+// Topology: star via ControllerTransport (root combines, broadcasts).
+// Reduction math: typed kernels including fp16/bf16 accumulation (half.cc)
+// and a binary-tree Adasum (reference: adasum_mpi.cc VHDD — same pairwise
+// combination, tree order).
+
+#ifndef HVD_TPU_DATA_PLANE_H
+#define HVD_TPU_DATA_PLANE_H
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+enum class ReduceKind : int32_t {
+  SUM = 0,
+  AVERAGE = 1,  // sum then scale by 1/size
+  MIN = 2,
+  MAX = 3,
+  PRODUCT = 4,
+  ADASUM = 5,
+};
+
+class DataPlane {
+ public:
+  explicit DataPlane(std::shared_ptr<ControllerTransport> transport)
+      : transport_(std::move(transport)) {}
+
+  // In-place allreduce over num_elements of dtype.
+  Status Allreduce(void* buffer, int64_t num_elements, DataType dtype,
+                   ReduceKind kind, double prescale, double postscale);
+
+  // Gather per-rank byte blobs; every rank receives the concatenation in
+  // rank order (sizes may differ — the allgatherv analog).
+  Status Allgatherv(const void* in, int64_t in_bytes, std::string* out,
+                    std::vector<int64_t>* rank_bytes);
+
+  // Root's buffer replicated to all (in-place for non-roots).
+  Status Bcast(void* buffer, int64_t nbytes, int32_t root);
+
+  // Each rank sends send_splits[r] bytes to rank r from `in`; receives into
+  // out (concatenated by source rank), recv sizes returned.
+  Status Alltoallv(const void* in, const std::vector<int64_t>& send_bytes,
+                   std::string* out, std::vector<int64_t>* recv_bytes);
+
+ private:
+  std::shared_ptr<ControllerTransport> transport_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_DATA_PLANE_H

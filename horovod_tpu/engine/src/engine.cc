@@ -79,18 +79,32 @@ Engine::Engine(int rank, int size, int local_rank, int local_size,
 Engine::~Engine() { Finalize(); }
 
 Status Engine::Init() {
+  // Two channels: control (cycle negotiation) and data (eager host
+  // collectives), so data frames never interleave with cycle frames.
+  std::shared_ptr<ControllerTransport> data_transport;
   if (tcfg_.kind == "loopback") {
     auto hub = GetOrCreateLoopbackHub(tcfg_.group, size_);
     transport_ = std::make_shared<LoopbackTransport>(hub, rank_);
+    auto data_hub = GetOrCreateLoopbackHub(tcfg_.group + "/data", size_);
+    data_transport = std::make_shared<LoopbackTransport>(data_hub, rank_);
   } else if (tcfg_.kind == "tcp") {
     auto tcp = std::make_shared<TcpTransport>(rank_, size_, tcfg_.addr,
                                               tcfg_.port, tcfg_.timeout_sec);
     auto st = tcp->Init();
     if (!st.ok()) return st;
     transport_ = tcp;
+    // Data channel: explicit data_port if given, else port+1 (the launcher
+    // allocates both and exports HOROVOD_CONTROLLER_DATA_PORT).
+    int dport = tcfg_.data_port > 0 ? tcfg_.data_port : tcfg_.port + 1;
+    auto data_tcp = std::make_shared<TcpTransport>(
+        rank_, size_, tcfg_.addr, dport, tcfg_.timeout_sec);
+    st = data_tcp->Init();
+    if (!st.ok()) return st;
+    data_transport = data_tcp;
   } else {
     return Status::InvalidArgument("unknown transport: " + tcfg_.kind);
   }
+  data_plane_ = std::make_unique<DataPlane>(data_transport);
   if (!opts_.timeline_path.empty()) {
     timeline_.Initialize(opts_.timeline_path, opts_.timeline_mark_cycles);
   }

@@ -25,6 +25,7 @@
 
 #include "common.h"
 #include "controller.h"
+#include "data_plane.h"
 #include "message.h"
 #include "tensor_queue.h"
 #include "timeline.h"
@@ -65,6 +66,7 @@ struct TransportConfig {
   std::string group = "default";  // loopback hub name
   std::string addr = "127.0.0.1";
   int port = 0;
+  int data_port = 0;  // eager data channel; <=0 means port+1
   double timeout_sec = 30.0;
 };
 
@@ -97,6 +99,12 @@ class Engine {
   Timeline& timeline() { return timeline_; }
   Controller& controller() { return *controller_; }
 
+  // Host data plane. ONLY safe from within the execute callback (which runs
+  // on the background thread, in lockstep response order across ranks) —
+  // calling it from arbitrary threads would interleave with other ranks'
+  // response-ordered traffic.
+  DataPlane* data_plane() { return data_plane_.get(); }
+
  private:
   void BackgroundLoop();
   void BackgroundLoopImpl();
@@ -108,6 +116,7 @@ class Engine {
   TransportConfig tcfg_;
   std::shared_ptr<ControllerTransport> transport_;
   std::unique_ptr<Controller> controller_;
+  std::unique_ptr<DataPlane> data_plane_;
   TensorQueue queue_;
   HandleManager handles_;
   Timeline timeline_;

@@ -1,0 +1,40 @@
+// Half-precision (fp16 + bf16) conversion and accumulation.
+//
+// Reference analog: horovod/common/half.{h,cc} — fp16↔fp32 bit conversion
+// and vectorized CPU fp16 sum (AVX/F16C there; plain loops here, which the
+// compiler auto-vectorizes, plus bf16 which the reference lacks and a TPU
+// framework cannot ship without).
+
+#ifndef HVD_TPU_HALF_H
+#define HVD_TPU_HALF_H
+
+#include <cstdint>
+#include <cstddef>
+
+namespace hvdtpu {
+
+float HalfToFloat(uint16_t h);
+uint16_t FloatToHalf(float f);
+
+inline float Bfloat16ToFloat(uint16_t b) {
+  uint32_t bits = static_cast<uint32_t>(b) << 16;
+  float out;
+  __builtin_memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+inline uint16_t FloatToBfloat16(float f) {
+  uint32_t bits;
+  __builtin_memcpy(&bits, &f, sizeof(bits));
+  // round-to-nearest-even
+  uint32_t rounding_bias = 0x7fff + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding_bias) >> 16);
+}
+
+// dst += src over n elements, accumulating in fp32.
+void HalfSumInto(uint16_t* dst, const uint16_t* src, size_t n);
+void Bfloat16SumInto(uint16_t* dst, const uint16_t* src, size_t n);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_HALF_H

@@ -70,6 +70,21 @@ Status LoopbackTransport::Bcast(std::string* payload) {
   return hub_->aborted ? Status::Aborted("loopback hub aborted") : Status::OK();
 }
 
+Status LoopbackTransport::Scatter(const std::vector<std::string>* payloads,
+                                  std::string* mine) {
+  if (rank_ == 0) {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+    for (int r = 0; r < hub_->size; ++r) hub_->slots[r] = (*payloads)[r];
+  }
+  hub_->BarrierWait();
+  {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+    *mine = hub_->slots[rank_];
+  }
+  hub_->BarrierWait();
+  return hub_->aborted ? Status::Aborted("loopback hub aborted") : Status::OK();
+}
+
 Status LoopbackTransport::BitAllreduce(std::vector<uint64_t>* bits,
                                        bool is_and) {
   {
@@ -309,6 +324,19 @@ Status TcpTransport::Bcast(std::string* payload) {
     return Status::OK();
   }
   return RecvFrame(root_fd_, payload);
+}
+
+Status TcpTransport::Scatter(const std::vector<std::string>* payloads,
+                             std::string* mine) {
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; ++r) {
+      auto st = SendFrame(worker_fds_[r], (*payloads)[r]);
+      if (!st.ok()) return st;
+    }
+    *mine = (*payloads)[0];
+    return Status::OK();
+  }
+  return RecvFrame(root_fd_, mine);
 }
 
 Status TcpTransport::BitAllreduce(std::vector<uint64_t>* bits, bool is_and) {

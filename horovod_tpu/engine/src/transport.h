@@ -42,6 +42,10 @@ class ControllerTransport {
   // Root's payload is delivered to every rank.
   virtual Status Bcast(std::string* payload) = 0;
 
+  // Root delivers payloads[r] to rank r (inverse of Gather).
+  virtual Status Scatter(const std::vector<std::string>* payloads,
+                         std::string* mine) = 0;
+
   // Elementwise bitwise AND/OR across ranks (cache-coordination bit vectors,
   // reference: mpi_controller.cc:88-106).
   virtual Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) = 0;
@@ -79,6 +83,8 @@ class LoopbackTransport : public ControllerTransport {
   Status Gather(const std::string& mine,
                 std::vector<std::string>* out) override;
   Status Bcast(std::string* payload) override;
+  Status Scatter(const std::vector<std::string>* payloads,
+                 std::string* mine) override;
   Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) override;
   Status Barrier() override;
 
@@ -110,6 +116,8 @@ class TcpTransport : public ControllerTransport {
   Status Gather(const std::string& mine,
                 std::vector<std::string>* out) override;
   Status Bcast(std::string* payload) override;
+  Status Scatter(const std::vector<std::string>* payloads,
+                 std::string* mine) override;
   Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) override;
   Status Barrier() override;
 

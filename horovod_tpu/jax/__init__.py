@@ -24,9 +24,34 @@ from horovod_tpu.ops.fusion import fused_apply_tree
 from horovod_tpu.parallel import collectives
 from horovod_tpu.parallel.collectives import (  # noqa: F401
     Adasum, Average, Max, Min, Op, Product, Sum,
-    allgather, allreduce, alltoall, barrier, broadcast, grouped_allreduce,
     reducescatter,
 )
+# Smart-dispatch collective ops: in-jit tracers → XLA/ICI collectives;
+# concrete arrays → engine-coordinated eager path (reference surface:
+# horovod/torch/mpi_ops.py).
+from horovod_tpu.jax.mpi_ops import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    join,
+    poll,
+    synchronize,
+)
+from horovod_tpu.jax.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+)
+from horovod_tpu.jax.sync_batch_norm import SyncBatchNorm  # noqa: F401
+from horovod_tpu.jax import elastic  # noqa: F401
 from horovod_tpu.parallel.dp import (  # noqa: F401
     DP_AXES,
     make_eval_step,
@@ -149,12 +174,17 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
 
 def broadcast_parameters(params, root_rank: int = 0, axis=DP_AXES):
-    """In-program tree broadcast from ``root_rank`` (reference:
-    horovod/torch/functions.py:29-112 broadcast_parameters), fused per dtype
-    into single collectives. Use inside shard_map; for host-side state sync
-    across processes use broadcast_object (engine path)."""
-    return fused_apply_tree(
-        lambda v: collectives.broadcast(v, root_rank, axis), params)
+    """Tree broadcast from ``root_rank`` (reference:
+    horovod/torch/functions.py:29-112 broadcast_parameters).
+
+    Inside a trace: fused per-dtype XLA collectives over ``axis``. On
+    concrete values: the engine-coordinated eager path (cross-process)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    if leaves and isinstance(leaves[0], jax.core.Tracer):
+        return fused_apply_tree(
+            lambda v: collectives.broadcast(v, root_rank, axis), params)
+    from horovod_tpu.jax import functions
+    return functions.broadcast_parameters(params, root_rank)
 
 
 def metric_average(value, axis=DP_AXES):

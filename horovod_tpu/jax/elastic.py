@@ -1,0 +1,186 @@
+"""Elastic training state: commit / restore / sync + the retry loop.
+
+Reference analog: horovod/common/elastic.py (State :33-105, run wrapper
+:147-168) and horovod/torch/elastic/state.py (TorchState handlers). The
+semantics carried over exactly:
+
+- ``State.commit()``  — checkpoint in memory + check for pending host
+  updates (raises HostsUpdatedInterrupt at a safe point).
+- ``State.restore()`` — roll back to the last commit after a failure.
+- ``State.sync()``    — broadcast state from a rank that has it (rank 0)
+  after a re-initialization.
+- ``run(fn)``         — retry loop: HorovodInternalError → restore + reinit;
+  HostsUpdatedInterrupt → reinit, keep state.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+from typing import Any, Callable, Dict
+
+import jax
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+
+# Host-update notifications (pushed by the runner's worker notification
+# client, reference: runner/elastic/worker.py:84-110).
+_notification_queue: "queue.Queue[bool]" = queue.Queue()
+
+
+def notify_hosts_updated(skip_sync: bool = False):
+    _notification_queue.put(skip_sync)
+
+
+def _check_host_updates():
+    updated = False
+    skip_sync = True
+    while True:
+        try:
+            s = _notification_queue.get_nowait()
+            updated = True
+            skip_sync = skip_sync and s
+        except queue.Empty:
+            break
+    if updated:
+        raise HostsUpdatedInterrupt(skip_sync)
+
+
+class State:
+    """In-memory checkpoint of training state (reference:
+    common/elastic.py:33-105)."""
+
+    def __init__(self, **kwargs):
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._tracked = list(kwargs.keys())
+        self.commit_no_check()
+
+    def _capture(self) -> Dict[str, Any]:
+        out = {}
+        for k in self._tracked:
+            v = getattr(self, k)
+            if isinstance(v, (jax.Array,)):
+                out[k] = v  # immutable; keep the reference
+            elif _is_pytree_of_arrays(v):
+                out[k] = v
+            else:
+                out[k] = copy.deepcopy(v)
+        return out
+
+    def commit_no_check(self):
+        self._saved = self._capture()
+
+    def commit(self):
+        """Save + surface pending host updates (reference:
+        elastic.py:60-76)."""
+        self.commit_no_check()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        _check_host_updates()
+
+    def restore(self):
+        """Roll back to the last commit (reference: elastic.py:78-84)."""
+        for k, v in self._saved.items():
+            setattr(self, k, v)
+
+    def sync(self):
+        """Broadcast committed state from rank 0 (reference:
+        elastic.py:86-105 + torch/elastic/state.py handlers)."""
+        from horovod_tpu.jax import functions
+        if basics._context().engine is None:
+            return
+        for k in self._tracked:
+            v = getattr(self, k)
+            if isinstance(v, jax.Array) or _is_pytree_of_arrays(v):
+                setattr(self, k, functions.broadcast_parameters(v, 0))
+            else:
+                setattr(self, k, functions.broadcast_object(
+                    v, 0, name=f"elastic_state.{k}"))
+        self.commit_no_check()
+
+    def on_reset(self):
+        """Hook called after re-initialization (reference: State.on_reset)."""
+
+    def on_hosts_updated(self):
+        """Hook when a host-change notification arrives."""
+
+
+def _is_pytree_of_arrays(v) -> bool:
+    if isinstance(v, (dict, list, tuple)):
+        leaves = jax.tree_util.tree_leaves(v)
+        return bool(leaves) and all(
+            isinstance(x, (jax.Array,)) or hasattr(x, "shape")
+            for x in leaves)
+    return False
+
+
+def run(func: Callable) -> Callable:
+    """Elastic retry wrapper (reference: common/elastic.py:147-168).
+
+    ``func(state, *args, **kwargs)``; on HorovodInternalError the last
+    committed state is restored, the framework re-initialized, state
+    re-synced; on HostsUpdatedInterrupt training resumes with current state
+    after re-initialization.
+    """
+
+    def wrapper(state: State, *args, **kwargs):
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _reset()
+                state.on_reset()
+                if not skip_sync:
+                    state.sync()
+                reset_required = False
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                reset_required = True
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                reset_required = True
+                skip_sync = e.skip_sync
+
+    return wrapper
+
+
+def _reset():
+    """Shutdown + re-init (reference: torch/elastic/__init__.py:46+ —
+    shutdown, re-rendezvous, init). Topology env vars are re-read, so the
+    launcher can hand this process a new rank/size before unblocking it."""
+    ctx = basics._context()
+    was_elastic = ctx.elastic
+    basics.shutdown()
+    import os
+    if was_elastic and os.environ.get("HOROVOD_RENDEZVOUS_ADDR"):
+        _requery_rank_and_size()
+    basics.init()
+
+
+def _requery_rank_and_size():
+    """Re-fetch rank/size from the rendezvous KV (reference:
+    gloo_context.cc:154-200 querying HOROVOD_GLOO_GET_RANK_AND_SIZE)."""
+    import os
+    from horovod_tpu.runner.http_kv import KVClient
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+    client = KVClient(addr, port)
+    hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
+    local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    info = client.get_json(
+        f"rank_and_size/{hostname}/{local_rank}", timeout=60.0)
+    if info is None or info.get("removed"):
+        raise RuntimeError("host removed from elastic job")
+    for k in ("rank", "size", "local_rank", "local_size", "cross_rank",
+              "cross_size"):
+        if k in info:
+            os.environ[f"HOROVOD_{k.upper()}"] = str(info[k])

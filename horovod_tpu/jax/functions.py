@@ -1,0 +1,98 @@
+"""Parameter/object broadcast helpers.
+
+Reference analog: horovod/torch/functions.py —
+broadcast_parameters (:29-112), broadcast_optimizer_state (:113-185),
+broadcast_object (:186-228), allgather_object; built on the eager op surface
+so they work on concrete host/device values outside jit.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+import jax
+
+from horovod_tpu.jax import mpi_ops
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Broadcast a pytree of arrays from root to all ranks (reference:
+    functions.py:29-112 — the post-checkpoint/post-init consistency sync).
+
+    Async-submits every leaf then synchronizes, letting the engine pipeline
+    the transfers.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    handles = [mpi_ops.broadcast_async(leaf, root_rank,
+                                       name=f"bcast_params.{i}")
+               for i, leaf in enumerate(leaves)]
+    out = [mpi_ops.synchronize(h) for h in handles]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Broadcast optax optimizer state (reference: functions.py:113-185).
+    Array leaves broadcast as tensors; non-array leaves (step counts live as
+    arrays in optax; python scalars possible in custom states) ride a pickled
+    object broadcast."""
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    array_idx = [i for i, leaf in enumerate(leaves)
+                 if isinstance(leaf, (np.ndarray, jax.Array))]
+    other_idx = [i for i in range(len(leaves)) if i not in set(array_idx)]
+    arrays = broadcast_parameters([leaves[i] for i in array_idx], root_rank)
+    others = broadcast_object([leaves[i] for i in other_idx], root_rank,
+                              name="bcast_opt_state_py")
+    out = list(leaves)
+    for i, v in zip(array_idx, arrays):
+        out[i] = v
+    for i, v in zip(other_idx, others):
+        out[i] = v
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Pickle + broadcast an arbitrary python object (reference:
+    functions.py:186-228: size broadcast, then payload)."""
+    name = name or "broadcast_object"
+    from horovod_tpu.common import basics
+    if basics._context().engine is None:
+        return obj
+    if basics.rank() == root_rank:
+        buf = io.BytesIO()
+        pickle.dump(obj, buf)
+        payload = np.frombuffer(buf.getvalue(), np.uint8)
+    else:
+        payload = np.zeros(0, np.uint8)
+    sz = np.asarray([payload.size], np.int64)
+    sz = np.asarray(mpi_ops.broadcast(sz, root_rank, name=name + ".sz"))
+    if basics.rank() != root_rank:
+        payload = np.zeros(int(sz[0]), np.uint8)
+    data = np.asarray(mpi_ops.broadcast(payload, root_rank,
+                                        name=name + ".data"))
+    return pickle.loads(data.tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    """Gather one python object per rank (reference:
+    torch/functions.py allgather_object): pickled blobs ride the ragged
+    allgather, per-rank byte counts ride a fixed-size allgather."""
+    name = name or "allgather_object"
+    from horovod_tpu.common import basics
+    if basics._context().engine is None:
+        return [obj]
+    buf = io.BytesIO()
+    pickle.dump(obj, buf)
+    payload = np.frombuffer(buf.getvalue(), np.uint8)
+    sizes = np.asarray(mpi_ops.allgather(
+        np.asarray([payload.size], np.int64), name=name + ".sz"))
+    data = np.asarray(mpi_ops.allgather(payload, name=name + ".data"))
+    out = []
+    off = 0
+    for s in sizes.ravel():
+        out.append(pickle.loads(data[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out

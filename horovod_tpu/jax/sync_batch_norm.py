@@ -1,0 +1,92 @@
+"""Cross-replica synchronized batch normalization.
+
+Reference analog: horovod/torch/sync_batch_norm.py (allreduce of per-replica
+sum/sum-of-squares + count, then normalization with global statistics) and
+horovod/tensorflow/sync_batch_norm.py. Here it is a flax.linen module whose
+statistics are psum'd over the data-parallel mesh axes inside the compiled
+step — one fused ICI collective instead of the reference's two allreduces.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.parallel import collectives
+from horovod_tpu.parallel.collectives import Sum
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BatchNorm that reduces statistics across replicas.
+
+    Use inside shard_map/pjit over a mesh with the given axes; outside a
+    mesh context it behaves like plain BatchNorm.
+    """
+
+    axes: Tuple[str, ...] = ("data", "fsdp")
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    use_running_average: bool = False
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = None):  # noqa: RUF013
+        use_ra = (self.use_running_average if use_running_average is None
+                  else use_running_average)
+        features = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros(features, jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones(features, jnp.float32))
+        scale = self.param("scale", nn.initializers.ones, (features,))
+        bias = self.param("bias", nn.initializers.zeros, (features,))
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            reduce_dims = tuple(range(x.ndim - 1))
+            local_count = 1
+            for d in reduce_dims:
+                local_count *= x.shape[d]
+            local_sum = jnp.sum(xf, axis=reduce_dims)
+            local_sqsum = jnp.sum(xf * xf, axis=reduce_dims)
+            axes = self._bound_axes()
+            if axes:
+                # One fused collective for [sum, sqsum, count] — the
+                # reference issues separate allreduces
+                # (sync_batch_norm.py _SyncBatchNorm forward).
+                packed = jnp.concatenate(
+                    [local_sum, local_sqsum,
+                     jnp.asarray([float(local_count)], jnp.float32)])
+                packed = collectives.allreduce(packed, op=Sum, axis=axes)
+                total_sum = packed[:features]
+                total_sqsum = packed[features:2 * features]
+                count = packed[-1]
+            else:
+                total_sum, total_sqsum = local_sum, local_sqsum
+                count = float(local_count)
+            mean = total_sum / count
+            var = total_sqsum / count - mean * mean
+            if not self.is_initializing():
+                ra_mean.value = (self.momentum * ra_mean.value +
+                                 (1 - self.momentum) * mean)
+                ra_var.value = (self.momentum * ra_var.value +
+                                (1 - self.momentum) * var)
+
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * scale + bias
+        return y.astype(self.dtype or x.dtype)
+
+    def _bound_axes(self):
+        bound = []
+        for a in self.axes:
+            try:
+                jax.lax.axis_size(a)
+            except Exception:  # noqa: BLE001
+                continue
+            bound.append(a)
+        return tuple(bound)

@@ -1,0 +1,126 @@
+"""HTTP key-value rendezvous store.
+
+Reference analog: horovod/runner/http/http_server.py (scoped PUT/GET/DELETE
+KV store, :35-134) + http_client.py. The launcher runs the server; workers
+(and the elastic re-init path, reference gloo_context.cc:154-200) read keys
+like ``rank_and_size/<hostname>/<local_rank>``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+
+class KVServer:
+    """Threaded HTTP KV server (launcher side)."""
+
+    def __init__(self, port: int = 0):
+        self._store: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        store = self._store
+        lock = self._lock
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence
+                pass
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                with lock:
+                    store[self.path.lstrip("/")] = body
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):
+                with lock:
+                    val = store.get(self.path.lstrip("/"))
+                if val is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(val)))
+                self.end_headers()
+                self.wfile.write(val)
+
+            def do_DELETE(self):
+                with lock:
+                    existed = store.pop(self.path.lstrip("/"), None)
+                self.send_response(200 if existed is not None else 404)
+                self.end_headers()
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # direct (in-process) access for the launcher
+    def put_json(self, key: str, value: Any):
+        with self._lock:
+            self._store[key] = json.dumps(value).encode()
+
+    def get_json(self, key: str) -> Optional[Any]:
+        with self._lock:
+            val = self._store.get(key)
+        return json.loads(val) if val is not None else None
+
+    def delete(self, key: str):
+        with self._lock:
+            self._store.pop(key, None)
+
+
+class KVClient:
+    """Worker-side client (reference: runner/http/http_client.py)."""
+
+    def __init__(self, addr: str, port: int):
+        self._base = f"http://{addr}:{port}/"
+
+    def put_json(self, key: str, value: Any, timeout: float = 10.0):
+        req = urlrequest.Request(self._base + key,
+                                 data=json.dumps(value).encode(),
+                                 method="PUT")
+        urlrequest.urlopen(req, timeout=timeout)
+
+    def get_json(self, key: str, timeout: float = 10.0,
+                 poll_interval: float = 0.2) -> Optional[Any]:
+        """GET, polling until the key exists or timeout elapses (rendezvous
+        keys appear asynchronously)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                with urlrequest.urlopen(self._base + key,
+                                        timeout=timeout) as resp:
+                    return json.loads(resp.read())
+            except urlerror.HTTPError as e:
+                if e.code != 404:
+                    raise
+            except urlerror.URLError:
+                pass
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(poll_interval)
+
+    def delete(self, key: str, timeout: float = 10.0):
+        req = urlrequest.Request(self._base + key, method="DELETE")
+        try:
+            urlrequest.urlopen(req, timeout=timeout)
+        except urlerror.HTTPError:
+            pass

@@ -69,6 +69,7 @@ def test_tcp_three_process_coordination(tmp_path):
         env = dict(os.environ,
                    HOROVOD_RANK=str(r), HOROVOD_SIZE=str(size),
                    HOROVOD_CONTROLLER_PORT=str(port))
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # keep workers off the TPU relay
         procs.append(subprocess.Popen([sys.executable, str(script)], env=env,
                                       stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT))

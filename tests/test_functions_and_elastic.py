@@ -1,0 +1,185 @@
+"""broadcast_object/allgather_object/broadcast_parameters over the engine,
+SyncBatchNorm statistics, and elastic State commit/restore/sync semantics
+(reference analogs: test/parallel/test_torch.py broadcast_object tests,
+test/single/test_torch_elastic.py)."""
+
+import threading
+import uuid
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.jax import elastic
+from horovod_tpu.jax.sync_batch_norm import SyncBatchNorm
+
+
+def test_sync_batch_norm_matches_global_bn(dp_mesh):
+    """SyncBatchNorm over the mesh == plain BN over the concatenated global
+    batch (the reference's defining property)."""
+    model = SyncBatchNorm(momentum=0.5)
+    rs = np.random.RandomState(0)
+    x_global = rs.uniform(-2, 2, size=(16, 6)).astype(np.float32)
+    variables = model.init(jax.random.key(0), x_global[:2])
+
+    def local(v, xg):
+        out, new_vars = model.apply(v, xg, use_running_average=False,
+                                    mutable=["batch_stats"])
+        return out, new_vars["batch_stats"]
+
+    mapped = jax.shard_map(local, mesh=dp_mesh,
+                           in_specs=(P(), P(("data", "fsdp"))),
+                           out_specs=(P(("data", "fsdp")), P()),
+                           check_vma=False)
+    out, stats = jax.jit(mapped)(variables, jnp.asarray(x_global))
+
+    mean = x_global.mean(0)
+    var = x_global.var(0)
+    expected = (x_global - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-5)
+    # running stats updated with the *global* statistics on every replica
+    np.testing.assert_allclose(np.asarray(stats["mean"]), 0.5 * mean,
+                               rtol=1e-4, atol=1e-5)
+
+
+def _engine_ring(n=3):
+    group = f"fe-{uuid.uuid4().hex[:8]}"
+    from horovod_tpu.engine import EngineSession
+    return [EngineSession(rank=r, size=n, transport="loopback", group=group,
+                          cycle_time_ms=1.0) for r in range(n)]
+
+
+def _run_ranks(sessions, fn):
+    from horovod_tpu.jax.mpi_ops import EagerExecutor
+    executors = [EagerExecutor(s) for s in sessions]
+    results = [None] * len(sessions)
+    errors = [None] * len(sessions)
+
+    def work(r):
+        try:
+            results[r] = fn(r, executors[r])
+        except Exception as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=work, args=(r,))
+               for r in range(len(sessions))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errors:
+        if e:
+            raise e
+    return results
+
+
+def test_broadcast_object_and_allgather_object_over_engine():
+    """Pickled-object transport across 3 in-process ranks (exercises the
+    two-phase size+payload broadcast and ragged allgather)."""
+    sessions = _engine_ring(3)
+    try:
+        def fn(rank, ex):
+            import pickle
+            import io
+            # emulate functions.broadcast_object against a specific executor
+            from horovod_tpu.jax import mpi_ops as mo
+            obj = {"epoch": 7, "note": "hello"} if rank == 0 else None
+            if rank == 0:
+                buf = io.BytesIO()
+                pickle.dump(obj, buf)
+                payload = np.frombuffer(buf.getvalue(), np.uint8)
+            else:
+                payload = np.zeros(0, np.uint8)
+            sz = np.asarray([payload.size], np.int64)
+            h = ex.submit("bo.sz", mo._OP_BROADCAST, sz, root_rank=0)
+            ex.session.wait(h, timeout=15.0)
+            sz = ex.take_result("bo.sz")
+            if rank != 0:
+                payload = np.zeros(int(sz[0]), np.uint8)
+            h = ex.submit("bo.data", mo._OP_BROADCAST, payload, root_rank=0)
+            ex.session.wait(h, timeout=15.0)
+            data = ex.take_result("bo.data")
+            got = pickle.loads(np.asarray(data).tobytes())
+            assert got == {"epoch": 7, "note": "hello"}
+            return True
+
+        assert all(_run_ranks(sessions, fn))
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+
+def test_elastic_state_commit_restore():
+    state = elastic.State(params={"w": jnp.ones((3,))}, epoch=0, batch=0)
+    state.epoch = 5
+    state.params = {"w": jnp.full((3,), 2.0)}
+    state.commit()
+    state.epoch = 9
+    state.params = {"w": jnp.full((3,), 9.0)}
+    state.restore()
+    assert state.epoch == 5
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 2.0)
+
+
+def test_elastic_run_retries_on_internal_error(monkeypatch):
+    """HorovodInternalError → restore + reset + retry (reference:
+    common/elastic.py:147-168)."""
+    calls = {"n": 0, "resets": 0}
+    monkeypatch.setattr(elastic, "_reset",
+                        lambda: calls.__setitem__("resets",
+                                                  calls["resets"] + 1))
+    state = elastic.State(step=0)
+
+    @elastic.run
+    def train(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            state.step = 123  # uncommitted progress, must roll back
+            raise HorovodInternalError("collective failed")
+        assert state.step == 0, "state was not restored"
+        return "done"
+
+    assert train(state) == "done"
+    assert calls["resets"] == 1
+
+
+def test_elastic_run_handles_hosts_updated(monkeypatch):
+    calls = {"n": 0, "resets": 0}
+    monkeypatch.setattr(elastic, "_reset",
+                        lambda: calls.__setitem__("resets",
+                                                  calls["resets"] + 1))
+    state = elastic.State(step=0)
+
+    @elastic.run
+    def train(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            elastic.notify_hosts_updated(skip_sync=True)
+            state.commit()  # surfaces the interrupt
+            raise AssertionError("commit should have raised")
+        state.step += 1
+        return state.step
+
+    assert train(state) == 1
+    assert calls["resets"] == 1
+
+
+def test_local_broadcast_object_without_engine():
+    import horovod_tpu as hvd_top
+    hvd_top.init(start_engine=False)
+    try:
+        assert hvd.broadcast_object({"a": 1}, 0) == {"a": 1}
+        assert hvd.allgather_object(5) == [5]
+    finally:
+        hvd_top.shutdown()
