@@ -131,24 +131,23 @@ def run(func: Callable) -> Callable:
     """
 
     def wrapper(state: State, *args, **kwargs):
-        reset_required = False
+        start_notification_poller()
         skip_sync = False
         while True:
-            if reset_required:
-                _reset()
-                state.on_reset()
-                if not skip_sync:
-                    state.sync()
-                reset_required = False
+            # Sync-first, including the very first iteration: a freshly
+            # spawned worker receives the committed state before its first
+            # training collective (reference: common/elastic.py run_fn).
+            if not skip_sync:
+                state.sync()
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 state.restore()
-                reset_required = True
                 skip_sync = False
             except HostsUpdatedInterrupt as e:
-                reset_required = True
                 skip_sync = e.skip_sync
+            _reset()
+            state.on_reset()
 
     return wrapper
 
@@ -166,21 +165,70 @@ def _reset():
     basics.init()
 
 
-def _requery_rank_and_size():
-    """Re-fetch rank/size from the rendezvous KV (reference:
-    gloo_context.cc:154-200 querying HOROVOD_GLOO_GET_RANK_AND_SIZE)."""
+_seen_generation = -1
+_poller_started = False
+
+
+def _kv_client():
     import os
     from horovod_tpu.runner.http_kv import KVClient
-    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
-    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
-    client = KVClient(addr, port)
+    return KVClient(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+                    int(os.environ["HOROVOD_RENDEZVOUS_PORT"]))
+
+
+def _requery_rank_and_size():
+    """Re-fetch this slot's topology for the latest generation (reference:
+    gloo_context.cc:154-200 querying the HOROVOD_GLOO_GET_RANK_AND_SIZE
+    scope on reset). Also refreshes the controller endpoint — the previous
+    coordinator may be gone."""
+    global _seen_generation
+    import os
+    client = _kv_client()
+    gen_info = client.get_json("generation", timeout=60.0)
+    if gen_info is None:
+        raise RuntimeError("rendezvous server unreachable during reset")
+    gen = gen_info["generation"]
     hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
     local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "0")
     info = client.get_json(
-        f"rank_and_size/{hostname}/{local_rank}", timeout=60.0)
+        f"rank_and_size/g{gen}/{hostname}/{local_rank}", timeout=60.0)
     if info is None or info.get("removed"):
-        raise RuntimeError("host removed from elastic job")
+        raise SystemExit(0)  # host removed from the job: exit cleanly
+    _seen_generation = gen
     for k in ("rank", "size", "local_rank", "local_size", "cross_rank",
               "cross_size"):
         if k in info:
             os.environ[f"HOROVOD_{k.upper()}"] = str(info[k])
+    os.environ["HOROVOD_CONTROLLER_ADDR"] = info["controller_addr"]
+    os.environ["HOROVOD_CONTROLLER_PORT"] = str(info["controller_port"])
+    os.environ["HOROVOD_CONTROLLER_DATA_PORT"] = \
+        str(info["controller_data_port"])
+
+
+def start_notification_poller(interval: float = 1.0):
+    """Background thread surfacing driver membership-change notifications
+    (reference: WorkerNotificationService/Client,
+    runner/elastic/worker.py:31-110 — here a poll of the rendezvous
+    ``notify`` key instead of a push socket)."""
+    global _poller_started, _seen_generation
+    import os
+    import threading
+    if _poller_started or not os.environ.get("HOROVOD_RENDEZVOUS_ADDR"):
+        return
+    _poller_started = True
+    if _seen_generation < 0:
+        _seen_generation = 0
+
+    def poll_loop():
+        while True:
+            try:
+                client = _kv_client()
+                info = client.get_json("notify", timeout=5.0)
+                if info and info["generation"] > _seen_generation:
+                    notify_hosts_updated()
+            except Exception:  # noqa: BLE001 — rendezvous may be restarting
+                pass
+            import time
+            time.sleep(interval)
+
+    threading.Thread(target=poll_loop, daemon=True).start()

@@ -1,0 +1,87 @@
+"""Elastic host discovery.
+
+Reference analog: horovod/runner/elastic/discovery.py — HostDiscovery
+(script-driven membership) + HostManager with blacklist (:41-47,102-108).
+The discovery script prints one "hostname:slots" line per available host;
+the driver polls it every second (reference: driver.py:181-201).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, List
+
+from horovod_tpu.runner import hosts as hosts_lib
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    def __init__(self, script: str):
+        self._script = script
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.run(self._script, shell=True, capture_output=True,
+                             text=True, timeout=30)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"host discovery script failed (rc={out.returncode}): "
+                f"{out.stderr.strip()}")
+        hosts: Dict[str, int] = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            info = hosts_lib.HostInfo.from_string(line)
+            hosts[info.hostname] = info.slots
+        return hosts
+
+
+class FixedHostDiscovery(HostDiscovery):
+    """Static membership (for tests / driving the state machine manually)."""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+    def update(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+
+class HostManager:
+    """Tracks current hosts + blacklist (reference: discovery.py
+    HostManager)."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._blacklist = set()
+        self.current: Dict[str, int] = {}
+
+    def blacklist(self, hostname: str):
+        with self._lock:
+            self._blacklist.add(hostname)
+
+    def is_blacklisted(self, hostname: str) -> bool:
+        with self._lock:
+            return hostname in self._blacklist
+
+    def refresh(self) -> bool:
+        """Poll discovery; returns True if the usable host set changed."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            usable = {h: s for h, s in found.items()
+                      if h not in self._blacklist}
+        changed = usable != self.current
+        self.current = usable
+        return changed
+
+    def host_list(self) -> List[hosts_lib.HostInfo]:
+        return [hosts_lib.HostInfo(h, s)
+                for h, s in sorted(self.current.items())]

@@ -1,0 +1,200 @@
+"""Elastic driver: discovery polling, rank reassignment, worker lifecycle.
+
+Reference analog: horovod/runner/elastic/driver.py — the background
+discovery thread (:181-201), host-change notification + rank reassignment
+(:202-274), worker spawn for new slots (:276-294) and failure handling with
+blacklisting (:296+).
+
+Topology generations: every membership change bumps a generation; the new
+per-slot topology (plus fresh controller ports — the old coordinator may be
+gone) is published to the rendezvous KV under ``rank_and_size/g<N>/...``.
+Workers learn about the change either by a collective failure
+(HorovodInternalError) or the notify key (polled inside the training
+process, reference: WorkerNotificationService, runner/elastic/worker.py),
+then reset: shutdown engine → re-query topology → re-init.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.runner import hosts as hosts_lib
+from horovod_tpu.runner.elastic.discovery import HostDiscovery, HostManager
+from horovod_tpu.runner.exec_utils import WorkerProcess
+from horovod_tpu.runner.http_kv import KVServer
+from horovod_tpu.runner.launch import (
+    free_port,
+    publish_assignments,
+    worker_env,
+)
+
+DISCOVER_INTERVAL_SECS = 1.0
+FAILURES_TO_BLACKLIST = 3
+
+
+class ElasticDriver:
+    def __init__(self, discovery: HostDiscovery, min_np: int, max_np: int,
+                 command: List[str], extra_env: Optional[dict] = None,
+                 reset_limit: Optional[int] = None, verbose: bool = False,
+                 discover_interval: float = DISCOVER_INTERVAL_SECS):
+        self._hosts = HostManager(discovery)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._command = command
+        self._extra_env = extra_env or {}
+        self._reset_limit = reset_limit
+        self._verbose = verbose
+        self._interval = discover_interval
+
+        self._kv = KVServer().start()
+        self._generation = -1
+        self._prev_host_order: List[str] = []
+        self._workers: Dict[Tuple[str, int], WorkerProcess] = {}
+        self._host_failures: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._result: Optional[int] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def run(self, start_timeout: float = 120.0) -> int:
+        self._wait_for_min_hosts(start_timeout)
+        self._rebalance(first=True)
+        poller = threading.Thread(target=self._discovery_loop, daemon=True)
+        poller.start()
+        try:
+            return self._wait_for_completion()
+        finally:
+            self._shutdown.set()
+            poller.join(timeout=5)
+            for w in self._workers.values():
+                w.terminate()
+            self._kv.stop()
+
+    def _wait_for_min_hosts(self, timeout: float):
+        deadline = time.monotonic() + timeout
+        while True:
+            self._hosts.refresh()
+            if sum(s for s in self._hosts.current.values()) >= self._min_np:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"discovery did not provide {self._min_np} slots within "
+                    f"{timeout}s (have {self._hosts.current})")
+            time.sleep(self._interval)
+
+    # -- discovery + rebalancing --------------------------------------------
+
+    def _discovery_loop(self):
+        while not self._shutdown.is_set():
+            time.sleep(self._interval)
+            try:
+                changed = self._hosts.refresh()
+            except RuntimeError as e:
+                self._log(f"discovery error: {e}")
+                continue
+            self._reap_workers()
+            if changed:
+                available = sum(self._hosts.current.values())
+                if available >= self._min_np:
+                    self._log(f"host set changed: {self._hosts.current}")
+                    self._rebalance()
+                else:
+                    self._log(
+                        f"waiting: only {available} slots available, "
+                        f"need {self._min_np}")
+
+    def _rebalance(self, first: bool = False):
+        with self._lock:
+            self._generation += 1
+            gen = self._generation
+            if self._reset_limit is not None and gen > self._reset_limit:
+                self._log(f"reset limit {self._reset_limit} exceeded")
+                self._result = 1
+                self._shutdown.set()
+                return
+            # Keep prior hosts first so rank 0 lands on a worker that holds
+            # committed state (reference: driver.py:232-274 keeps at least
+            # one previously-used host ordered first for state sync).
+            current = dict(self._hosts.current)
+            ordered = [h for h in self._prev_host_order if h in current]
+            ordered += [h for h in sorted(current) if h not in ordered]
+            self._prev_host_order = ordered
+            host_list = [hosts_lib.HostInfo(h, current[h]) for h in ordered]
+            slots = hosts_lib.get_host_assignments(
+                host_list, min_np=min(self._min_np,
+                                      sum(h.slots for h in host_list)),
+                max_np=self._max_np)
+            controller_host = slots[0].hostname
+            controller_addr = "127.0.0.1" \
+                if controller_host == "localhost" else controller_host
+            controller_port = free_port()
+            data_port = free_port()
+            publish_assignments(self._kv, slots, controller_addr,
+                                controller_port, data_port, generation=gen)
+            # mark slots no longer present as removed so resetting workers
+            # on removed hosts exit cleanly (reference: gloo_context.cc
+            # throws when the host is gone)
+            current = {(s.hostname, s.local_rank) for s in slots}
+            for key in list(self._workers):
+                if key not in current:
+                    self._kv.put_json(
+                        f"rank_and_size/g{gen}/{key[0]}/{key[1]}",
+                        {"removed": True})
+            # notify running workers (polled inside the training process)
+            self._kv.put_json("notify", {"generation": gen})
+            # spawn workers for slots that have no live process
+            for s in slots:
+                key = (s.hostname, s.local_rank)
+                w = self._workers.get(key)
+                if w is not None and w.poll() is None:
+                    continue
+                env = worker_env(s, controller_addr, controller_port,
+                                 data_port, self._kv.port, self._extra_env,
+                                 elastic=True)
+                self._log(f"spawning worker {key} (generation {gen})")
+                self._workers[key] = WorkerProcess(
+                    s.hostname, s.rank, self._command, env)
+
+    def _reap_workers(self):
+        with self._lock:
+            for key, w in list(self._workers.items()):
+                code = w.poll()
+                if code is None:
+                    continue
+                host, local_rank = key
+                if code == 0:
+                    self._log(f"worker {key} finished successfully")
+                    self._result = 0 if self._result is None else self._result
+                    self._shutdown.set()
+                    continue
+                self._log(f"worker {key} failed with code {code}")
+                del self._workers[key]
+                self._host_failures[host] = \
+                    self._host_failures.get(host, 0) + 1
+                if self._host_failures[host] >= FAILURES_TO_BLACKLIST:
+                    self._log(f"blacklisting {host}")
+                    self._hosts.blacklist(host)
+                # force a rebalance on next tick by clearing current view
+                self._hosts.current = {}
+
+    def _wait_for_completion(self) -> int:
+        while not self._shutdown.is_set():
+            time.sleep(0.2)
+        # drain remaining workers briefly
+        deadline = time.monotonic() + 30
+        for w in self._workers.values():
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                w.wait(timeout=remaining)
+            except Exception:  # noqa: BLE001
+                w.terminate()
+        return self._result if self._result is not None else 1
+
+    def _log(self, msg: str):
+        if self._verbose:
+            sys.stderr.write(f"[elastic-driver] {msg}\n")
+            sys.stderr.flush()

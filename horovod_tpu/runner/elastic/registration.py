@@ -1,0 +1,46 @@
+"""Worker state registry.
+
+Reference analog: horovod/runner/elastic/registration.py — the
+READY/SUCCESS/FAILURE barrier (:66-135) driving re-rendezvous: the driver
+waits until every expected worker of a generation has recorded READY before
+publishing the go-ahead, and uses SUCCESS/FAILURE records to decide
+completion vs reset.
+
+This build records states in the rendezvous KV
+(``worker_state/g<GEN>/<host>/<slot>``) — workers PUT, the driver polls.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+READY = "READY"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+
+class WorkerStateRegistry:
+    def __init__(self, kv_server):
+        self._kv = kv_server
+
+    def key(self, generation: int, hostname: str, local_rank: int) -> str:
+        return f"worker_state/g{generation}/{hostname}/{local_rank}"
+
+    def record(self, generation: int, hostname: str, local_rank: int,
+               state: str):
+        self._kv.put_json(self.key(generation, hostname, local_rank),
+                          {"state": state, "ts": time.time()})
+
+    def get(self, generation: int, hostname: str,
+            local_rank: int) -> str:
+        v = self._kv.get_json(self.key(generation, hostname, local_rank))
+        return v["state"] if v else None
+
+    def count(self, generation: int,
+              slots: Dict[Tuple[str, int], None]) -> Dict[str, int]:
+        counts = {READY: 0, SUCCESS: 0, FAILURE: 0, None: 0}
+        for (host, local_rank) in slots:
+            counts[self.get(generation, host, local_rank)] = \
+                counts.get(self.get(generation, host, local_rank), 0) + 1
+        return counts

@@ -1,0 +1,94 @@
+"""Worker process spawning — local subprocess or ssh.
+
+Reference analog: horovod/runner/common/util/safe_shell_exec.py (exec with
+output forwarding + termination) and the per-slot ssh command construction
+in runner/gloo_run.py:114-185.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+LOCAL_HOSTNAMES = {"localhost", "127.0.0.1", os.uname().nodename}
+
+
+def is_local(hostname: str) -> bool:
+    return hostname in LOCAL_HOSTNAMES
+
+
+def build_command(hostname: str, command: List[str],
+                  env: Dict[str, str], ssh_port: Optional[int] = None,
+                  ) -> List[str]:
+    """Local: run directly with env. Remote: ssh with inline exports
+    (reference: gloo_run.py get_remote_command)."""
+    if is_local(hostname):
+        return command
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+        " ".join(shlex.quote(c) for c in command)
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    return ssh + [hostname, remote]
+
+
+class WorkerProcess:
+    """A spawned worker with output forwarding and a tag prefix
+    (reference: safe_shell_exec forwarding threads)."""
+
+    def __init__(self, hostname: str, rank: int, command: List[str],
+                 env: Dict[str, str], prefix_output: bool = True,
+                 capture: bool = False):
+        self.hostname = hostname
+        self.rank = rank
+        full_env = dict(os.environ)
+        full_env.update(env)
+        # keep launcher-spawned workers off any single-tenant accelerator
+        # relay; the training script opts back in explicitly if needed.
+        cmd = build_command(hostname, command, env)
+        self.captured: List[str] = []
+        self._capture = capture
+        self.proc = subprocess.Popen(
+            cmd, env=full_env if is_local(hostname) else None,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._fwd = threading.Thread(
+            target=self._forward, args=(prefix_output,), daemon=True)
+        self._fwd.start()
+
+    def _forward(self, prefix: bool):
+        tag = f"[{self.rank}]<stdout>:" if prefix else ""
+        for line in self.proc.stdout:
+            text = line.decode(errors="replace")
+            if self._capture:
+                self.captured.append(text)
+            sys.stdout.write(f"{tag}{text}" if tag else text)
+            sys.stdout.flush()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        rc = self.proc.wait(timeout=timeout)
+        self._fwd.join(timeout=5)
+        return rc
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    def kill(self):
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass

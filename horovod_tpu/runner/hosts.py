@@ -1,0 +1,99 @@
+"""Host parsing and slot assignment.
+
+Reference analog: horovod/runner/common/util/hosts.py — ``parse_hosts``
+("host1:4,host2:2" specs) and ``get_host_assignments`` producing SlotInfo
+records with the full rank topology (rank / local_rank / cross_rank and the
+three sizes) that the launcher exports as the worker env contract
+(reference: runner/gloo_run.py:65-78).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+
+@dataclasses.dataclass
+class HostInfo:
+    hostname: str
+    slots: int
+
+    @staticmethod
+    def from_string(spec: str) -> "HostInfo":
+        if ":" in spec:
+            host, slots = spec.rsplit(":", 1)
+            return HostInfo(host, int(slots))
+        return HostInfo(spec, 1)
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+    def to_env(self) -> Dict[str, str]:
+        return {
+            "HOROVOD_HOSTNAME": self.hostname,
+            "HOROVOD_RANK": str(self.rank),
+            "HOROVOD_LOCAL_RANK": str(self.local_rank),
+            "HOROVOD_CROSS_RANK": str(self.cross_rank),
+            "HOROVOD_SIZE": str(self.size),
+            "HOROVOD_LOCAL_SIZE": str(self.local_size),
+            "HOROVOD_CROSS_SIZE": str(self.cross_size),
+        }
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """Parse "host1:2,host2:4" (reference: hosts.py parse_hosts)."""
+    return [HostInfo.from_string(s) for s in hosts_string.split(",") if s]
+
+
+def get_host_assignments(hosts: Sequence[HostInfo], min_np: int,
+                         max_np: int = None) -> List[SlotInfo]:
+    """Assign ranks to host slots (reference: hosts.py
+    get_host_assignments): ranks fill hosts in order; local_rank is the
+    index within a host; cross_rank is the index of the host among hosts
+    that also have that local_rank."""
+    max_np = max_np if max_np is not None else min_np
+    total = sum(h.slots for h in hosts)
+    if total < min_np:
+        raise ValueError(
+            f"requested at least {min_np} processes but hosts provide only "
+            f"{total} slots")
+    np_ = min(total, max_np)
+
+    # rank-ordered placement
+    placements: List = []  # (host_idx, local_rank)
+    for host_idx, h in enumerate(hosts):
+        for local_rank in range(h.slots):
+            if len(placements) == np_:
+                break
+            placements.append((host_idx, local_rank))
+
+    local_sizes: Dict[int, int] = {}
+    for host_idx, _ in placements:
+        local_sizes[host_idx] = local_sizes.get(host_idx, 0) + 1
+    # cross_size per local_rank = number of hosts having that local_rank
+    cross_sizes: Dict[int, int] = {}
+    for _, local_rank in placements:
+        cross_sizes[local_rank] = cross_sizes.get(local_rank, 0) + 1
+
+    out: List[SlotInfo] = []
+    for rank, (host_idx, local_rank) in enumerate(placements):
+        cross_rank = sum(1 for (h2, l2) in placements[:rank]
+                         if l2 == local_rank)
+        out.append(SlotInfo(
+            hostname=hosts[host_idx].hostname,
+            rank=rank,
+            local_rank=local_rank,
+            cross_rank=cross_rank,
+            size=np_,
+            local_size=local_sizes[host_idx],
+            cross_size=cross_sizes[local_rank],
+        ))
+    return out

@@ -1,0 +1,215 @@
+"""``hvdrun-tpu`` — the launcher CLI.
+
+Reference analog: horovod/runner/launch.py (argparse surface mapping engine
+knobs to env, :734-758 static-vs-elastic dispatch) + gloo_run.py
+(rendezvous server, host assignment, per-slot env, worker spawn,
+:226-271,187-211).
+
+Static flow: allocate controller+data ports, start the rendezvous KV,
+publish per-slot topology, spawn one worker per slot with the
+``HOROVOD_*`` env contract, fail fast if any worker fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import time
+from typing import List, Optional
+
+from horovod_tpu.runner import hosts as hosts_lib
+from horovod_tpu.runner.exec_utils import WorkerProcess
+from horovod_tpu.runner.http_kv import KVServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("0.0.0.0", 0))
+        return s.getsockname()[1]
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun-tpu",
+        description="Launch a horovod_tpu distributed job")
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="number of worker processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='host slots, e.g. "localhost:4,host2:4"')
+    p.add_argument("--ssh-port", type=int, default=None)
+    # elastic (reference: launch.py elastic group)
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None,
+                   help="script printing 'host:slots' lines; polled for "
+                        "elastic membership changes")
+    p.add_argument("--reset-limit", type=int, default=None,
+                   help="max elastic resets before aborting")
+    # engine knobs → env (reference: config_parser mapping)
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--stall-check-time-seconds", type=float, default=None)
+    p.add_argument("--stall-shutdown-time-seconds", type=float, default=None)
+    p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--start-timeout", type=float, default=120.0)
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    return p
+
+
+def _engine_env(args) -> dict:
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.timeline_filename:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.stall_check_time_seconds is not None:
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_check_time_seconds)
+    if args.stall_shutdown_time_seconds is not None:
+        env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = str(
+            args.stall_shutdown_time_seconds)
+    if args.no_stall_check:
+        env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    return env
+
+
+def publish_assignments(kv: KVServer, slots, controller_addr: str,
+                        controller_port: int, data_port: int,
+                        generation: int = 0):
+    """Publish per-slot topology under a generation scope (reference:
+    rendezvous GET_RANK_AND_SIZE scope, runner/elastic/rendezvous.py)."""
+    for s in slots:
+        kv.put_json(
+            f"rank_and_size/g{generation}/{s.hostname}/{s.local_rank}",
+            {"rank": s.rank, "size": s.size,
+             "local_rank": s.local_rank, "local_size": s.local_size,
+             "cross_rank": s.cross_rank, "cross_size": s.cross_size,
+             "controller_addr": controller_addr,
+             "controller_port": controller_port,
+             "controller_data_port": data_port})
+    kv.put_json("generation", {"generation": generation})
+
+
+def worker_env(slot, controller_addr, controller_port, data_port,
+               kv_port, extra, elastic=False) -> dict:
+    env = slot.to_env()
+    env.update(extra)
+    env.update({
+        "HOROVOD_CONTROLLER_ADDR": controller_addr,
+        "HOROVOD_CONTROLLER_PORT": str(controller_port),
+        "HOROVOD_CONTROLLER_DATA_PORT": str(data_port),
+        "HOROVOD_RENDEZVOUS_ADDR": controller_addr,
+        "HOROVOD_RENDEZVOUS_PORT": str(kv_port),
+    })
+    if elastic:
+        env["HOROVOD_ELASTIC"] = "1"
+    # Workers must not grab a single-tenant accelerator relay the launcher
+    # process may own; training scripts opt in explicitly.
+    env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu"))
+    return env
+
+
+def run_static(args) -> int:
+    host_string = args.hosts or f"localhost:{args.num_proc}"
+    host_list = hosts_lib.parse_hosts(host_string)
+    np_ = args.num_proc or sum(h.slots for h in host_list)
+    slots = hosts_lib.get_host_assignments(host_list, np_)
+
+    controller_addr = slots[0].hostname if slots[0].hostname != "localhost" \
+        else "127.0.0.1"
+    controller_port = free_port()
+    data_port = free_port()
+    kv = KVServer().start()
+    try:
+        publish_assignments(kv, slots, controller_addr, controller_port,
+                            data_port)
+        extra = _engine_env(args)
+        workers: List[WorkerProcess] = []
+        for s in slots:
+            env = worker_env(s, controller_addr, controller_port, data_port,
+                             kv.port, extra)
+            workers.append(WorkerProcess(s.hostname, s.rank, args.command,
+                                         env))
+        return _wait_all(workers)
+    finally:
+        kv.stop()
+
+
+def _wait_all(workers: List[WorkerProcess]) -> int:
+    """Fail fast: first non-zero exit kills the rest (reference:
+    gloo_run terminate-on-failure)."""
+    rc = 0
+    pending = {w.rank: w for w in workers}
+    try:
+        while pending:
+            for rank, w in list(pending.items()):
+                code = w.poll()
+                if code is None:
+                    continue
+                del pending[rank]
+                if code != 0:
+                    sys.stderr.write(
+                        f"[launcher] worker rank {rank} on {w.hostname} "
+                        f"exited with code {code}; terminating job\n")
+                    rc = code
+                    for other in pending.values():
+                        other.terminate()
+                    for other in pending.values():
+                        other.wait(timeout=10)
+                    return rc
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        for w in pending.values():
+            w.terminate()
+        rc = 130
+    return rc
+
+
+def run_elastic(args) -> int:
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    min_np = args.min_np or args.num_proc
+    max_np = args.max_np or args.num_proc or min_np
+    discovery = HostDiscoveryScript(args.host_discovery_script)
+    driver = ElasticDriver(
+        discovery=discovery, min_np=min_np, max_np=max_np,
+        command=args.command, extra_env=_engine_env(args),
+        reset_limit=args.reset_limit, verbose=args.verbose)
+    return driver.run(start_timeout=args.start_timeout)
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        make_parser().error("no training command given")
+    elastic = args.host_discovery_script is not None or \
+        (args.min_np is not None or args.max_np is not None)
+    if elastic and not args.host_discovery_script:
+        make_parser().error("elastic mode requires --host-discovery-script")
+    if not elastic and not (args.num_proc or args.hosts):
+        make_parser().error("specify -np and/or -H")
+    return run_elastic(args) if elastic else run_static(args)
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Elastic training end-to-end on localhost.
+
+Reference analog: test/integration/test_elastic_torch.py +
+elastic_common.py — a discovery script backed by a file the test mutates
+mid-run; asserts training survives host additions and worker failures with
+state intact.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ELASTIC_TRAIN = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd_top
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.jax import elastic
+
+    hvd_top.init()
+    state = elastic.State(step=0)
+    TOTAL = int(os.environ.get("TOTAL_STEPS", "30"))
+
+    @elastic.run
+    def train(state):
+        while state.step < TOTAL:
+            out = np.asarray(hvd.allreduce(
+                np.ones(2, np.float32), op=hvd.Sum,
+                name=f"batch.{{state.step}}"))
+            assert np.allclose(out, hvd_top.size()), (out, hvd_top.size())
+            print(f"progress rank={{hvd_top.rank()}} step={{state.step}} "
+                  f"size={{hvd_top.size()}}", flush=True)
+            state.step += 1
+            state.commit()
+            time.sleep(0.05)
+        return state.step
+
+    steps = train(state)
+    print(f"worker-done rank={{hvd_top.rank()}} steps={{steps}} "
+          f"size={{hvd_top.size()}}", flush=True)
+    hvd_top.shutdown()
+""")
+
+
+def _launch_elastic(tmp_path, hosts_file_content, min_np, max_np,
+                    total_steps=30):
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text(hosts_file_content)
+    discovery = tmp_path / "discover.sh"
+    discovery.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    discovery.chmod(0o755)
+    train = tmp_path / "train.py"
+    train.write_text(ELASTIC_TRAIN.format(repo=REPO))
+
+    env = dict(os.environ, TOTAL_STEPS=str(total_steps),
+               HOROVOD_CONTROLLER_TIMEOUT_SECONDS="10",
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "--min-np", str(min_np), "--max-np", str(max_np),
+         "--host-discovery-script", str(discovery), "--verbose",
+         "--", sys.executable, str(train.resolve())],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    return proc, hosts_file
+
+
+def test_elastic_scale_up(tmp_path):
+    """Start with 2 slots, add a third mid-run: workers reset, the new
+    worker syncs committed state, training finishes at size 3."""
+    proc, hosts_file = _launch_elastic(tmp_path, "localhost:2\n",
+                                       min_np=2, max_np=3, total_steps=40)
+
+    def add_host():
+        time.sleep(4.0)
+        hosts_file.write_text("localhost:3\n")
+
+    t = threading.Thread(target=add_host)
+    t.start()
+    out, _ = proc.communicate(timeout=180)
+    t.join()
+    text = out.decode()
+    assert proc.returncode == 0, text
+    assert "size=2" in text, text
+    assert "size=3" in text, f"never scaled up:\n{text}"
+    done = [line for line in text.splitlines() if "worker-done" in line]
+    assert any("size=3" in line for line in done), text
+    # the late-joining worker must resume from committed step, not step 0:
+    # after scale-up no step may repeat from 0 for rank 2
+    rank2_steps = [int(line.split("step=")[1].split()[0])
+                   for line in text.splitlines()
+                   if "progress rank=2" in line]
+    if rank2_steps:
+        assert rank2_steps[0] > 0, (
+            f"new worker restarted from step 0:\n{text}")
+
+
+def test_elastic_worker_failure_recovers(tmp_path):
+    """Kill one worker mid-run: peers restore committed state, the driver
+    respawns the slot, training completes."""
+    proc, hosts_file = _launch_elastic(tmp_path, "localhost:2\n",
+                                       min_np=2, max_np=2, total_steps=40)
+
+    killed = {}
+
+    def kill_one():
+        time.sleep(5.0)
+        # find a worker: children of launcher running train.py
+        out = subprocess.run(
+            ["pgrep", "-f", "train.py"], capture_output=True, text=True)
+        pids = [int(p) for p in out.stdout.split()]
+        if pids:
+            os.kill(pids[-1], 9)
+            killed["pid"] = pids[-1]
+
+    t = threading.Thread(target=kill_one)
+    t.start()
+    out, _ = proc.communicate(timeout=180)
+    t.join()
+    text = out.decode()
+    assert killed, "did not find a worker to kill"
+    assert proc.returncode == 0, text
+    assert "worker-done" in text, text

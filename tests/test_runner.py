@@ -1,0 +1,135 @@
+"""Launcher tests.
+
+Reference analog: test/single/test_run.py (host parsing + assignment
+against expected topologies, launcher arg handling) and
+test/integration/test_static_run.py (real localhost jobs end-to-end).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import hosts as hosts_lib
+from horovod_tpu.runner.launch import make_parser, run_commandline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# host assignment units (reference: test_run.py test_get_host_assignments)
+
+
+def test_parse_hosts():
+    hosts = hosts_lib.parse_hosts("a:2,b:4,c")
+    assert [(h.hostname, h.slots) for h in hosts] == \
+        [("a", 2), ("b", 4), ("c", 1)]
+
+
+def test_host_assignment_topology():
+    hosts = hosts_lib.parse_hosts("a:2,b:2")
+    slots = hosts_lib.get_host_assignments(hosts, 4)
+    assert [(s.rank, s.hostname, s.local_rank, s.cross_rank)
+            for s in slots] == [
+        (0, "a", 0, 0), (1, "a", 1, 0), (2, "b", 0, 1), (3, "b", 1, 1)]
+    for s in slots:
+        assert s.size == 4
+        assert s.local_size == 2
+        assert s.cross_size == 2
+
+
+def test_host_assignment_uneven():
+    hosts = hosts_lib.parse_hosts("a:3,b:1")
+    slots = hosts_lib.get_host_assignments(hosts, 4)
+    a_slots = [s for s in slots if s.hostname == "a"]
+    b_slots = [s for s in slots if s.hostname == "b"]
+    assert len(a_slots) == 3 and a_slots[0].local_size == 3
+    assert len(b_slots) == 1 and b_slots[0].local_size == 1
+    # local_rank 0 exists on both hosts; local ranks 1,2 only on a
+    assert a_slots[0].cross_size == 2
+    assert a_slots[1].cross_size == 1
+
+
+def test_host_assignment_insufficient_slots():
+    with pytest.raises(ValueError, match="slots"):
+        hosts_lib.get_host_assignments(hosts_lib.parse_hosts("a:2"), 4)
+
+
+def test_env_contract():
+    slots = hosts_lib.get_host_assignments(
+        hosts_lib.parse_hosts("localhost:2"), 2)
+    env = slots[1].to_env()
+    assert env["HOROVOD_RANK"] == "1"
+    assert env["HOROVOD_SIZE"] == "2"
+    assert env["HOROVOD_LOCAL_RANK"] == "1"
+
+
+def test_parser_maps_engine_knobs():
+    args = make_parser().parse_args(
+        ["-np", "2", "--fusion-threshold-mb", "32", "--cycle-time-ms", "5",
+         "--timeline-filename", "/tmp/t.json", "--", "python", "x.py"])
+    from horovod_tpu.runner.launch import _engine_env
+    env = _engine_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert float(env["HOROVOD_CYCLE_TIME"]) == 5.0
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+
+
+# ---------------------------------------------------------------------------
+# integration: real localhost static runs
+
+
+TRAIN = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd_top
+    import horovod_tpu.jax as hvd
+    hvd_top.init()
+    out = np.asarray(hvd.allreduce(
+        np.full((2,), float(hvd_top.rank()), np.float32), op=hvd.Sum))
+    assert np.allclose(out, sum(range(hvd_top.size()))), out
+    cfg = hvd.broadcast_object({{"seed": 42}} if hvd_top.rank() == 0 else None)
+    assert cfg == {{"seed": 42}}
+    print(f"static-worker {{hvd_top.rank()}}/{{hvd_top.size()}} OK")
+    hvd_top.shutdown()
+""")
+
+
+def _clean_env():
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def test_static_launch_three_workers(tmp_path, capfd, monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    script = tmp_path / "train.py"
+    script.write_text(TRAIN.format(repo=REPO))
+    rc = run_commandline(["-np", "3", "--", sys.executable, str(script)])
+    out = capfd.readouterr().out
+    assert rc == 0, out
+    for r in range(3):
+        assert f"static-worker {r}/3 OK" in out
+
+
+def test_static_launch_failure_propagates(tmp_path, monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    script = tmp_path / "bad.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        if int(os.environ["HOROVOD_RANK"]) == 1:
+            sys.exit(7)
+        time.sleep(60)  # must be terminated by the launcher, not finish
+    """))
+    import time
+    t0 = time.monotonic()
+    rc = run_commandline(["-np", "3", "--", sys.executable, str(script)])
+    assert rc == 7
+    assert time.monotonic() - t0 < 50, "launcher did not fail fast"
+
+
+def test_cli_requires_command():
+    with pytest.raises(SystemExit):
+        run_commandline(["-np", "2"])
