@@ -57,6 +57,13 @@ class _HorovodTpuContext:
         with self._lock:
             if self.initialized:
                 return
+            from horovod_tpu.runner.elastic import worker as elastic_worker
+            if elastic_worker.is_elastic_worker():
+                # Synchronize with the driver's current topology generation
+                # (READY/go barrier) before reading the env it rewrites —
+                # both on first spawn and on elastic re-init (reference:
+                # gloo_context.cc:154-200 re-init scope query).
+                elastic_worker.rendezvous()
             self.rank = _env_int("HOROVOD_RANK", 0)
             self.size = _env_int("HOROVOD_SIZE", 1)
             self.local_rank = _env_int("HOROVOD_LOCAL_RANK", 0)

@@ -3,6 +3,8 @@
 #include <algorithm>
 #include <sstream>
 
+#include "data_plane.h"
+
 namespace hvdtpu {
 
 namespace {
@@ -216,7 +218,13 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
     if (used[i]) continue;
     Response& base = (*responses)[i];
     used[i] = true;
-    if (base.type != Response::Type::ALLREDUCE) {
+    // ADASUM responses never fuse: the combination coefficients are per
+    // tensor (dot/norm over each tensor alone), so an elementwise-fused
+    // buffer would compute different math than per-tensor Adasum (the
+    // in-jit adasum_allreduce_group documents the same constraint; the
+    // reference fuses Adasum only with per-tensor offsets).
+    if (base.type != Response::Type::ALLREDUCE ||
+        base.reduce_op == static_cast<int32_t>(ReduceKind::ADASUM)) {
       fused.push_back(std::move(base));
       continue;
     }

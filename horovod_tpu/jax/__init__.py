@@ -187,8 +187,17 @@ def broadcast_parameters(params, root_rank: int = 0, axis=DP_AXES):
     return functions.broadcast_parameters(params, root_rank)
 
 
-def metric_average(value, axis=DP_AXES):
+def metric_average(value, axis=DP_AXES, name: Optional[str] = None):
     """Average a scalar metric across replicas (reference: the
     ``metric_average`` pattern in examples/pytorch/pytorch_mnist.py and
-    MetricAverageCallback, horovod/_keras/callbacks.py:48-88)."""
-    return collectives.allreduce(jnp.asarray(value), op=Average, axis=axis)
+    MetricAverageCallback, horovod/_keras/callbacks.py:48-88).
+
+    Smart-dispatched: tracers inside shard_map use the in-jit ``lax.psum``
+    collective; concrete host values (the eager post-epoch pattern) go
+    through the engine-coordinated eager allreduce."""
+    value = jnp.asarray(value)
+    if isinstance(value, jax.core.Tracer):
+        return collectives.allreduce(value, op=Average, axis=axis)
+    from horovod_tpu.jax import mpi_ops
+    return mpi_ops.allreduce(value, op=Average, axis=axis,
+                             name=name or "metric_average")

@@ -28,24 +28,36 @@ from horovod_tpu.common.exceptions import (
 )
 
 # Host-update notifications (pushed by the runner's worker notification
-# client, reference: runner/elastic/worker.py:84-110).
-_notification_queue: "queue.Queue[bool]" = queue.Queue()
+# client, reference: runner/elastic/worker.py:84-110). Each entry is
+# (generation, skip_sync): a notification only fires an interrupt if its
+# generation is newer than the one this worker last rendezvoused into, so a
+# freshly spawned worker never interrupts on the announcement of its own
+# birth generation.
+_notification_queue: "queue.Queue[tuple]" = queue.Queue()
 
 
-def notify_hosts_updated(skip_sync: bool = False):
-    _notification_queue.put(skip_sync)
+def notify_hosts_updated(skip_sync: bool = False, generation: int = None):
+    _notification_queue.put((generation, skip_sync))
+
+
+def _current_generation() -> int:
+    from horovod_tpu.runner.elastic import worker as elastic_worker
+    return elastic_worker.current_generation()
 
 
 def _check_host_updates():
     updated = False
     skip_sync = True
+    cur = _current_generation()
     while True:
         try:
-            s = _notification_queue.get_nowait()
-            updated = True
-            skip_sync = skip_sync and s
+            gen, s = _notification_queue.get_nowait()
         except queue.Empty:
             break
+        if gen is not None and gen <= cur:
+            continue  # stale: we already rendezvoused past this generation
+        updated = True
+        skip_sync = skip_sync and s
     if updated:
         raise HostsUpdatedInterrupt(skip_sync)
 
@@ -134,13 +146,18 @@ def run(func: Callable) -> Callable:
         start_notification_poller()
         skip_sync = False
         while True:
-            # Sync-first, including the very first iteration: a freshly
-            # spawned worker receives the committed state before its first
-            # training collective (reference: common/elastic.py run_fn).
-            if not skip_sync:
-                state.sync()
             try:
-                return func(state, *args, **kwargs)
+                # Sync-first, including the very first iteration: a freshly
+                # spawned worker receives the committed state before its
+                # first training collective (reference: common/elastic.py
+                # run_fn). sync() itself runs collectives, so it sits inside
+                # the retry scope: a peer dying mid-sync restores + resets
+                # instead of crashing this worker.
+                if not skip_sync:
+                    state.sync()
+                result = func(state, *args, **kwargs)
+                _record_final_state(success=True)
+                return result
             except HorovodInternalError:
                 state.restore()
                 skip_sync = False
@@ -152,83 +169,72 @@ def run(func: Callable) -> Callable:
     return wrapper
 
 
+def _record_final_state(success: bool):
+    """Best-effort SUCCESS/FAILURE record for the driver's registry
+    (reference: runner/elastic/registration.py SUCCESS/FAILURE records)."""
+    from horovod_tpu.runner.elastic import worker as elastic_worker
+    if not elastic_worker.is_elastic_worker():
+        return
+    try:
+        elastic_worker.record_state(
+            elastic_worker.current_generation(),
+            elastic_worker.SUCCESS if success else elastic_worker.FAILURE)
+    except Exception:  # noqa: BLE001 — the driver also watches exit codes
+        pass
+
+
 def _reset():
     """Shutdown + re-init (reference: torch/elastic/__init__.py:46+ —
-    shutdown, re-rendezvous, init). Topology env vars are re-read, so the
-    launcher can hand this process a new rank/size before unblocking it."""
-    ctx = basics._context()
-    was_elastic = ctx.elastic
-    basics.shutdown()
-    import os
-    if was_elastic and os.environ.get("HOROVOD_RENDEZVOUS_ADDR"):
-        _requery_rank_and_size()
-    basics.init()
+    shutdown, re-rendezvous, init). The re-rendezvous (generation query +
+    READY/go barrier, reference gloo_context.cc:154-200) happens inside
+    ``init()`` for elastic workers, so the driver hands this process its new
+    rank/size/controller endpoint before the engine boots.
+
+    A reset always requires a *strictly newer* generation: the one we are
+    leaving may still be current (its go released) yet contain a dead peer.
+    Engine boot failures retry with another fresh generation — a peer may
+    die mid-re-init too."""
+    from horovod_tpu.runner.elastic import worker as elastic_worker
+    last_exc = None
+    for _ in range(3):
+        if elastic_worker.is_elastic_worker():
+            elastic_worker.request_new_generation()
+        basics.shutdown()
+        try:
+            basics.init()
+            return
+        except SystemExit:
+            raise
+        except RuntimeError as e:
+            last_exc = e
+    raise last_exc
 
 
-_seen_generation = -1
 _poller_started = False
-
-
-def _kv_client():
-    import os
-    from horovod_tpu.runner.http_kv import KVClient
-    return KVClient(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
-                    int(os.environ["HOROVOD_RENDEZVOUS_PORT"]))
-
-
-def _requery_rank_and_size():
-    """Re-fetch this slot's topology for the latest generation (reference:
-    gloo_context.cc:154-200 querying the HOROVOD_GLOO_GET_RANK_AND_SIZE
-    scope on reset). Also refreshes the controller endpoint — the previous
-    coordinator may be gone."""
-    global _seen_generation
-    import os
-    client = _kv_client()
-    gen_info = client.get_json("generation", timeout=60.0)
-    if gen_info is None:
-        raise RuntimeError("rendezvous server unreachable during reset")
-    gen = gen_info["generation"]
-    hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
-    local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "0")
-    info = client.get_json(
-        f"rank_and_size/g{gen}/{hostname}/{local_rank}", timeout=60.0)
-    if info is None or info.get("removed"):
-        raise SystemExit(0)  # host removed from the job: exit cleanly
-    _seen_generation = gen
-    for k in ("rank", "size", "local_rank", "local_size", "cross_rank",
-              "cross_size"):
-        if k in info:
-            os.environ[f"HOROVOD_{k.upper()}"] = str(info[k])
-    os.environ["HOROVOD_CONTROLLER_ADDR"] = info["controller_addr"]
-    os.environ["HOROVOD_CONTROLLER_PORT"] = str(info["controller_port"])
-    os.environ["HOROVOD_CONTROLLER_DATA_PORT"] = \
-        str(info["controller_data_port"])
 
 
 def start_notification_poller(interval: float = 1.0):
     """Background thread surfacing driver membership-change notifications
     (reference: WorkerNotificationService/Client,
     runner/elastic/worker.py:31-110 — here a poll of the rendezvous
-    ``notify`` key instead of a push socket)."""
-    global _poller_started, _seen_generation
-    import os
+    ``notify`` key instead of a push socket). Stale announcements — at or
+    below the generation this worker already rendezvoused into — are
+    filtered both here and at the interrupt point."""
+    global _poller_started
     import threading
-    if _poller_started or not os.environ.get("HOROVOD_RENDEZVOUS_ADDR"):
+    from horovod_tpu.runner.elastic import worker as elastic_worker
+    if _poller_started or not elastic_worker.is_elastic_worker():
         return
     _poller_started = True
-    if _seen_generation < 0:
-        _seen_generation = 0
 
     def poll_loop():
+        import time
+        last_notified = -1
         while True:
-            try:
-                client = _kv_client()
-                info = client.get_json("notify", timeout=5.0)
-                if info and info["generation"] > _seen_generation:
-                    notify_hosts_updated()
-            except Exception:  # noqa: BLE001 — rendezvous may be restarting
-                pass
-            import time
+            gen = elastic_worker.poll_notification()
+            if gen is not None and gen > last_notified:
+                last_notified = gen
+                notify_hosts_updated(generation=gen)
             time.sleep(interval)
 
     threading.Thread(target=poll_loop, daemon=True).start()

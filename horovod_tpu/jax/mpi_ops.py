@@ -129,8 +129,21 @@ class EagerExecutor:
             with self._lock:
                 buf = self._inputs.get(names[i])
             if buf is None:
-                # joined rank: participate with zeros of the negotiated shape
-                buf = np.zeros(shapes[i], dtypes[i])
+                # Joined rank: participate with the op's identity so the
+                # result is unaffected — zero *rows* for gather-type ops
+                # (the controller advertises 0 rows for joined ranks in
+                # tensor_sizes; contributing a full-shape buffer would
+                # inject spurious rows), and the reduce op's identity
+                # element for allreduce (zeros poison MIN/MAX/PRODUCT; the
+                # reference zeros-substitution shares that flaw, this
+                # improves on it).
+                if t in ("ALLGATHER", "ALLTOALL"):
+                    buf = np.zeros((0, *shapes[i][1:]), dtypes[i])
+                elif t == "ALLREDUCE":
+                    buf = _identity_buffer(shapes[i], dtypes[i],
+                                           resp["reduce_op"])
+                else:
+                    buf = np.zeros(shapes[i], dtypes[i])
             return buf
 
         if t == "ALLREDUCE":
@@ -193,7 +206,11 @@ class EagerExecutor:
                 if buf.shape[0] % size != 0:
                     return 2
                 splits = [buf.shape[0] // size] * size
-            row_bytes = buf.nbytes // max(buf.shape[0], 1)
+            # derive from trailing dims, not nbytes/rows — a joined rank
+            # contributes 0 rows and its nbytes is 0
+            row_bytes = int(np.prod(shapes[0][1:], dtype=np.int64) *
+                            dtypes[0].itemsize) if shapes[0] else \
+                dtypes[0].itemsize
             send_bytes = (ctypes.c_int64 * size)(
                 *[s * row_bytes for s in splits])
             recv_bytes = (ctypes.c_int64 * size)()
@@ -215,6 +232,33 @@ class EagerExecutor:
             return 0
 
         return 0
+
+
+_FLOAT_DTYPE_NAMES = {"float16", "bfloat16", "float32", "float64"}
+
+
+def _identity_buffer(shape, dtype, reduce_kind: int) -> np.ndarray:
+    """Identity element of the reduce op (joined-rank substitution).
+
+    SUM/AVERAGE/ADASUM: zeros (Adasum's zero-norm guard makes a zero vector
+    combine as identity); MIN: +inf / int max; MAX: -inf / int min;
+    PRODUCT: ones. Engine ReduceKind ids per engine/src/data_plane.h."""
+    dtype = np.dtype(dtype)
+    if reduce_kind == _REDUCE_KIND[Min]:
+        if dtype.name in _FLOAT_DTYPE_NAMES:
+            return np.full(shape, np.inf, dtype)
+        if dtype.name == "bool":
+            return np.ones(shape, dtype)
+        return np.full(shape, np.iinfo(dtype).max, dtype)
+    if reduce_kind == _REDUCE_KIND[Max]:
+        if dtype.name in _FLOAT_DTYPE_NAMES:
+            return np.full(shape, -np.inf, dtype)
+        if dtype.name == "bool":
+            return np.zeros(shape, dtype)
+        return np.full(shape, np.iinfo(dtype).min, dtype)
+    if reduce_kind == _REDUCE_KIND[Product]:
+        return np.ones(shape, dtype)
+    return np.zeros(shape, dtype)
 
 
 def _dtype_name(engine_dtype_id: int) -> str:

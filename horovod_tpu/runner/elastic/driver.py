@@ -23,16 +23,26 @@ from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.runner import hosts as hosts_lib
 from horovod_tpu.runner.elastic.discovery import HostDiscovery, HostManager
+from horovod_tpu.runner.elastic.registration import (
+    READY,
+    SUCCESS,
+    WorkerStateRegistry,
+)
 from horovod_tpu.runner.exec_utils import WorkerProcess
 from horovod_tpu.runner.http_kv import KVServer
 from horovod_tpu.runner.launch import (
     free_port,
+    launcher_addr,
     publish_assignments,
     worker_env,
 )
 
 DISCOVER_INTERVAL_SECS = 1.0
 FAILURES_TO_BLACKLIST = 3
+# Fallback: publish go/g<N> even without full READY after this long, so a
+# worker that dies pre-READY cannot wedge the whole generation (its exit is
+# separately detected and triggers the next rebalance).
+GO_BARRIER_TIMEOUT_SECS = 60.0
 
 
 class ElasticDriver:
@@ -50,11 +60,17 @@ class ElasticDriver:
         self._interval = discover_interval
 
         self._kv = KVServer().start()
+        self._registry = WorkerStateRegistry(self._kv)
         self._generation = -1
         self._prev_host_order: List[str] = []
         self._workers: Dict[Tuple[str, int], WorkerProcess] = {}
         self._host_failures: Dict[str, int] = {}
+        self._removed_slots: set = set()
+        self._expected_slots: List[Tuple[str, int]] = []
+        self._go_deadline: float = 0.0
+        self._go_published: set = set()
         self._lock = threading.Lock()
+        self._rebalance_needed = threading.Event()
         self._shutdown = threading.Event()
         self._result: Optional[int] = None
 
@@ -65,11 +81,14 @@ class ElasticDriver:
         self._rebalance(first=True)
         poller = threading.Thread(target=self._discovery_loop, daemon=True)
         poller.start()
+        barrier = threading.Thread(target=self._go_barrier_loop, daemon=True)
+        barrier.start()
         try:
             return self._wait_for_completion()
         finally:
             self._shutdown.set()
             poller.join(timeout=5)
+            barrier.join(timeout=5)
             for w in self._workers.values():
                 w.terminate()
             self._kv.stop()
@@ -97,15 +116,57 @@ class ElasticDriver:
                 self._log(f"discovery error: {e}")
                 continue
             self._reap_workers()
-            if changed:
+            if changed or self._rebalance_needed.is_set():
                 available = sum(self._hosts.current.values())
                 if available >= self._min_np:
+                    self._rebalance_needed.clear()
                     self._log(f"host set changed: {self._hosts.current}")
                     self._rebalance()
                 else:
                     self._log(
                         f"waiting: only {available} slots available, "
                         f"need {self._min_np}")
+
+    def _go_barrier_loop(self):
+        """Publish go/g<N> once every expected slot of generation N has
+        recorded READY (reference: WorkerStateRegistry barrier,
+        runner/elastic/registration.py:66-135), with a liveness fallback
+        after GO_BARRIER_TIMEOUT_SECS."""
+        reset_handled: set = set()
+        while not self._shutdown.is_set():
+            time.sleep(0.1)
+            with self._lock:
+                gen = self._generation
+                go_out = gen in self._go_published
+                expected = list(self._expected_slots)
+                deadline = self._go_deadline
+            if gen < 0:
+                continue
+            if go_out:
+                # A worker that reset out of this generation (peer failure
+                # without a topology change) asks for a fresh round; grant
+                # it by rebalancing (reference: READY records re-triggering
+                # rendezvous, registration.py:66-135).
+                if gen not in reset_handled and \
+                        self._kv.get_json(f"reset_request/g{gen}"):
+                    reset_handled.add(gen)
+                    self._log(f"worker requested reset out of generation "
+                              f"{gen}; scheduling rebalance")
+                    self._rebalance_needed.set()
+                continue
+            counts = self._registry.count(gen, dict.fromkeys(expected))
+            if counts.get(READY, 0) + counts.get(SUCCESS, 0) >= len(expected):
+                self._log(f"all {len(expected)} slots READY at generation "
+                          f"{gen}; releasing go barrier")
+            elif time.monotonic() > deadline:
+                self._log(f"go-barrier timeout at generation {gen} "
+                          f"({counts}); releasing anyway")
+            else:
+                continue
+            with self._lock:
+                if self._generation == gen:
+                    self._kv.put_json(f"go/g{gen}", {"ts": time.time()})
+                    self._go_published.add(gen)
 
     def _rebalance(self, first: bool = False):
         with self._lock:
@@ -133,6 +194,7 @@ class ElasticDriver:
                 if controller_host == "localhost" else controller_host
             controller_port = free_port()
             data_port = free_port()
+            rdv_addr = launcher_addr([s.hostname for s in slots])
             publish_assignments(self._kv, slots, controller_addr,
                                 controller_port, data_port, generation=gen)
             # mark slots no longer present as removed so resetting workers
@@ -144,17 +206,26 @@ class ElasticDriver:
                     self._kv.put_json(
                         f"rank_and_size/g{gen}/{key[0]}/{key[1]}",
                         {"removed": True})
-            # notify running workers (polled inside the training process)
+                    self._removed_slots.add(key)
+            # arm the READY/go barrier for this generation, then notify
+            # running workers (polled inside the training process)
+            self._expected_slots = [(s.hostname, s.local_rank)
+                                    for s in slots]
+            self._go_deadline = time.monotonic() + GO_BARRIER_TIMEOUT_SECS
             self._kv.put_json("notify", {"generation": gen})
             # spawn workers for slots that have no live process
             for s in slots:
                 key = (s.hostname, s.local_rank)
+                # a slot in the new assignment is no longer "removed", even
+                # if its (re-included) process never observed the removal
+                self._removed_slots.discard(key)
                 w = self._workers.get(key)
                 if w is not None and w.poll() is None:
                     continue
                 env = worker_env(s, controller_addr, controller_port,
                                  data_port, self._kv.port, self._extra_env,
-                                 elastic=True)
+                                 elastic=True, generation=gen,
+                                 rendezvous_addr=rdv_addr)
                 self._log(f"spawning worker {key} (generation {gen})")
                 self._workers[key] = WorkerProcess(
                     s.hostname, s.rank, self._command, env)
@@ -167,6 +238,13 @@ class ElasticDriver:
                     continue
                 host, local_rank = key
                 if code == 0:
+                    if key in self._removed_slots:
+                        # a slot dropped by a scale-down exits cleanly; it
+                        # is not a job-completion signal
+                        self._log(f"removed worker {key} exited")
+                        del self._workers[key]
+                        self._removed_slots.discard(key)
+                        continue
                     self._log(f"worker {key} finished successfully")
                     self._result = 0 if self._result is None else self._result
                     self._shutdown.set()
@@ -178,8 +256,10 @@ class ElasticDriver:
                 if self._host_failures[host] >= FAILURES_TO_BLACKLIST:
                     self._log(f"blacklisting {host}")
                     self._hosts.blacklist(host)
-                # force a rebalance on next tick by clearing current view
-                self._hosts.current = {}
+                # request an explicit rebalance (respawns the dead slot at a
+                # fresh generation); replaces the prior hack of clearing the
+                # discovery view, which raced with the discovery thread
+                self._rebalance_needed.set()
 
     def _wait_for_completion(self) -> int:
         while not self._shutdown.is_set():

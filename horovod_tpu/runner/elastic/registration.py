@@ -20,12 +20,18 @@ SUCCESS = "SUCCESS"
 FAILURE = "FAILURE"
 
 
+def state_key(generation: int, hostname, local_rank) -> str:
+    """KV key for a slot's state record — the single definition shared by
+    the worker (PUT side) and the driver's registry (poll side)."""
+    return f"worker_state/g{generation}/{hostname}/{local_rank}"
+
+
 class WorkerStateRegistry:
     def __init__(self, kv_server):
         self._kv = kv_server
 
     def key(self, generation: int, hostname: str, local_rank: int) -> str:
-        return f"worker_state/g{generation}/{hostname}/{local_rank}"
+        return state_key(generation, hostname, local_rank)
 
     def record(self, generation: int, hostname: str, local_rank: int,
                state: str):
@@ -41,6 +47,6 @@ class WorkerStateRegistry:
               slots: Dict[Tuple[str, int], None]) -> Dict[str, int]:
         counts = {READY: 0, SUCCESS: 0, FAILURE: 0, None: 0}
         for (host, local_rank) in slots:
-            counts[self.get(generation, host, local_rank)] = \
-                counts.get(self.get(generation, host, local_rank), 0) + 1
+            state = self.get(generation, host, local_rank)
+            counts[state] = counts.get(state, 0) + 1
         return counts

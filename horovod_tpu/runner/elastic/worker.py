@@ -1,0 +1,171 @@
+"""Worker-side elastic rendezvous: generation sync + READY/go barrier.
+
+Reference analog: horovod/runner/elastic/worker.py (WorkerNotificationClient
+side) + horovod/common/gloo/gloo_context.cc:154-200 (the re-init scope query
+on reset). Here both the freshly-spawned and the resetting worker go through
+the same handshake against the driver's rendezvous KV:
+
+1. read the driver's current ``generation`` key,
+2. fetch this slot's topology ``rank_and_size/g<GEN>/<host>/<local_rank>``
+   (exit cleanly if the slot was removed),
+3. record READY in the worker-state registry
+   (``worker_state/g<GEN>/<host>/<slot>``, reference:
+   runner/elastic/registration.py:66-135),
+4. wait for the driver's ``go/g<GEN>`` key — published once every expected
+   slot of the generation is READY — re-looping from (1) if the generation
+   advances while waiting.
+
+This barrier is what makes elastic resets deterministic: no worker can
+initialize a generation that the driver is about to supersede, and the new
+coordinator is only contacted once every peer has committed to the same
+generation.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+from horovod_tpu.runner.elastic.registration import (  # noqa: F401
+    FAILURE,
+    READY,
+    SUCCESS,
+    state_key,
+)
+
+
+def kv_client():
+    from horovod_tpu.runner.http_kv import KVClient
+    return KVClient(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+                    int(os.environ["HOROVOD_RENDEZVOUS_PORT"]))
+
+
+def is_elastic_worker() -> bool:
+    """True when this process was spawned by the elastic driver."""
+    return (os.environ.get("HOROVOD_ELASTIC") == "1"
+            and bool(os.environ.get("HOROVOD_RENDEZVOUS_ADDR")))
+
+
+def current_generation() -> int:
+    """The topology generation this worker last rendezvoused into."""
+    return int(os.environ.get("HOROVOD_ELASTIC_GENERATION", "0"))
+
+
+def _slot() -> Tuple[str, str]:
+    return (os.environ.get("HOROVOD_HOSTNAME", "localhost"),
+            os.environ.get("HOROVOD_LOCAL_RANK", "0"))
+
+
+def record_state(generation: int, state: str, client=None):
+    """Record READY/SUCCESS/FAILURE for this slot (registry PUT side)."""
+    host, local_rank = _slot()
+    (client or kv_client()).put_json(
+        state_key(generation, host, local_rank),
+        {"state": state, "ts": time.time()})
+
+
+def request_new_generation():
+    """Mark that the next rendezvous must land on a strictly newer
+    generation than the one this worker is leaving.
+
+    Called on elastic reset after a HorovodInternalError: the generation
+    this worker crashed out of may still be the driver's current one (its
+    ``go`` already published), and rejoining it would re-init against a
+    topology that includes the dead peer. The pending minimum makes
+    ``rendezvous()`` ask the driver for a fresh round instead (reference:
+    WorkerStateRegistry READY records triggering a new rendezvous,
+    runner/elastic/registration.py:66-135)."""
+    os.environ["HOROVOD_ELASTIC_MIN_GENERATION"] = \
+        str(current_generation() + 1)
+
+
+def rendezvous(timeout: float = 300.0) -> int:
+    """Synchronize this slot with the driver's current generation.
+
+    Applies the fetched topology to the ``HOROVOD_*`` env (so a subsequent
+    ``init()`` picks it up) and returns the generation joined. Raises
+    SystemExit(0) if this slot was removed from the job, RuntimeError if the
+    rendezvous server is unreachable or the barrier times out.
+    """
+    client = kv_client()
+    host, local_rank = _slot()
+    min_gen = int(os.environ.get("HOROVOD_ELASTIC_MIN_GENERATION", "0"))
+    deadline = time.monotonic() + timeout
+    while True:
+        gen_info = client.get_json("generation", timeout=60.0)
+        if gen_info is None:
+            raise RuntimeError(
+                "rendezvous server unreachable during elastic rendezvous")
+        gen = gen_info["generation"]
+        if gen < min_gen:
+            # ask the driver for a fresh round (it rebalances on seeing the
+            # request) and wait for the generation to advance
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"driver never advanced past generation {gen} "
+                    f"(need >= {min_gen})")
+            client.put_json(f"reset_request/g{gen}",
+                            {"slot": f"{host}/{local_rank}",
+                             "ts": time.time()})
+            time.sleep(0.3)
+            continue
+        info = client.get_json(f"rank_and_size/g{gen}/{host}/{local_rank}",
+                               timeout=30.0)
+        if info is None:
+            # Generation published without this slot: either we were dropped
+            # (the driver marks removed slots explicitly) or the driver is
+            # mid-publish; re-read the generation and retry briefly.
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no topology for slot {host}/{local_rank} at "
+                    f"generation {gen}")
+            time.sleep(0.2)
+            continue
+        if info.get("removed"):
+            raise SystemExit(0)  # host removed from the job: exit cleanly
+        record_state(gen, READY, client)
+        joined = _wait_go(client, gen, deadline)
+        if joined:
+            _apply_env(gen, info)
+            os.environ.pop("HOROVOD_ELASTIC_MIN_GENERATION", None)
+            return gen
+        # generation advanced while waiting — re-rendezvous
+
+
+def _wait_go(client, gen: int, deadline: float) -> bool:
+    """Wait for go/g<gen>; False if the generation advances first."""
+    while True:
+        if client.get_json(f"go/g{gen}", timeout=1.0) is not None:
+            return True
+        cur = client.get_json("generation", timeout=1.0)
+        if cur is not None and cur["generation"] > gen:
+            return False
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"elastic go-barrier timed out at generation {gen}")
+
+
+def _apply_env(gen: int, info: dict):
+    for k in ("rank", "size", "local_rank", "local_size", "cross_rank",
+              "cross_size"):
+        if k in info:
+            os.environ[f"HOROVOD_{k.upper()}"] = str(info[k])
+    os.environ["HOROVOD_CONTROLLER_ADDR"] = info["controller_addr"]
+    os.environ["HOROVOD_CONTROLLER_PORT"] = str(info["controller_port"])
+    os.environ["HOROVOD_CONTROLLER_DATA_PORT"] = \
+        str(info["controller_data_port"])
+    os.environ["HOROVOD_ELASTIC_GENERATION"] = str(gen)
+
+
+def poll_notification(client=None) -> Optional[int]:
+    """Return the driver's announced generation if it is newer than the one
+    this worker rendezvoused into (reference: WorkerNotificationService push,
+    here a poll of the ``notify`` key)."""
+    try:
+        info = (client or kv_client()).get_json("notify", timeout=5.0)
+    except Exception:  # noqa: BLE001 — rendezvous may be restarting
+        return None
+    if info and info["generation"] > current_generation():
+        return info["generation"]
+    return None

@@ -104,19 +104,43 @@ def publish_assignments(kv: KVServer, slots, controller_addr: str,
     kv.put_json("generation", {"generation": generation})
 
 
+def launcher_addr(hostnames) -> str:
+    """Address workers use to reach the launcher's rendezvous KV server.
+
+    The KV server runs in the *launcher* process — not on the first slot's
+    host — so multi-host jobs must be given the launcher's reachable address,
+    not the controller's. Resolved via the UDP-connect trick toward a worker
+    host (reference: the driver-service NIC probe picks a routable interface,
+    runner/driver/driver_service.py:162-258 — getfqdn() is often
+    unresolvable or loopback-mapped from remote hosts)."""
+    remote = [h for h in hostnames if h not in ("localhost", "127.0.0.1")]
+    if not remote:
+        return "127.0.0.1"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((remote[0], 9))  # no traffic sent; just routes
+        return s.getsockname()[0]
+    except OSError:
+        return socket.getfqdn()
+    finally:
+        s.close()
+
+
 def worker_env(slot, controller_addr, controller_port, data_port,
-               kv_port, extra, elastic=False) -> dict:
+               kv_port, extra, elastic=False, generation=0,
+               rendezvous_addr=None) -> dict:
     env = slot.to_env()
     env.update(extra)
     env.update({
         "HOROVOD_CONTROLLER_ADDR": controller_addr,
         "HOROVOD_CONTROLLER_PORT": str(controller_port),
         "HOROVOD_CONTROLLER_DATA_PORT": str(data_port),
-        "HOROVOD_RENDEZVOUS_ADDR": controller_addr,
+        "HOROVOD_RENDEZVOUS_ADDR": rendezvous_addr or controller_addr,
         "HOROVOD_RENDEZVOUS_PORT": str(kv_port),
     })
     if elastic:
         env["HOROVOD_ELASTIC"] = "1"
+        env["HOROVOD_ELASTIC_GENERATION"] = str(generation)
     # Workers must not grab a single-tenant accelerator relay the launcher
     # process may own; training scripts opt in explicitly.
     env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -138,10 +162,11 @@ def run_static(args) -> int:
         publish_assignments(kv, slots, controller_addr, controller_port,
                             data_port)
         extra = _engine_env(args)
+        rdv_addr = launcher_addr([s.hostname for s in slots])
         workers: List[WorkerProcess] = []
         for s in slots:
             env = worker_env(s, controller_addr, controller_port, data_port,
-                             kv.port, extra)
+                             kv.port, extra, rendezvous_addr=rdv_addr)
             workers.append(WorkerProcess(s.hostname, s.rank, args.command,
                                          env))
         return _wait_all(workers)

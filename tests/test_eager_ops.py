@@ -228,5 +228,60 @@ def test_local_fallback_without_engine():
         h = mpi_ops.allreduce_async(x, op=Sum)
         assert mpi_ops.poll(h)
         np.testing.assert_allclose(synchronize(h), x)
+        # metric_average on a concrete host value must take the eager path
+        # (not raise an unbound-axis error from the in-jit collective)
+        import horovod_tpu.jax as hvd_jax
+        np.testing.assert_allclose(
+            np.asarray(hvd_jax.metric_average(3.5)), 3.5)
     finally:
         hvd.shutdown()
+
+
+def test_join_identity_minmax_product(ring):
+    """A joined rank participates with the reduce op's *identity* — MIN/MAX/
+    PRODUCT results are unaffected by the joined rank (improves on the
+    reference's zeros substitution, operations.cc:1166-1190, which poisons
+    these ops)."""
+    def fn(r, ex):
+        if r == 3:
+            h = ex.session.join()
+            ex.session.wait(h, timeout=15.0)
+            return None
+        outs = {}
+        for nm, op in (("jmin", Min), ("jmax", Max), ("jprod", Product)):
+            x = np.asarray([r + 1.0, -(r + 1.0)], np.float32)
+            outs[nm] = submit_wait(ex, nm, _OP_ALLREDUCE, x, reduce_op=op)
+        ex.session.wait(ex.session.join(), timeout=15.0)
+        return outs
+
+    outs = run_all(ring, fn)
+    active = [np.asarray([r + 1.0, -(r + 1.0)], np.float32)
+              for r in range(3)]
+    for r in range(3):
+        np.testing.assert_allclose(outs[r]["jmin"],
+                                   np.min(active, axis=0))
+        np.testing.assert_allclose(outs[r]["jmax"],
+                                   np.max(active, axis=0))
+        np.testing.assert_allclose(outs[r]["jprod"],
+                                   np.prod(active, axis=0))
+
+
+def test_join_allgather_zero_rows(ring):
+    """A joined rank contributes zero rows to allgather — no spurious
+    zero-filled rows appear in any rank's output."""
+    def fn(r, ex):
+        if r == 2:
+            h = ex.session.join()
+            ex.session.wait(h, timeout=15.0)
+            return None
+        x = np.full((r + 1, 3), float(r), np.float32)
+        out = submit_wait(ex, "jgather", _OP_ALLGATHER, x)
+        ex.session.wait(ex.session.join(), timeout=15.0)
+        return out
+
+    outs = run_all(ring, fn)
+    expected = np.concatenate([np.full((r + 1, 3), float(r), np.float32)
+                               for r in range(N) if r != 2])
+    for r in range(N):
+        if r != 2:
+            np.testing.assert_allclose(outs[r], expected)

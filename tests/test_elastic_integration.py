@@ -50,6 +50,44 @@ ELASTIC_TRAIN = textwrap.dedent("""
 """)
 
 
+class _StreamingJob:
+    """Launcher subprocess with live output capture, so mid-run events
+    (host add, worker kill) trigger on observed progress instead of racing
+    a fixed sleep against JAX import time."""
+
+    def __init__(self, proc):
+        self.proc = proc
+        self.lines = []
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            with self._cond:
+                self.lines.append(line.decode(errors="replace"))
+                self._cond.notify_all()
+
+    def wait_for_line(self, needle: str, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        scanned = 0
+        with self._cond:
+            while True:
+                for line in self.lines[scanned:]:
+                    if needle in line:
+                        return True
+                scanned = len(self.lines)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self.proc.poll() is not None:
+                    return False
+                self._cond.wait(timeout=min(remaining, 1.0))
+
+    def finish(self, timeout: float) -> str:
+        self.proc.wait(timeout=timeout)
+        self._thread.join(timeout=10)
+        return "".join(self.lines)
+
+
 def _launch_elastic(tmp_path, hosts_file_content, min_np, max_np,
                     total_steps=30):
     hosts_file = tmp_path / "hosts.txt"
@@ -71,25 +109,19 @@ def _launch_elastic(tmp_path, hosts_file_content, min_np, max_np,
          "--", sys.executable, str(train.resolve())],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-    return proc, hosts_file
+    return _StreamingJob(proc), hosts_file
 
 
 def test_elastic_scale_up(tmp_path):
     """Start with 2 slots, add a third mid-run: workers reset, the new
     worker syncs committed state, training finishes at size 3."""
-    proc, hosts_file = _launch_elastic(tmp_path, "localhost:2\n",
-                                       min_np=2, max_np=3, total_steps=40)
-
-    def add_host():
-        time.sleep(4.0)
-        hosts_file.write_text("localhost:3\n")
-
-    t = threading.Thread(target=add_host)
-    t.start()
-    out, _ = proc.communicate(timeout=180)
-    t.join()
-    text = out.decode()
-    assert proc.returncode == 0, text
+    job, hosts_file = _launch_elastic(tmp_path, "localhost:2\n",
+                                      min_np=2, max_np=3, total_steps=40)
+    assert job.wait_for_line("step=2 size=2", timeout=90), \
+        "".join(job.lines)
+    hosts_file.write_text("localhost:3\n")
+    text = job.finish(timeout=180)
+    assert job.proc.returncode == 0, text
     assert "size=2" in text, text
     assert "size=3" in text, f"never scaled up:\n{text}"
     done = [line for line in text.splitlines() if "worker-done" in line]
@@ -99,34 +131,24 @@ def test_elastic_scale_up(tmp_path):
     rank2_steps = [int(line.split("step=")[1].split()[0])
                    for line in text.splitlines()
                    if "progress rank=2" in line]
-    if rank2_steps:
-        assert rank2_steps[0] > 0, (
-            f"new worker restarted from step 0:\n{text}")
+    assert rank2_steps, f"rank 2 never made progress:\n{text}"
+    assert rank2_steps[0] > 0, (
+        f"new worker restarted from step 0:\n{text}")
 
 
 def test_elastic_worker_failure_recovers(tmp_path):
     """Kill one worker mid-run: peers restore committed state, the driver
     respawns the slot, training completes."""
-    proc, hosts_file = _launch_elastic(tmp_path, "localhost:2\n",
-                                       min_np=2, max_np=2, total_steps=40)
-
-    killed = {}
-
-    def kill_one():
-        time.sleep(5.0)
-        # find a worker: children of launcher running train.py
-        out = subprocess.run(
-            ["pgrep", "-f", "train.py"], capture_output=True, text=True)
-        pids = [int(p) for p in out.stdout.split()]
-        if pids:
-            os.kill(pids[-1], 9)
-            killed["pid"] = pids[-1]
-
-    t = threading.Thread(target=kill_one)
-    t.start()
-    out, _ = proc.communicate(timeout=180)
-    t.join()
-    text = out.decode()
-    assert killed, "did not find a worker to kill"
-    assert proc.returncode == 0, text
+    job, hosts_file = _launch_elastic(tmp_path, "localhost:2\n",
+                                      min_np=2, max_np=2, total_steps=40)
+    assert job.wait_for_line("step=2 size=2", timeout=90), \
+        "".join(job.lines)
+    # find a worker: children of launcher running train.py
+    out = subprocess.run(
+        ["pgrep", "-f", "train.py"], capture_output=True, text=True)
+    pids = [int(p) for p in out.stdout.split()]
+    assert pids, "did not find a worker to kill"
+    os.kill(pids[-1], 9)
+    text = job.finish(timeout=180)
+    assert job.proc.returncode == 0, text
     assert "worker-done" in text, text
