@@ -1,0 +1,194 @@
+#include "bayes_opt.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvdtpu {
+
+namespace {
+
+// Standard normal pdf / cdf for expected improvement.
+double NormPdf(double z) {
+  static const double kInvSqrt2Pi = 0.3989422804014327;
+  return kInvSqrt2Pi * std::exp(-0.5 * z * z);
+}
+
+double NormCdf(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+
+}  // namespace
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-d2 / (2.0 * length_scale_ * length_scale_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& xs,
+                          const std::vector<double>& ys, double length_scale,
+                          double noise) {
+  xs_ = xs;
+  length_scale_ = length_scale;
+  const size_t n = xs.size();
+  // K + noise*I
+  std::vector<std::vector<double>> k(n, std::vector<double>(n));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double v = Kernel(xs[i], xs[j]);
+      k[i][j] = v;
+      k[j][i] = v;
+    }
+    k[i][i] += noise;
+  }
+  // Cholesky: K = L L^T
+  chol_.assign(n, std::vector<double>(n, 0.0));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double sum = k[i][j];
+      for (size_t p = 0; p < j; ++p) sum -= chol_[i][p] * chol_[j][p];
+      if (i == j) {
+        chol_[i][i] = std::sqrt(std::max(sum, 1e-12));
+      } else {
+        chol_[i][j] = sum / chol_[j][j];
+      }
+    }
+  }
+  // alpha = K^-1 y via two triangular solves.
+  std::vector<double> tmp(n);
+  for (size_t i = 0; i < n; ++i) {
+    double sum = ys[i];
+    for (size_t p = 0; p < i; ++p) sum -= chol_[i][p] * tmp[p];
+    tmp[i] = sum / chol_[i][i];
+  }
+  alpha_.assign(n, 0.0);
+  for (size_t ii = n; ii-- > 0;) {
+    double sum = tmp[ii];
+    for (size_t p = ii + 1; p < n; ++p) sum -= chol_[p][ii] * alpha_[p];
+    alpha_[ii] = sum / chol_[ii][ii];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* variance) const {
+  const size_t n = xs_.size();
+  std::vector<double> kstar(n);
+  for (size_t i = 0; i < n; ++i) kstar[i] = Kernel(x, xs_[i]);
+  double mu = 0;
+  for (size_t i = 0; i < n; ++i) mu += kstar[i] * alpha_[i];
+  // v = L^-1 kstar; var = k(x,x) - v.v
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; ++i) {
+    double sum = kstar[i];
+    for (size_t p = 0; p < i; ++p) sum -= chol_[i][p] * v[p];
+    v[i] = sum / chol_[i][i];
+  }
+  double var = 1.0;  // k(x,x) = 1 for RBF
+  for (size_t i = 0; i < n; ++i) var -= v[i] * v[i];
+  *mean = mu;
+  *variance = std::max(var, 1e-12);
+}
+
+BayesianOptimizer::BayesianOptimizer(int dim, uint64_t seed)
+    : dim_(dim), rng_state_(seed ? seed : 1) {}
+
+double BayesianOptimizer::NextHalton(int index, int base) const {
+  double f = 1.0, r = 0.0;
+  while (index > 0) {
+    f /= base;
+    r += f * (index % base);
+    index /= base;
+  }
+  return r;
+}
+
+void BayesianOptimizer::AddSample(const std::vector<double>& x, double y) {
+  xs_.push_back(x);
+  ys_.push_back(y);
+}
+
+std::vector<double> BayesianOptimizer::BestPoint() const {
+  if (ys_.empty()) return {};
+  size_t best = 0;
+  for (size_t i = 1; i < ys_.size(); ++i) {
+    if (ys_[i] > ys_[best]) best = i;
+  }
+  return xs_[best];
+}
+
+double BayesianOptimizer::BestValue() const {
+  if (ys_.empty()) return 0.0;
+  return *std::max_element(ys_.begin(), ys_.end());
+}
+
+std::vector<double> BayesianOptimizer::Suggest() {
+  static const int kPrimes[] = {2, 3, 5, 7, 11, 13};
+  // Cold start: space-fill with the Halton sequence until we have enough
+  // samples for a useful surrogate (reference seeds its GP the same way).
+  if (ys_.size() < 3) {
+    std::vector<double> x(dim_);
+    for (int d = 0; d < dim_; ++d) {
+      x[d] = NextHalton(halton_index_, kPrimes[d % 6]);
+    }
+    ++halton_index_;
+    return x;
+  }
+  // Normalize y to zero mean / unit variance for GP stability.
+  double mean = 0;
+  for (double y : ys_) mean += y;
+  mean /= ys_.size();
+  double var = 0;
+  for (double y : ys_) var += (y - mean) * (y - mean);
+  var = std::sqrt(std::max(var / ys_.size(), 1e-12));
+  std::vector<double> yn(ys_.size());
+  for (size_t i = 0; i < ys_.size(); ++i) yn[i] = (ys_[i] - mean) / var;
+  double ybest = *std::max_element(yn.begin(), yn.end());
+
+  GaussianProcess gp;
+  gp.Fit(xs_, yn, /*length_scale=*/0.25, /*noise=*/1e-3);
+
+  // Candidates: Halton space fill + jitter around the incumbent.
+  auto xorshift = [this]() {
+    rng_state_ ^= rng_state_ << 13;
+    rng_state_ ^= rng_state_ >> 7;
+    rng_state_ ^= rng_state_ << 17;
+    return (rng_state_ >> 11) * (1.0 / 9007199254740992.0);
+  };
+  std::vector<std::vector<double>> cands;
+  for (int c = 0; c < 256; ++c) {
+    std::vector<double> x(dim_);
+    for (int d = 0; d < dim_; ++d) {
+      x[d] = NextHalton(halton_index_, kPrimes[d % 6]);
+    }
+    ++halton_index_;
+    cands.push_back(std::move(x));
+  }
+  auto inc = BestPoint();
+  for (int c = 0; c < 64; ++c) {
+    std::vector<double> x(dim_);
+    for (int d = 0; d < dim_; ++d) {
+      x[d] = std::min(1.0, std::max(0.0, inc[d] + 0.1 * (xorshift() - 0.5)));
+    }
+    cands.push_back(std::move(x));
+  }
+
+  const double xi = 0.01;  // exploration margin
+  double best_ei = -1.0;
+  std::vector<double> best_x = inc;
+  for (const auto& x : cands) {
+    double mu, v;
+    gp.Predict(x, &mu, &v);
+    double sigma = std::sqrt(v);
+    double z = (mu - ybest - xi) / sigma;
+    double ei = (mu - ybest - xi) * NormCdf(z) + sigma * NormPdf(z);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_x = x;
+    }
+  }
+  return best_x;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,66 @@
+// Bayesian optimization for the parameter autotuner.
+//
+// Reference analog: horovod/common/optim/{bayesian_optimization,
+// gaussian_process}.{h,cc} — a Gaussian-process surrogate with an
+// expected-improvement acquisition. The reference maximizes EI with LBFGS
+// over Eigen matrices; this build evaluates EI on a low-discrepancy
+// candidate set in the unit cube and takes the argmax — same surrogate and
+// acquisition, no vendored solver.
+
+#ifndef HVD_TPU_BAYES_OPT_H
+#define HVD_TPU_BAYES_OPT_H
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace hvdtpu {
+
+// GP regression with an RBF kernel over [0,1]^d inputs.
+class GaussianProcess {
+ public:
+  // length_scale: RBF kernel width in normalized input space; noise: iid
+  // observation noise added to the kernel diagonal.
+  void Fit(const std::vector<std::vector<double>>& xs,
+           const std::vector<double>& ys, double length_scale, double noise);
+  // Posterior mean and variance at x. Requires Fit() first.
+  void Predict(const std::vector<double>& x, double* mean,
+               double* variance) const;
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  std::vector<std::vector<double>> xs_;
+  std::vector<std::vector<double>> chol_;  // lower Cholesky of K + noise*I
+  std::vector<double> alpha_;              // (K + noise*I)^-1 y
+  double length_scale_ = 0.2;
+};
+
+// Maximizes an unknown function over [0,1]^dim from noisy samples.
+class BayesianOptimizer {
+ public:
+  explicit BayesianOptimizer(int dim, uint64_t seed = 12345);
+
+  void AddSample(const std::vector<double>& x, double y);
+  // Next point to evaluate: argmax of expected improvement over a Halton
+  // candidate set (plus local jitter around the incumbent).
+  std::vector<double> Suggest();
+  // Best observed point so far (empty before any sample).
+  std::vector<double> BestPoint() const;
+  double BestValue() const;
+  size_t num_samples() const { return ys_.size(); }
+
+ private:
+  double NextHalton(int index, int base) const;
+
+  int dim_;
+  uint64_t rng_state_;
+  int halton_index_ = 1;
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_BAYES_OPT_H

@@ -6,6 +6,7 @@
 // real multi-process harness for this; SURVEY §7.2 calls out the
 // single-process N-rank testability win).
 
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -60,6 +61,24 @@ int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,
   opts.stall_check_disable = stall_check_disable != 0;
   if (timeline_path != nullptr) opts.timeline_path = timeline_path;
   opts.timeline_mark_cycles = timeline_mark_cycles != 0;
+
+  // Autotune knobs come straight from env (reference parses these in C++
+  // too, operations.cc:521-530 + utils/env_parser).
+  const char* at = std::getenv("HOROVOD_AUTOTUNE");
+  opts.autotune = at != nullptr && std::strcmp(at, "0") != 0 &&
+                  std::strcmp(at, "") != 0;
+  if (const char* v = std::getenv("HOROVOD_AUTOTUNE_LOG")) {
+    opts.autotune_log_path = v;
+  }
+  if (const char* v = std::getenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES")) {
+    opts.autotune_warmup_samples = std::atoi(v);
+  }
+  if (const char* v = std::getenv("HOROVOD_AUTOTUNE_STEPS")) {
+    opts.autotune_steps = std::atoi(v);
+  }
+  if (const char* v = std::getenv("HOROVOD_AUTOTUNE_SAMPLE_CYCLES")) {
+    opts.autotune_sample_cycles = std::atoi(v);
+  }
 
   TransportConfig tcfg;
   tcfg.kind = transport_kind ? transport_kind : "loopback";

@@ -147,6 +147,11 @@ struct EngineOptions {
   std::string timeline_path;               // HOROVOD_TIMELINE
   bool timeline_mark_cycles = false;       // HOROVOD_TIMELINE_MARK_CYCLES
   bool elastic = false;                    // HOROVOD_ELASTIC
+  bool autotune = false;                   // HOROVOD_AUTOTUNE
+  std::string autotune_log_path;           // HOROVOD_AUTOTUNE_LOG
+  int autotune_warmup_samples = 3;         // HOROVOD_AUTOTUNE_WARMUP_SAMPLES
+  int autotune_steps = 30;                 // HOROVOD_AUTOTUNE_STEPS
+  int autotune_sample_cycles = 10;         // HOROVOD_AUTOTUNE_SAMPLE_CYCLES
 };
 
 }  // namespace hvdtpu

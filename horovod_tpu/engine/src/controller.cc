@@ -72,6 +72,8 @@ Controller::Controller(std::shared_ptr<ControllerTransport> transport,
   stall_.set_warning_time_sec(opts_.stall_warning_time_sec);
   stall_.set_shutdown_time_sec(opts_.stall_shutdown_time_sec);
   stall_.set_disabled(opts_.stall_check_disable);
+  pm_.Initialize(opts_, /*is_coordinator=*/transport_->rank() == 0);
+  autotune_sync_ = opts_.autotune;
 }
 
 bool Controller::IncrementTensorCount(const Request& msg, int joined_count) {
@@ -495,6 +497,55 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
   out->responses.shutdown = any_shutdown;
   out->join_completed = join_completed;
   out->should_shut_down = any_shutdown;
+
+  if (autotune_sync_) {
+    auto pst = SynchronizeParameters(out);
+    if (!pst.ok()) return pst;
+  }
+  return Status::OK();
+}
+
+Status Controller::SynchronizeParameters(CycleOutput* out) {
+  // Coordinator scores the cycle, maybe adopts a new configuration, then
+  // broadcasts its current params; all ranks apply the same record
+  // (reference: parameter_manager Update/Tune + controller.cc:40-53).
+  if (rank() == 0) {
+    // Score every data-bearing response type — an allgather/broadcast-
+    // dominated workload must still advance (and eventually finish) tuning.
+    int64_t bytes = 0;
+    for (const auto& r : out->responses.responses) {
+      switch (r.type) {
+        case Response::Type::ALLREDUCE:
+        case Response::Type::ALLGATHER:
+        case Response::Type::BROADCAST:
+        case Response::Type::ALLTOALL:
+          bytes += ResponseBytes(r);
+          break;
+        default:
+          break;
+      }
+    }
+    pm_.RecordCycle(bytes);
+  }
+  std::string payload;
+  if (rank() == 0) pm_.Current().SerializeTo(&payload);
+  auto st = transport_->Bcast(&payload);
+  if (!st.ok()) return st;
+  TunedParams p = TunedParams::Deserialize(payload);
+  if (rank() != 0) pm_.SetCurrent(p);
+  opts_.fusion_threshold_bytes = p.fusion_threshold_bytes;
+  if ((p.cache_enabled != 0) != opts_.cache_enabled) {
+    opts_.cache_enabled = p.cache_enabled != 0;
+    cache_.set_capacity(opts_.cache_enabled ? opts_.cache_capacity : 0);
+    if (!opts_.cache_enabled) cache_.Clear();
+    // all ranks flip at the same cycle boundary (this runs after the same
+    // broadcast everywhere), so the coordination bit-vector layout stays
+    // consistent; anything riding the fast path re-announces slow-path
+    for (auto& m : cached_pending_) uncached_pending_.push_back(m);
+    cached_pending_.clear();
+  }
+  out->tuned_cycle_time_ms = p.cycle_time_ms;
+  if (!p.tuning_active) autotune_sync_ = false;
   return Status::OK();
 }
 

@@ -33,6 +33,7 @@
 
 #include "common.h"
 #include "message.h"
+#include "parameter_manager.h"
 #include "response_cache.h"
 #include "stall_inspector.h"
 #include "timeline.h"
@@ -55,6 +56,8 @@ class Controller {
     ResponseList responses;
     bool join_completed = false;
     bool should_shut_down = false;
+    // Autotuner decision for the engine's loop pacing; 0 = unchanged.
+    double tuned_cycle_time_ms = 0;
   };
 
   Status RunCycle(const CycleInput& in, CycleOutput* out);
@@ -64,6 +67,7 @@ class Controller {
 
   StallInspector& stall_inspector() { return stall_; }
   ResponseCache& response_cache() { return cache_; }
+  ParameterManager& parameter_manager() { return pm_; }
 
  private:
   // Rank-0 bookkeeping of how many ranks announced each tensor.
@@ -83,11 +87,19 @@ class Controller {
   void FuseResponses(std::vector<Response>* responses);
   int64_t ResponseBytes(const Response& r) const;
 
+  // Autotune synchronization: broadcast the coordinator's current params
+  // each cycle while tuning is live (reference: controller.cc:40-53
+  // SynchronizeParameters); all ranks stop together on the broadcast that
+  // carries tuning_active=0.
+  Status SynchronizeParameters(CycleOutput* out);
+
   std::shared_ptr<ControllerTransport> transport_;
   EngineOptions opts_;
   Timeline* timeline_;
   ResponseCache cache_;
   StallInspector stall_;
+  ParameterManager pm_;
+  bool autotune_sync_ = false;
 
   // Tensors that hit cache and wait for the common bit (order-preserving).
   std::deque<Request> cached_pending_;

@@ -314,6 +314,9 @@ void Engine::BackgroundLoopImpl() {
     for (const auto& response : out.responses.responses) {
       PerformOperation(response);
     }
+    if (out.tuned_cycle_time_ms > 0) {
+      opts_.cycle_time_ms = out.tuned_cycle_time_ms;  // autotuner pacing
+    }
     if (out.join_completed && join_pending_.load()) {
       join_pending_.store(false);
       handles_.MarkDone(join_handle_, "");

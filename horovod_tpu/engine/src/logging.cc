@@ -1,0 +1,84 @@
+#include "logging.h"
+
+#include <cctype>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+namespace hvdtpu {
+
+namespace {
+
+LogLevel ParseLevel() {
+  const char* env = std::getenv("HOROVOD_LOG_LEVEL");
+  if (env == nullptr) return LogLevel::LOG_WARNING;
+  std::string s(env);
+  for (auto& c : s) {
+    c = static_cast<char>(std::tolower(static_cast<unsigned char>(c)));
+  }
+  if (s == "trace") return LogLevel::LOG_TRACE;
+  if (s == "debug") return LogLevel::LOG_DEBUG;
+  if (s == "info") return LogLevel::LOG_INFO;
+  if (s == "warning" || s == "warn") return LogLevel::LOG_WARNING;
+  if (s == "error") return LogLevel::LOG_ERROR;
+  if (s == "fatal") return LogLevel::LOG_FATAL;
+  return LogLevel::LOG_WARNING;
+}
+
+const char* LevelName(LogLevel l) {
+  switch (l) {
+    case LogLevel::LOG_TRACE: return "trace";
+    case LogLevel::LOG_DEBUG: return "debug";
+    case LogLevel::LOG_INFO: return "info";
+    case LogLevel::LOG_WARNING: return "warning";
+    case LogLevel::LOG_ERROR: return "error";
+    case LogLevel::LOG_FATAL: return "fatal";
+  }
+  return "?";
+}
+
+}  // namespace
+
+LogLevel MinLogLevel() {
+  static LogLevel level = ParseLevel();
+  return level;
+}
+
+bool LogTimestampEnabled() {
+  static bool enabled = [] {
+    const char* env = std::getenv("HOROVOD_LOG_TIMESTAMP");
+    return env != nullptr && std::strcmp(env, "0") != 0 &&
+           std::strcmp(env, "") != 0;
+  }();
+  return enabled;
+}
+
+LogMessage::LogMessage(const char* file, int line, LogLevel level)
+    : file_(file), line_(line), level_(level) {}
+
+LogMessage::~LogMessage() {
+  const char* base = std::strrchr(file_, '/');
+  base = base ? base + 1 : file_;
+  std::string ts;
+  if (LogTimestampEnabled()) {
+    auto now = std::chrono::system_clock::now();
+    std::time_t t = std::chrono::system_clock::to_time_t(now);
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  now.time_since_epoch()).count() % 1000000;
+    char buf[64];
+    std::tm tm_buf;
+    localtime_r(&t, &tm_buf);
+    std::strftime(buf, sizeof(buf), "%Y-%m-%d %H:%M:%S", &tm_buf);
+    char full[80];
+    std::snprintf(full, sizeof(full), "%s.%06ld ", buf,
+                  static_cast<long>(us));
+    ts = full;
+  }
+  std::fprintf(stderr, "[hvdtpu %s%s %s:%d] %s\n", ts.c_str(),
+               LevelName(level_), base, line_, stream_.str().c_str());
+  if (level_ == LogLevel::LOG_FATAL) std::abort();
+}
+
+}  // namespace hvdtpu

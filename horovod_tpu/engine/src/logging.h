@@ -1,0 +1,57 @@
+// Leveled logging (reference analog: horovod/common/logging.{h,cc} — the
+// LOG(LEVEL) macros honoring HOROVOD_LOG_LEVEL / HOROVOD_LOG_TIMESTAMP).
+//
+// Usage: HVD_LOG(INFO) << "message";  — the stream is emitted to stderr on
+// destruction when the level passes the env-configured threshold.
+//
+// Enumerators carry a LOG_ prefix and the macro pastes tokens (no argument
+// pre-expansion), so builds defining common macros like -DDEBUG still
+// compile.
+
+#ifndef HVD_TPU_LOGGING_H
+#define HVD_TPU_LOGGING_H
+
+#include <sstream>
+#include <string>
+
+namespace hvdtpu {
+
+enum class LogLevel : int {
+  LOG_TRACE = 0,
+  LOG_DEBUG = 1,
+  LOG_INFO = 2,
+  LOG_WARNING = 3,
+  LOG_ERROR = 4,
+  LOG_FATAL = 5,
+};
+
+// Threshold from HOROVOD_LOG_LEVEL ("trace".."fatal", default "warning"),
+// parsed once per process.
+LogLevel MinLogLevel();
+bool LogTimestampEnabled();  // HOROVOD_LOG_TIMESTAMP
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line, LogLevel level);
+  ~LogMessage();
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  std::ostringstream stream_;
+  const char* file_;
+  int line_;
+  LogLevel level_;
+};
+
+}  // namespace hvdtpu
+
+#define HVD_LOG_IS_ON(lvl) \
+  (::hvdtpu::LogLevel::LOG_##lvl >= ::hvdtpu::MinLogLevel())
+
+#define HVD_LOG(lvl)                                       \
+  if (!HVD_LOG_IS_ON(lvl)) {                               \
+  } else                                                   \
+    ::hvdtpu::LogMessage(__FILE__, __LINE__,               \
+                         ::hvdtpu::LogLevel::LOG_##lvl).stream()
+
+#endif  // HVD_TPU_LOGGING_H

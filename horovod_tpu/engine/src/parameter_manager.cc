@@ -1,0 +1,177 @@
+#include "parameter_manager.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// Log-scale tuning ranges (reference tunes the same two continuous knobs;
+// parameter_manager.cc uses comparable spans).
+constexpr double kCycleMsMin = 0.5;
+constexpr double kCycleMsMax = 50.0;
+constexpr double kFusionMin = 1.0 * (1 << 20);    // 1 MB
+constexpr double kFusionMax = 256.0 * (1 << 20);  // 256 MB
+
+double ToUnit(double v, double lo, double hi) {
+  double t = (std::log(v) - std::log(lo)) / (std::log(hi) - std::log(lo));
+  return std::min(1.0, std::max(0.0, t));
+}
+
+double FromUnit(double t, double lo, double hi) {
+  return std::exp(std::log(lo) + t * (std::log(hi) - std::log(lo)));
+}
+
+}  // namespace
+
+void TunedParams::SerializeTo(std::string* out) const {
+  out->resize(sizeof(double) + sizeof(int64_t) + 2);
+  char* p = &(*out)[0];
+  std::memcpy(p, &cycle_time_ms, sizeof(double));
+  p += sizeof(double);
+  std::memcpy(p, &fusion_threshold_bytes, sizeof(int64_t));
+  p += sizeof(int64_t);
+  p[0] = static_cast<char>(cache_enabled);
+  p[1] = static_cast<char>(tuning_active);
+}
+
+TunedParams TunedParams::Deserialize(const std::string& payload) {
+  TunedParams p;
+  if (payload.size() < sizeof(double) + sizeof(int64_t) + 2) return p;
+  const char* q = payload.data();
+  std::memcpy(&p.cycle_time_ms, q, sizeof(double));
+  q += sizeof(double);
+  std::memcpy(&p.fusion_threshold_bytes, q, sizeof(int64_t));
+  q += sizeof(int64_t);
+  p.cache_enabled = static_cast<uint8_t>(q[0]);
+  p.tuning_active = static_cast<uint8_t>(q[1]);
+  return p;
+}
+
+ParameterManager::~ParameterManager() {
+  if (log_file_ != nullptr) std::fclose(log_file_);
+}
+
+void ParameterManager::Initialize(const EngineOptions& opts,
+                                  bool is_coordinator) {
+  active_ = opts.autotune;
+  is_coordinator_ = is_coordinator;
+  current_.cycle_time_ms = opts.cycle_time_ms;
+  current_.fusion_threshold_bytes = opts.fusion_threshold_bytes;
+  current_.cache_enabled = opts.cache_enabled ? 1 : 0;
+  current_.tuning_active = active_ ? 1 : 0;
+  warmup_remaining_ = opts.autotune_warmup_samples;
+  steps_remaining_ = opts.autotune_steps;
+  sample_cycles_ = opts.autotune_sample_cycles;
+  if (!active_) return;
+  opt_ = std::make_unique<BayesianOptimizer>(/*dim=*/3);
+  if (is_coordinator_ && !opts.autotune_log_path.empty()) {
+    log_file_ = std::fopen(opts.autotune_log_path.c_str(), "w");
+    if (log_file_ != nullptr) {
+      std::fprintf(log_file_,
+                   "score_bytes_per_sec,cycle_time_ms,"
+                   "fusion_threshold_bytes,cache_enabled\n");
+    }
+  }
+}
+
+std::vector<double> ParameterManager::PointFromParams() const {
+  return {ToUnit(current_.cycle_time_ms, kCycleMsMin, kCycleMsMax),
+          ToUnit(static_cast<double>(current_.fusion_threshold_bytes),
+                 kFusionMin, kFusionMax),
+          current_.cache_enabled ? 1.0 : 0.0};
+}
+
+void ParameterManager::ApplyPoint(const std::vector<double>& x) {
+  current_.cycle_time_ms = FromUnit(x[0], kCycleMsMin, kCycleMsMax);
+  current_.fusion_threshold_bytes =
+      static_cast<int64_t>(FromUnit(x[1], kFusionMin, kFusionMax));
+  current_.cache_enabled = x[2] >= 0.5 ? 1 : 0;
+}
+
+void ParameterManager::LogSample(double score) const {
+  if (log_file_ == nullptr) return;
+  std::fprintf(log_file_, "%.1f,%.3f,%lld,%d\n", score,
+               current_.cycle_time_ms,
+               static_cast<long long>(current_.fusion_threshold_bytes),
+               static_cast<int>(current_.cache_enabled));
+  std::fflush(log_file_);
+}
+
+bool ParameterManager::RecordCycle(int64_t allreduce_bytes) {
+  if (!active_ || !is_coordinator_) return false;
+  if (allreduce_bytes <= 0) return false;  // idle cycles don't count
+  auto now = std::chrono::steady_clock::now();
+  // A long idle gap mid-window (eval, checkpointing, data stall) would
+  // attribute the pause's wall-clock to the current configuration and feed
+  // the optimizer a near-zero score; discard the window instead.
+  constexpr double kMaxGapSec = 1.0;
+  if (sample_timing_ &&
+      std::chrono::duration<double>(now - last_traffic_).count() >
+          kMaxGapSec) {
+    sample_timing_ = false;
+  }
+  last_traffic_ = now;
+  if (!sample_timing_) {
+    sample_timing_ = true;
+    sample_start_ = now;
+    // the first traffic cycle opens the window; its bytes land in the
+    // elapsed time measured from here
+    bytes_in_sample_ = 0;
+    cycles_in_sample_ = 0;
+    return false;
+  }
+  bytes_in_sample_ += allreduce_bytes;
+  ++cycles_in_sample_;
+  if (cycles_in_sample_ < sample_cycles_) return false;
+  double elapsed =
+      std::chrono::duration<double>(now - sample_start_).count();
+  double score = static_cast<double>(bytes_in_sample_) /
+                 std::max(elapsed, 1e-6);
+  sample_timing_ = false;
+  if (warmup_remaining_ > 0) {
+    --warmup_remaining_;
+    return false;
+  }
+  Tune(score);
+  return true;
+}
+
+void ParameterManager::Tune(double score) {
+  LogSample(score);
+  opt_->AddSample(PointFromParams(), score);
+  --steps_remaining_;
+  if (steps_remaining_ <= 0) {
+    ApplyPoint(opt_->BestPoint());
+    active_ = false;
+    current_.tuning_active = 0;
+    HVD_LOG(INFO) << "autotune converged: cycle_time_ms="
+                  << current_.cycle_time_ms << " fusion_threshold_bytes="
+                  << current_.fusion_threshold_bytes << " cache_enabled="
+                  << static_cast<int>(current_.cache_enabled)
+                  << " (best score " << opt_->BestValue() << " B/s)";
+    if (log_file_ != nullptr) {
+      std::fprintf(log_file_, "# converged\n");
+      LogSample(opt_->BestValue());
+    }
+    return;
+  }
+  ApplyPoint(opt_->Suggest());
+  HVD_LOG(DEBUG) << "autotune trying cycle_time_ms=" << current_.cycle_time_ms
+                 << " fusion_threshold_bytes="
+                 << current_.fusion_threshold_bytes << " cache_enabled="
+                 << static_cast<int>(current_.cache_enabled) << " (score "
+                 << score << " B/s, " << steps_remaining_ << " steps left)";
+}
+
+void ParameterManager::SetCurrent(const TunedParams& p) {
+  current_ = p;
+  if (!p.tuning_active) active_ = false;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,82 @@
+// Online autotuning of engine parameters, scored by collective throughput.
+//
+// Reference analog: horovod/common/parameter_manager.{h,cc} (:42-246) —
+// tunes tensor-fusion threshold and cycle time (continuous, log-scale) and
+// cache enablement (categorical) via Bayesian optimization, scoring each
+// configuration by allreduce bytes/sec. Rank 0 tunes; the chosen
+// parameters are broadcast to workers every cycle while tuning is active
+// (reference: controller.cc:40-53 SynchronizeParameters) and fixed at the
+// best observed configuration once the step budget is exhausted.
+//
+// Enabled by HOROVOD_AUTOTUNE=1; progress optionally logged as CSV to
+// HOROVOD_AUTOTUNE_LOG (reference: operations.cc:521-530).
+
+#ifndef HVD_TPU_PARAMETER_MANAGER_H
+#define HVD_TPU_PARAMETER_MANAGER_H
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <memory>
+#include <string>
+
+#include "bayes_opt.h"
+#include "common.h"
+
+namespace hvdtpu {
+
+// The tunable set, broadcast as a fixed-size record each autotune cycle.
+struct TunedParams {
+  double cycle_time_ms = 0;
+  int64_t fusion_threshold_bytes = 0;
+  uint8_t cache_enabled = 1;
+  uint8_t tuning_active = 1;
+
+  void SerializeTo(std::string* out) const;
+  static TunedParams Deserialize(const std::string& payload);
+};
+
+class ParameterManager {
+ public:
+  ~ParameterManager();
+
+  void Initialize(const EngineOptions& opts, bool is_coordinator);
+
+  bool active() const { return active_; }
+
+  // Coordinator, once per cycle: record the cycle's allreduce payload
+  // bytes. Returns true when a new configuration was adopted (callers
+  // re-read Current()).
+  bool RecordCycle(int64_t allreduce_bytes);
+
+  TunedParams Current() const { return current_; }
+  // Workers: adopt the coordinator's broadcast decision.
+  void SetCurrent(const TunedParams& p);
+
+ private:
+  void Tune(double score);
+  void ApplyPoint(const std::vector<double>& x);
+  std::vector<double> PointFromParams() const;
+  void LogSample(double score) const;
+
+  bool active_ = false;
+  bool is_coordinator_ = false;
+  TunedParams current_;
+
+  // Sampling state: a sample = >= sample_cycles_ traffic-bearing cycles.
+  int sample_cycles_ = 10;
+  int warmup_remaining_ = 3;
+  int steps_remaining_ = 30;
+  int cycles_in_sample_ = 0;
+  int64_t bytes_in_sample_ = 0;
+  std::chrono::steady_clock::time_point sample_start_;
+  std::chrono::steady_clock::time_point last_traffic_;
+  bool sample_timing_ = false;
+
+  std::unique_ptr<BayesianOptimizer> opt_;
+  std::FILE* log_file_ = nullptr;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_PARAMETER_MANAGER_H

@@ -56,6 +56,15 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--stall-check-time-seconds", type=float, default=None)
     p.add_argument("--stall-shutdown-time-seconds", type=float, default=None)
     p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--autotune", action="store_true",
+                   help="enable online Bayesian tuning of cycle time / "
+                        "fusion threshold / cache (HOROVOD_AUTOTUNE)")
+    p.add_argument("--autotune-log", default=None,
+                   help="CSV file recording autotune samples "
+                        "(HOROVOD_AUTOTUNE_LOG)")
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps", type=int, default=None)
+    p.add_argument("--autotune-sample-cycles", type=int, default=None)
     p.add_argument("--start-timeout", type=float, default=120.0)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -84,6 +93,18 @@ def _engine_env(args) -> dict:
             args.stall_shutdown_time_seconds)
     if args.no_stall_check:
         env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if args.autotune_log:
+        env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log
+    if args.autotune_warmup_samples is not None:
+        env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = \
+            str(args.autotune_warmup_samples)
+    if args.autotune_steps is not None:
+        env["HOROVOD_AUTOTUNE_STEPS"] = str(args.autotune_steps)
+    if args.autotune_sample_cycles is not None:
+        env["HOROVOD_AUTOTUNE_SAMPLE_CYCLES"] = \
+            str(args.autotune_sample_cycles)
     return env
 
 

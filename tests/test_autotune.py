@@ -1,0 +1,124 @@
+"""Parameter-manager autotuning: online Bayesian tuning of cycle time /
+fusion threshold / cache enablement, scored by allreduce bytes/sec.
+
+Reference analog: horovod/common/parameter_manager.{h,cc} +
+optim/bayesian_optimization.cc, enabled via HOROVOD_AUTOTUNE
+(operations.cc:521-530).
+"""
+
+import threading
+import uuid
+
+import numpy as np
+import pytest
+
+from horovod_tpu.engine import EngineSession
+from horovod_tpu.jax.mpi_ops import _OP_ALLREDUCE, EagerExecutor
+from horovod_tpu.parallel.collectives import Sum
+
+N = 2
+
+
+def run_all(executors, fn):
+    results = [None] * len(executors)
+    errors = [None] * len(executors)
+
+    def work(r):
+        try:
+            results[r] = fn(r, executors[r])
+        except Exception as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=work, args=(r,))
+               for r in range(len(executors))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+@pytest.fixture
+def autotune_ring(tmp_path, monkeypatch):
+    log = tmp_path / "autotune.csv"
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_LOG", str(log))
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS", "6")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_SAMPLE_CYCLES", "2")
+    group = f"autotune-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=N, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(N)]
+    executors = [EagerExecutor(s) for s in sessions]
+    yield executors, log
+    for s in sessions:
+        s._lib.hvdtpu_shutdown(s._session)
+    for s in sessions:
+        s.destroy()
+
+
+def test_autotune_converges_and_stays_correct(autotune_ring):
+    """Numerics stay exact through every parameter change; the tuner
+    explores (log has one row per sample) and converges to an in-range
+    configuration."""
+    executors, log = autotune_ring
+    rounds = 150
+
+    def fn(r, ex):
+        for i in range(rounds):
+            x = np.full((256,), float(r + i), np.float32)
+            h = ex.submit(f"t{i}", _OP_ALLREDUCE, x, reduce_op=Sum)
+            ex.session.wait(h, timeout=30.0)
+            out = ex.take_result(f"t{i}")
+            expected = np.full((256,), sum(rr + i for rr in range(N)),
+                               np.float32)
+            np.testing.assert_allclose(out, expected)
+        return True
+
+    assert all(run_all(executors, fn))
+
+    text = log.read_text()
+    lines = [ln for ln in text.splitlines() if ln]
+    assert lines[0].startswith("score_bytes_per_sec")
+    samples = [ln for ln in lines[1:] if not ln.startswith("#")]
+    # warmup discarded; 6 tuning steps scored, plus the converged record
+    assert len(samples) >= 6, text
+    assert "# converged" in text, text
+    for ln in samples:
+        score, cycle_ms, fusion, cache = ln.split(",")
+        assert float(score) > 0
+        assert 0.5 <= float(cycle_ms) <= 50.0
+        assert (1 << 20) <= int(fusion) <= (256 << 20)
+        assert cache in ("0", "1")
+
+
+def test_autotune_off_no_log(tmp_path, monkeypatch):
+    """Autotune off (default): no tuning traffic, no log file."""
+    monkeypatch.delenv("HOROVOD_AUTOTUNE", raising=False)
+    log = tmp_path / "never.csv"
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_LOG", str(log))
+    group = f"autotune-off-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=N, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(N)]
+    executors = [EagerExecutor(s) for s in sessions]
+    try:
+        def fn(r, ex):
+            x = np.ones(8, np.float32) * (r + 1)
+            h = ex.submit("z", _OP_ALLREDUCE, x, reduce_op=Sum)
+            ex.session.wait(h, timeout=15.0)
+            return ex.take_result("z")
+
+        outs = run_all(executors, fn)
+        for out in outs:
+            np.testing.assert_allclose(out, np.ones(8) * 3)
+        assert not log.exists()
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
