@@ -79,9 +79,9 @@ class _HorovodTpuContext:
                     # collectives inside jit and doesn't need it.
                     start_engine = self.size > 1 and jax.process_count() == 1
                 if start_engine:
-                    from horovod_tpu.common import engine_client
+                    from horovod_tpu.engine import bindings
                     try:
-                        self.engine = engine_client.start(
+                        self.engine = bindings.EngineSession(
                             rank=self.rank, size=self.size,
                             local_rank=self.local_rank,
                             local_size=self.local_size)

@@ -1,0 +1,35 @@
+"""Reduction-op constants shared by every frontend.
+
+Reference analog: the Average/Sum/Adasum/Min/Max/Product constants exposed by
+each frontend (reference: horovod/torch/mpi_ops.py:60-76,
+horovod/common/common.h ReduceOp). Lives in ``common`` so the torch/TF
+frontends can import it without pulling in JAX.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Op(enum.Enum):
+    """Reduction ops (reference: horovod/common/common.h ReduceOp)."""
+
+    AVERAGE = "average"
+    SUM = "sum"
+    ADASUM = "adasum"
+    MIN = "min"
+    MAX = "max"
+    PRODUCT = "product"
+
+
+Average = Op.AVERAGE
+Sum = Op.SUM
+Adasum = Op.ADASUM
+Min = Op.MIN
+Max = Op.MAX
+Product = Op.PRODUCT
+
+# Engine ReduceKind ids (engine/src/data_plane.h).
+REDUCE_KIND = {
+    Sum: 0, Average: 1, Min: 2, Max: 3, Product: 4, Adasum: 5,
+}

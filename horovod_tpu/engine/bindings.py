@@ -54,12 +54,13 @@ def _lib_path() -> Path:
 
 
 def build_library(force: bool = False) -> Path:
-    path = _lib_path()
-    if path.exists() and not force:
-        return path
-    subprocess.run(["make", "-C", str(Path(__file__).parent)], check=True,
-                   capture_output=True)
-    return path
+    # Always run make: its dependency tracking makes a fresh build a no-op,
+    # and it protects against a stale prebuilt .so missing newly added
+    # symbols (the .so is gitignored and survives checkouts).
+    subprocess.run(["make", "-C", str(Path(__file__).parent)] +
+                   (["-B"] if force else []),
+                   check=True, capture_output=True)
+    return _lib_path()
 
 
 def load_library():
@@ -98,6 +99,8 @@ def load_library():
         ]
         lib.hvdtpu_join.argtypes = [ctypes.c_int64,
                                     ctypes.POINTER(ctypes.c_int64)]
+        lib.hvdtpu_last_joined_rank.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_last_joined_rank.restype = ctypes.c_int32
         lib.hvdtpu_poll.restype = ctypes.c_int32
         lib.hvdtpu_poll.argtypes = [ctypes.c_int64, ctypes.c_int64,
                                     ctypes.c_char_p, ctypes.c_int32]
@@ -286,6 +289,11 @@ class EngineSession:
             raise HorovodInternalError(
                 self._lib.hvdtpu_last_error().decode())
         return handle.value
+
+    def last_joined_rank(self) -> int:
+        """Last rank to join in the most recent completed join epoch
+        (reference: torch/mpi_ops.py:846+ return contract)."""
+        return self._lib.hvdtpu_last_joined_rank(self._session)
 
     def poll(self, handle: int):
         buf = ctypes.create_string_buffer(4096)

@@ -204,6 +204,14 @@ int32_t hvdtpu_join(int64_t session, int64_t* handle) {
   return 0;
 }
 
+// The last rank whose join completed the previous join epoch (reference:
+// torch/mpi_ops.py:846+ return contract); -1 before any join completes.
+int32_t hvdtpu_last_joined_rank(int64_t session) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  return e->last_joined_rank();
+}
+
 // Returns 1 done, 0 in-flight, <0 error. error_buf receives failure reason.
 int32_t hvdtpu_poll(int64_t session, int64_t handle, char* error_buf,
                     int32_t error_buf_len) {

@@ -404,7 +404,12 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
       if (!st.ok()) return st;
       for (int r = 0; r < size(); ++r) {
         RequestList list = RequestList::Deserialize(all[r]);
-        if (list.join) joined_ranks_.insert(r);
+        if (list.join && joined_ranks_.insert(r).second) {
+          // Track arrival order — the join return contract is the rank that
+          // joined last in *time*, not the highest rank id (reference:
+          // torch/mpi_ops.py:846+).
+          last_to_join_ = r;
+        }
         for (auto& req : list.requests) {
           IncrementTensorCount(req, 0);
         }
@@ -442,7 +447,7 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
           joined_ranks_.size() == static_cast<size_t>(size())) {
         Response jr;
         jr.type = Response::Type::JOIN;
-        jr.last_joined_rank = *joined_ranks_.rbegin();
+        jr.last_joined_rank = last_to_join_;
         slow.push_back(std::move(jr));
         joined_ranks_.clear();
       }
@@ -466,6 +471,7 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
     for (auto& resp : rlist.responses) {
       if (resp.type == Response::Type::JOIN) {
         join_completed = true;
+        out->last_joined_rank = resp.last_joined_rank;
         continue;
       }
       if (Cacheable(resp) && cache_.capacity() > 0) {

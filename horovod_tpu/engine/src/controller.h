@@ -55,6 +55,10 @@ class Controller {
   struct CycleOutput {
     ResponseList responses;
     bool join_completed = false;
+    // Valid when join_completed: the last rank to join (reference:
+    // torch/mpi_ops.py:846+ returns it so callers can pick a broadcast
+    // root that saw all of its data).
+    int32_t last_joined_rank = -1;
     bool should_shut_down = false;
     // Autotuner decision for the engine's loop pacing; 0 = unchanged.
     double tuned_cycle_time_ms = 0;
@@ -110,6 +114,7 @@ class Controller {
   std::unordered_map<std::string, TensorCount> message_table_;
   std::vector<std::string> ready_order_;  // completion order for determinism
   std::set<int32_t> joined_ranks_;
+  int32_t last_to_join_ = -1;
 
   // Grouped-op bookkeeping: group members ready but held until the whole
   // group completes (reference: controller.cc:199-223 group handling).

@@ -318,6 +318,7 @@ void Engine::BackgroundLoopImpl() {
       opts_.cycle_time_ms = out.tuned_cycle_time_ms;  // autotuner pacing
     }
     if (out.join_completed && join_pending_.load()) {
+      last_joined_rank_.store(out.last_joined_rank);
       join_pending_.store(false);
       handles_.MarkDone(join_handle_, "");
     }

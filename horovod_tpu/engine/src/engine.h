@@ -88,6 +88,7 @@ class Engine {
   // Returns handle (>=0) or a failed status for duplicate names.
   Status EnqueueTensor(TensorTableEntry entry, int64_t* handle);
   Status EnqueueJoin(int64_t* handle);
+  int32_t last_joined_rank() const { return last_joined_rank_.load(); }
 
   Status PollHandle(int64_t handle, bool* done, std::string* error);
   Status WaitHandle(int64_t handle, double timeout_sec);
@@ -126,6 +127,7 @@ class Engine {
   std::atomic<bool> stopped_{false};
   std::atomic<bool> healthy_{true};
   std::atomic<bool> join_pending_{false};
+  std::atomic<int32_t> last_joined_rank_{-1};
   int64_t join_handle_ = -1;
   std::mutex cycle_mu_;
   std::condition_variable cycle_cv_;

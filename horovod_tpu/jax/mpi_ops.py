@@ -1,4 +1,4 @@
-"""Eager collective operations backed by the native coordination engine.
+"""JAX eager collective operations backed by the native coordination engine.
 
 Reference analog: horovod/torch/mpi_ops.py — the sync + ``_async`` +
 ``synchronize``/``poll`` surface for concrete tensors, coordinated by the
@@ -10,31 +10,27 @@ runs the host data plane (C++, engine/src/data_plane.cc). The TPU-resident
 hot path for gradients is the in-jit psum — these eager ops serve parameter
 broadcasts, metric averaging, object transport, and API parity.
 
-With no engine (single process), ops degrade to their size-1 semantics.
+The protocol layer is framework-neutral (horovod_tpu/common/eager.py); this
+module adapts jax.Array in and out and smart-dispatches traced tensors to the
+in-jit XLA/ICI collectives.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import List, Optional, Sequence
-
-import numpy as np
 import jax
 import jax.numpy as jnp
 
-from horovod_tpu.common import basics
-from horovod_tpu.common.exceptions import HorovodInternalError
-from horovod_tpu.parallel.collectives import (
+from horovod_tpu.common import eager as _eager
+from horovod_tpu.common.eager import (  # noqa: F401  (re-exported surface)
+    EagerExecutor, Handle, LocalHandle as _LocalHandle,
+    allgather_async, allreduce_async, alltoall_async, barrier,
+    broadcast_async, grouped_allreduce_async, join, poll,
+)
+from horovod_tpu.common.eager import resolve_op as _resolve_op
+from horovod_tpu.common.reduce_ops import (  # noqa: F401  (re-exported)
     Adasum, Average, Max, Min, Op, Product, Sum,
 )
-
-# Engine ReduceKind ids (engine/src/data_plane.h).
-_REDUCE_KIND = {
-    Sum: 0, Average: 1, Min: 2, Max: 3, Product: 4, Adasum: 5,
-}
-_KIND_TO_OP = {v: k for k, v in _REDUCE_KIND.items()}
-
-from horovod_tpu.engine.bindings import (  # single source of op-type truth
+from horovod_tpu.engine.bindings import (  # noqa: F401 (op-type truth)
     OP_ALLGATHER as _OP_ALLGATHER,
     OP_ALLREDUCE as _OP_ALLREDUCE,
     OP_ALLTOALL as _OP_ALLTOALL,
@@ -43,402 +39,19 @@ from horovod_tpu.engine.bindings import (  # single source of op-type truth
 )
 
 
-class Handle:
-    """Async op handle (reference: the int handles of torch/mpi_ops.py with
-    HandleManager, mpi_ops_v2.cc:441-477)."""
-
-    def __init__(self, executor, engine_handle: int, name: Optional[str]):
-        self._executor = executor
-        self._engine_handle = engine_handle
-        self._name = name  # None => no output payload (join/barrier)
-
-    def __repr__(self):
-        return f"<hvd handle {self._name or self._engine_handle}>"
-
-
-class _LocalHandle:
-    """Size-1 fallback: already-complete result."""
-
-    def __init__(self, result):
-        self.result = result
-
-
-class EagerExecutor:
-    """Owns host staging buffers and the engine execute callback."""
-
-    def __init__(self, session):
-        self.session = session
-        self.lib = session._lib
-        self._lock = threading.Lock()
-        self._inputs = {}    # name -> np.ndarray (staged input)
-        self._splits = {}    # name -> send splits (alltoall)
-        self._results = {}   # name -> np/jnp result
-        self._counters = {}
-        session.set_execute_callback(self._execute)
-
-    # -- naming (must be deterministic & identical across ranks) ------------
-
-    def auto_name(self, prefix: str) -> str:
-        with self._lock:
-            c = self._counters.get(prefix, 0)
-            self._counters[prefix] = c + 1
-        return f"{prefix}.noname.{c}"
-
-    # -- submission ----------------------------------------------------------
-
-    def submit(self, name, op_type, array, *, root_rank=0, reduce_op=Sum,
-               prescale=1.0, postscale=1.0, group_id=-1, group_size=0,
-               splits=None) -> int:
-        arr = np.ascontiguousarray(np.asarray(array))
-        with self._lock:
-            if name in self._inputs:
-                raise HorovodInternalError(
-                    f"tensor {name} is already being processed")
-            self._inputs[name] = arr
-            if splits is not None:
-                self._splits[name] = list(splits)
-        try:
-            return self.session.enqueue(
-                name, op_type, arr.dtype.name, list(arr.shape),
-                root_rank=root_rank, reduce_op=_REDUCE_KIND[reduce_op],
-                prescale_factor=prescale, postscale_factor=postscale,
-                group_id=group_id, group_size=group_size,
-                splits=splits)
-        except Exception:
-            with self._lock:
-                self._inputs.pop(name, None)
-                self._splits.pop(name, None)
-            raise
-
-    def take_result(self, name):
-        with self._lock:
-            self._inputs.pop(name, None)
-            self._splits.pop(name, None)
-            return self._results.pop(name, None)
-
-    # -- engine callback (background thread, lockstep across ranks) ----------
-
-    def _execute(self, resp: dict) -> int:
-        t = resp["type"]
-        names = resp["names"]
-        shapes = resp["shapes"]
-        dtypes = [np.dtype(_dtype_name(d)) for d in resp["dtypes"]]
-        sess = self.session._session
-
-        def staged(i):
-            with self._lock:
-                buf = self._inputs.get(names[i])
-            if buf is None:
-                # Joined rank: participate with the op's identity so the
-                # result is unaffected — zero *rows* for gather-type ops
-                # (the controller advertises 0 rows for joined ranks in
-                # tensor_sizes; contributing a full-shape buffer would
-                # inject spurious rows), and the reduce op's identity
-                # element for allreduce (zeros poison MIN/MAX/PRODUCT; the
-                # reference zeros-substitution shares that flaw, this
-                # improves on it).
-                if t in ("ALLGATHER", "ALLTOALL"):
-                    buf = np.zeros((0, *shapes[i][1:]), dtypes[i])
-                elif t == "ALLREDUCE":
-                    buf = _identity_buffer(shapes[i], dtypes[i],
-                                           resp["reduce_op"])
-                else:
-                    buf = np.zeros(shapes[i], dtypes[i])
-            return buf
-
-        if t == "ALLREDUCE":
-            bufs = [np.ascontiguousarray(staged(i)) for i in range(len(names))]
-            groups = {}
-            for i, b in enumerate(bufs):
-                groups.setdefault(b.dtype, []).append(i)
-            for dtype, idxs in groups.items():
-                fused = np.concatenate([bufs[i].ravel() for i in idxs]) \
-                    if len(idxs) > 1 else bufs[idxs[0]].ravel().copy()
-                fused = np.ascontiguousarray(fused)
-                rc = self.lib.hvdtpu_data_allreduce(
-                    sess, fused.ctypes.data, fused.size,
-                    _engine_dtype(dtype), resp["reduce_op"],
-                    resp["prescale"], resp["postscale"])
-                if rc != 0:
-                    return rc
-                off = 0
-                for i in idxs:
-                    n = bufs[i].size
-                    with self._lock:
-                        self._results[names[i]] = \
-                            fused[off:off + n].reshape(bufs[i].shape)
-                    off += n
-            return 0
-
-        if t == "ALLGATHER":
-            buf = np.ascontiguousarray(staged(0))
-            import ctypes
-            rank_bytes = (ctypes.c_int64 * self.session.size)()
-            total = self.lib.hvdtpu_data_allgatherv(
-                sess, buf.ctypes.data, buf.nbytes, rank_bytes)
-            if total < 0:
-                return 1
-            out = np.empty(total, np.uint8)
-            self.lib.hvdtpu_data_fetch(sess, out.ctypes.data, total)
-            flat = out.view(buf.dtype)
-            trailing = shapes[0][1:]
-            with self._lock:
-                self._results[names[0]] = flat.reshape((-1, *trailing))
-            return 0
-
-        if t == "BROADCAST":
-            buf = np.ascontiguousarray(staged(0)).copy()
-            rc = self.lib.hvdtpu_data_bcast(sess, buf.ctypes.data, buf.nbytes,
-                                            resp["root_rank"])
-            if rc != 0:
-                return rc
-            with self._lock:
-                self._results[names[0]] = buf
-            return 0
-
-        if t == "ALLTOALL":
-            import ctypes
-            buf = np.ascontiguousarray(staged(0))
-            with self._lock:
-                splits = self._splits.get(names[0])
-            size = self.session.size
-            if splits is None:
-                if buf.shape[0] % size != 0:
-                    return 2
-                splits = [buf.shape[0] // size] * size
-            # derive from trailing dims, not nbytes/rows — a joined rank
-            # contributes 0 rows and its nbytes is 0
-            row_bytes = int(np.prod(shapes[0][1:], dtype=np.int64) *
-                            dtypes[0].itemsize) if shapes[0] else \
-                dtypes[0].itemsize
-            send_bytes = (ctypes.c_int64 * size)(
-                *[s * row_bytes for s in splits])
-            recv_bytes = (ctypes.c_int64 * size)()
-            total = self.lib.hvdtpu_data_alltoallv(
-                sess, buf.ctypes.data, send_bytes, size, recv_bytes)
-            if total < 0:
-                return 1
-            out = np.empty(total, np.uint8)
-            self.lib.hvdtpu_data_fetch(sess, out.ctypes.data, total)
-            flat = out.view(buf.dtype)
-            trailing = shapes[0][1:]
-            with self._lock:
-                self._results[names[0]] = flat.reshape((-1, *trailing))
-                self._results[names[0] + "/recv_splits"] = np.asarray(
-                    [rb // max(row_bytes, 1) for rb in recv_bytes])
-            return 0
-
-        if t == "BARRIER":
-            return 0
-
-        return 0
-
-
-_FLOAT_DTYPE_NAMES = {"float16", "bfloat16", "float32", "float64"}
-
-
-def _identity_buffer(shape, dtype, reduce_kind: int) -> np.ndarray:
-    """Identity element of the reduce op (joined-rank substitution).
-
-    SUM/AVERAGE/ADASUM: zeros (Adasum's zero-norm guard makes a zero vector
-    combine as identity); MIN: +inf / int max; MAX: -inf / int min;
-    PRODUCT: ones. Engine ReduceKind ids per engine/src/data_plane.h."""
-    dtype = np.dtype(dtype)
-    if reduce_kind == _REDUCE_KIND[Min]:
-        if dtype.name in _FLOAT_DTYPE_NAMES:
-            return np.full(shape, np.inf, dtype)
-        if dtype.name == "bool":
-            return np.ones(shape, dtype)
-        return np.full(shape, np.iinfo(dtype).max, dtype)
-    if reduce_kind == _REDUCE_KIND[Max]:
-        if dtype.name in _FLOAT_DTYPE_NAMES:
-            return np.full(shape, -np.inf, dtype)
-        if dtype.name == "bool":
-            return np.zeros(shape, dtype)
-        return np.full(shape, np.iinfo(dtype).min, dtype)
-    if reduce_kind == _REDUCE_KIND[Product]:
-        return np.ones(shape, dtype)
-    return np.zeros(shape, dtype)
-
-
-def _dtype_name(engine_dtype_id: int) -> str:
-    from horovod_tpu.engine.bindings import DTYPE_NAMES
-    return DTYPE_NAMES[engine_dtype_id]
-
-
-def _engine_dtype(np_dtype) -> int:
-    from horovod_tpu.engine.bindings import DTYPE_IDS
-    return DTYPE_IDS[np.dtype(np_dtype).name]
-
-
-# ---------------------------------------------------------------------------
-# module-level executor bound to the active context
-
-
-_executor = None
-_executor_lock = threading.Lock()
-
-
-def _get_executor() -> Optional[EagerExecutor]:
-    global _executor
-    ctx = basics._context()
-    if ctx.engine is None:
-        return None
-    with _executor_lock:
-        if _executor is None or _executor.session is not ctx.engine:
-            _executor = EagerExecutor(ctx.engine)
-        return _executor
-
-
 def _is_traced(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
-def _resolve_op(op, average):
-    # Legacy `average=` kwarg parity (reference: torch/mpi_ops.py:85-128
-    # deprecation shim).
-    if average is not None:
-        return Average if average else Sum
-    return op if op is not None else Average
-
-
-# ---------------------------------------------------------------------------
-# async API
-
-
-def allreduce_async(tensor, average=None, name=None, op=None,
-                    prescale_factor=1.0, postscale_factor=1.0):
-    op = _resolve_op(op, average)
-    ex = _get_executor()
-    if ex is None:
-        n = basics._context().size if basics._context().initialized else 1
-        if n != 1:
-            raise HorovodInternalError("eager ops need the engine when size>1")
-        result = _local_allreduce(tensor, op, prescale_factor,
-                                  postscale_factor)
-        return _LocalHandle(result)
-    name = name or ex.auto_name("allreduce")
-    h = ex.submit(name, _OP_ALLREDUCE, tensor, reduce_op=op,
-                  prescale=prescale_factor, postscale=postscale_factor)
-    return Handle(ex, h, name)
-
-
-def allgather_async(tensor, name=None):
-    ex = _get_executor()
-    if ex is None:
-        return _LocalHandle(np.asarray(tensor))
-    name = name or ex.auto_name("allgather")
-    h = ex.submit(name, _OP_ALLGATHER, tensor)
-    return Handle(ex, h, name)
-
-
-def broadcast_async(tensor, root_rank, name=None):
-    ex = _get_executor()
-    if ex is None:
-        return _LocalHandle(np.asarray(tensor))
-    name = name or ex.auto_name("broadcast")
-    h = ex.submit(name, _OP_BROADCAST, tensor, root_rank=root_rank)
-    return Handle(ex, h, name)
-
-
-def alltoall_async(tensor, splits=None, name=None):
-    ex = _get_executor()
-    if ex is None:
-        arr = np.asarray(tensor)
-        return _LocalHandle(arr)
-    name = name or ex.auto_name("alltoall")
-    h = ex.submit(name, _OP_ALLTOALL, tensor,
-                  splits=list(splits) if splits is not None else None)
-    return Handle(ex, h, name)
-
-
-def grouped_allreduce_async(tensors, average=None, name=None, op=None,
-                            prescale_factor=1.0, postscale_factor=1.0):
-    op = _resolve_op(op, average)
-    ex = _get_executor()
-    if ex is None:
-        return [_LocalHandle(_local_allreduce(t, op, prescale_factor,
-                                              postscale_factor))
-                for t in tensors]
-    base = name or ex.auto_name("grouped_allreduce")
-    # Deterministic across processes (Python hash() is salted per process).
-    import zlib
-    gid = zlib.crc32(base.encode()) & 0x3fffffff
-    handles = []
-    for i, t in enumerate(tensors):
-        n = f"{base}.{i}"
-        h = ex.submit(n, _OP_ALLREDUCE, t, reduce_op=op,
-                      prescale=prescale_factor, postscale=postscale_factor,
-                      group_id=gid, group_size=len(tensors))
-        handles.append(Handle(ex, h, n))
-    return handles
-
-
-def join() -> int:
-    """Blocks until every rank has joined (reference:
-    torch/mpi_ops.py:846+, operations.cc:1166-1190). Returns -1 when
-    single-process.
-
-    Goes through the executor so this rank's data plane is wired up even if
-    it never submitted an eager op — a joined rank must still participate
-    (with zeros) in collectives other ranks complete during the join epoch.
-    """
-    ex = _get_executor()
-    if ex is None:
-        return -1
-    h = ex.session.join()
-    ex.session.wait(h, timeout=0.0)
-    return 0
-
-
-def barrier():
-    ex = _get_executor()
-    if ex is None:
-        return
-    name = ex.auto_name("barrier")
-    h = ex.submit(name, _OP_BARRIER, np.zeros((), np.uint8))
-    ex.session.wait(h, timeout=0.0)
-    ex.take_result(name)
-
-
-def poll(handle) -> bool:
-    """True if the async op has completed (reference: mpi_ops.py:807-822)."""
-    if isinstance(handle, _LocalHandle):
-        return True
-    done, _ = handle._executor.session.poll(handle._engine_handle)
-    return done
-
-
 def synchronize(handle, timeout: float = 0.0):
-    """Wait for an async op; returns its output (reference:
+    """Wait for an async op; returns its output as a jax.Array (reference:
     mpi_ops.py:823-845)."""
-    if isinstance(handle, _LocalHandle):
-        return jnp.asarray(handle.result)
-    ex = handle._executor
-    try:
-        ex.session.wait(handle._engine_handle, timeout=timeout)
-    except HorovodInternalError:
-        if handle._name:
-            ex.take_result(handle._name)
-        raise
-    if handle._name is None:
-        return None
-    result = ex.take_result(handle._name)
+    result = _eager.synchronize(handle, timeout=timeout)
     return jnp.asarray(result) if result is not None else None
 
 
 # ---------------------------------------------------------------------------
 # sync API (reference: the non-async wrappers in torch/mpi_ops.py)
-
-
-def _local_allreduce(tensor, op, prescale, postscale):
-    if op not in (Sum, Average, Adasum, Min, Max, Product):
-        raise ValueError(f"unknown op {op}")
-    # Size-1 reduction is identity for every op; pre/postscale still apply
-    # (identical numerics to the multi-rank data plane, data_plane.cc).
-    arr = np.asarray(tensor)
-    return arr * prescale * postscale if (prescale != 1.0 or
-                                          postscale != 1.0) else arr
 
 
 def allreduce(tensor, average=None, name=None, op=None,

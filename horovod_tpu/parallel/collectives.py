@@ -18,7 +18,6 @@ prescale_factor/postscale_factor).
 
 from __future__ import annotations
 
-import enum
 import functools
 from typing import Optional, Sequence
 
@@ -26,25 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-
-class Op(enum.Enum):
-    """Reduction ops (reference: horovod/common/common.h ReduceOp + Python
-    Average/Sum/Adasum/Min/Max/Product constants in torch/mpi_ops.py:60-76)."""
-
-    AVERAGE = "average"
-    SUM = "sum"
-    ADASUM = "adasum"
-    MIN = "min"
-    MAX = "max"
-    PRODUCT = "product"
-
-
-Average = Op.AVERAGE
-Sum = Op.SUM
-Adasum = Op.ADASUM
-Min = Op.MIN
-Max = Op.MAX
-Product = Op.PRODUCT
+from horovod_tpu.common.reduce_ops import (  # noqa: F401  (re-exported)
+    Adasum, Average, Max, Min, Op, Product, Sum,
+)
 
 # Default axis: data parallelism — the reference's only axis (SURVEY §2.8).
 DEFAULT_AXIS = "data"

@@ -285,3 +285,19 @@ def test_join_allgather_zero_rows(ring):
     for r in range(N):
         if r != 2:
             np.testing.assert_allclose(outs[r], expected)
+
+
+def test_join_returns_last_joined_rank(ring):
+    """join() returns the rank that joined last in time (reference:
+    torch/mpi_ops.py:846+) — callers pick it as a broadcast root after
+    uneven data, since the last joiner processed the most batches."""
+    import time
+
+    def fn(r, ex):
+        # rank 1 joins conspicuously last; others stagger in rank order.
+        time.sleep(0.05 * r if r != 1 else 1.0)
+        ex.session.wait(ex.session.join(), timeout=15.0)
+        return ex.session.last_joined_rank()
+
+    outs = run_all(ring, fn)
+    assert outs == [1] * N
