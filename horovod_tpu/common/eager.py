@@ -37,6 +37,9 @@ class Handle:
         self._executor = executor
         self._engine_handle = engine_handle
         self._name = name  # None => no output payload (join/barrier)
+        # filled by synchronize(): per-op auxiliary outputs keyed by kind
+        # ("recv_splits" for alltoall, "rank_sizes" for allgather)
+        self.aux = {}
 
     def __repr__(self):
         return f"<hvd handle {self._name or self._engine_handle}>"
@@ -58,8 +61,7 @@ class EagerExecutor:
         self._lock = threading.Lock()
         self._inputs = {}    # name -> np.ndarray (staged input)
         self._splits = {}    # name -> send splits (alltoall)
-        self._results = {}   # name -> np result
-        self._aux_recv_splits = None
+        self._results = {}   # name -> np result (+ name/<aux-kind> entries)
         self._counters = {}
         session.set_execute_callback(self._execute)
 
@@ -97,24 +99,21 @@ class EagerExecutor:
                 self._splits.pop(name, None)
             raise
 
-    def take_result(self, name):
+    def take_result(self, name, aux_out: Optional[dict] = None):
+        """Pop and return an op's result. Auxiliary outputs (alltoall's
+        per-rank received row counts, allgather's per-rank contribution
+        sizes) are popped atomically with it: into ``aux_out`` if given,
+        discarded otherwise — keyed per name so concurrent synchronizes of
+        unrelated ops cannot swap each other's aux (they travel with the
+        handle, not a shared slot)."""
         with self._lock:
             self._inputs.pop(name, None)
             self._splits.pop(name, None)
-            # alltoall's auxiliary received-splits entry must not outlive the
-            # op's result (callers that want it read it before synchronize
-            # pops the result via take_recv_splits).
-            self._aux_recv_splits = self._results.pop(
-                name + "/recv_splits", None)
+            for kind in ("recv_splits", "rank_sizes"):
+                v = self._results.pop(f"{name}/{kind}", None)
+                if v is not None and aux_out is not None:
+                    aux_out[kind] = v
             return self._results.pop(name, None)
-
-    def take_recv_splits(self):
-        """Per-rank received row counts of the most recently synchronized
-        alltoall (reference: the recv_splits output of
-        tensorflow/mpi_ops alltoall)."""
-        with self._lock:
-            out, self._aux_recv_splits = self._aux_recv_splits, None
-            return out
 
     # -- engine callback (background thread, lockstep across ranks) ----------
 
@@ -182,8 +181,14 @@ class EagerExecutor:
             self.lib.hvdtpu_data_fetch(sess, out.ctypes.data, total)
             flat = out.view(buf.dtype)
             trailing = shapes[0][1:]
+            row_bytes = int(np.prod(trailing, dtype=np.int64) *
+                            buf.dtype.itemsize) or buf.dtype.itemsize
             with self._lock:
                 self._results[names[0]] = flat.reshape((-1, *trailing))
+                # per-rank contributed row counts — frontends use these for
+                # the allgather-gradient slice without a second collective
+                self._results[names[0] + "/rank_sizes"] = np.asarray(
+                    [int(rb) // row_bytes for rb in rank_bytes])
             return 0
 
         if t == "BROADCAST":

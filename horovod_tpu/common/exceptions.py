@@ -35,5 +35,14 @@ class HorovodShapeMismatchError(HorovodInternalError):
     """
 
 
+class WaitTimeout(RuntimeError):
+    """A bounded ``wait``/``synchronize`` elapsed before the op completed.
+
+    Deliberately NOT a HorovodInternalError: the collective is still pending
+    and this rank's staged input must stay in place — catching code should
+    wait again, not restore/reset.
+    """
+
+
 class WorkersAvailableException(RuntimeError):
     """Elastic driver found new workers available (used to trigger re-rendezvous)."""

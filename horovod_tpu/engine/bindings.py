@@ -54,12 +54,23 @@ def _lib_path() -> Path:
 
 
 def build_library(force: bool = False) -> Path:
-    # Always run make: its dependency tracking makes a fresh build a no-op,
-    # and it protects against a stale prebuilt .so missing newly added
-    # symbols (the .so is gitignored and survives checkouts).
-    subprocess.run(["make", "-C", str(Path(__file__).parent)] +
-                   (["-B"] if force else []),
-                   check=True, capture_output=True)
+    # Run make when a toolchain is present: its dependency tracking makes a
+    # fresh build a no-op, and it protects against a stale prebuilt .so
+    # missing newly added symbols (the .so is gitignored and survives
+    # checkouts). Deploy images without make fall back to the prebuilt .so;
+    # load_library's symbol setup fails loudly if that .so is stale.
+    try:
+        subprocess.run(["make", "-C", str(Path(__file__).parent)] +
+                       (["-B"] if force else []),
+                       check=True, capture_output=True)
+    except FileNotFoundError:
+        if _lib_path().exists():
+            return _lib_path()
+        raise
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            "engine build failed:\n" +
+            (e.stderr or b"").decode(errors="replace")) from e
     return _lib_path()
 
 
@@ -305,10 +316,15 @@ class EngineSession:
 
     def wait(self, handle: int, timeout: float = 0.0):
         """Blocks until the op completes; raises HorovodInternalError on
-        coordination/validation/data-plane failure."""
+        coordination/validation/data-plane failure, WaitTimeout when
+        ``timeout`` elapses first (the op is still pending and the handle
+        stays live — wait again)."""
         buf = ctypes.create_string_buffer(8192)
         rc = self._lib.hvdtpu_wait(self._session, handle, timeout, buf,
                                    len(buf))
+        if rc == 5:  # StatusType::IN_PROGRESS
+            from horovod_tpu.common.exceptions import WaitTimeout
+            raise WaitTimeout(buf.value.decode() or "wait timed out")
         if rc != 0:
             raise HorovodInternalError(buf.value.decode() or
                                        "collective failed")

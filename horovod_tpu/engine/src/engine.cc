@@ -45,8 +45,11 @@ Status HandleManager::Wait(int64_t handle, double timeout_sec) {
   if (timeout_sec > 0) {
     if (!cv_.wait_for(lock, std::chrono::duration<double>(timeout_sec),
                       pred)) {
-      return Status::Unknown("timed out waiting for handle " +
-                             std::to_string(handle));
+      // IN_PROGRESS, not an error: the op is still pending and the handle
+      // stays live — callers may wait again. Distinguishable at the C ABI
+      // from a real collective failure (UNKNOWN_ERROR).
+      return Status{StatusType::IN_PROGRESS,
+                    "timed out waiting for handle " + std::to_string(handle)};
     }
   } else {
     cv_.wait(lock, pred);

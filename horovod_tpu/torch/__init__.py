@@ -1,0 +1,35 @@
+"""The PyTorch user frontend — analog of the reference's ``horovod.torch``
+package (reference: horovod/torch/__init__.py).
+
+torch here is a *frontend over the same engine* the JAX surface uses: eager
+collectives stage through host numpy buffers, the C++ controller negotiates
+and fuses across ranks, and the host data plane executes. A torch training
+loop wrapped with ``DistributedOptimizer`` trains data-parallel across
+processes exactly as the reference's does — while the TPU-resident compute
+path stays available through ``horovod_tpu.jax``.
+"""
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    cross_rank, cross_size, init, is_initialized, local_rank, local_size,
+    mpi_threads_supported, nccl_built, rank, shutdown, size,
+    start_timeline, stop_timeline,
+)
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Op, Product, Sum,
+    allgather, allgather_async,
+    allreduce, allreduce_, allreduce_async, allreduce_async_,
+    alltoall, alltoall_async,
+    barrier,
+    broadcast, broadcast_, broadcast_async, broadcast_async_,
+    grouped_allreduce, grouped_allreduce_, grouped_allreduce_async,
+    grouped_allreduce_async_,
+    join, poll, synchronize,
+)
+from horovod_tpu.torch.functions import (  # noqa: F401
+    allgather_object, broadcast_object, broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from horovod_tpu.torch.optimizer import DistributedOptimizer  # noqa: F401
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
+from horovod_tpu.torch import elastic  # noqa: F401

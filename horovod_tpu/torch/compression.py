@@ -1,0 +1,66 @@
+"""Gradient wire compression for the torch frontend.
+
+Reference analog: horovod/torch/compression.py — ``Compression.none`` /
+``Compression.fp16`` pairs of (compress, decompress) applied around the
+allreduce wire transfer. A TPU-minded addition: ``Compression.bf16`` keeps
+the fp32 exponent range (no overflow on large gradient norms), which is the
+dtype the TPU data path prefers anyway.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: compression.py NoneCompressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Float tensors ride the wire as fp16 (reference: compression.py:46-66)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.type(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.type(ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.type(torch.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.type(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

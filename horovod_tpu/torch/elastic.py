@@ -1,0 +1,161 @@
+"""Elastic training state + mid-epoch sampler for torch.
+
+Reference analog: horovod/torch/elastic/state.py (TorchState with model /
+optimizer / sampler handlers) and horovod/torch/elastic/sampler.py
+(ElasticSampler — processed-index tracking so a rank resize mid-epoch
+resumes with every remaining sample processed exactly once).
+
+The retry loop (``run``) and the commit/restore/check-host-updates machinery
+are framework-neutral and shared with the JAX frontend
+(horovod_tpu/jax/elastic.py).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+from typing import Optional
+
+import torch
+
+from horovod_tpu.common import basics
+from horovod_tpu.jax.elastic import State, run  # noqa: F401  (re-exported)
+from horovod_tpu.torch import functions as torch_functions
+
+
+class ElasticSampler(torch.utils.data.Sampler):
+    """Distributed sampler that tracks processed indices for mid-epoch
+    elastic resume (reference: torch/elastic/sampler.py).
+
+    Usage: iterate batches; call ``record_batch(batch_idx, batch_size)``
+    after each; on a resize, ``reset()`` (via TorchState.on_reset) reshuffles
+    the *remaining* indices over the new world — already-processed samples
+    are not replayed."""
+
+    def __init__(self, dataset, shuffle: bool = True, seed: int = 0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices = set()
+        self.num_samples = 0
+        self.total_size = 0
+        self.indices = []
+        self.reset()
+
+    def set_epoch(self, epoch: int):
+        """New epoch: clear processed tracking, reshuffle (reference:
+        sampler.py set_epoch)."""
+        self.epoch = epoch
+        self.processed_indices = set()
+        self.reset()
+
+    def record_batch(self, batch_idx: int, batch_size: int):
+        """Mark one iterated batch as processed."""
+        start = batch_idx * batch_size
+        self.record_indices(self.indices[start:start + batch_size])
+
+    def record_indices(self, indices):
+        self.processed_indices.update(indices)
+
+    def reset(self):
+        ctx = basics._context()
+        rank = ctx.rank if ctx.initialized else 0
+        world = ctx.size if ctx.initialized else 1
+
+        g = torch.Generator()
+        g.manual_seed(self.seed + self.epoch)
+        order = torch.randperm(len(self.dataset), generator=g).tolist() \
+            if self.shuffle else list(range(len(self.dataset)))
+        remaining = [i for i in order if i not in self.processed_indices]
+
+        self.num_samples = int(math.ceil(len(remaining) / world)) \
+            if remaining else 0
+        self.total_size = self.num_samples * world
+        # pad so every rank sees the same number of batches (standard
+        # DistributedSampler contract; collectives stay in lockstep)
+        if remaining:
+            remaining = remaining + \
+                remaining[:self.total_size - len(remaining)]
+        self.indices = remaining[rank:self.total_size:world]
+
+    def __iter__(self):
+        return iter(self.indices)
+
+    def __len__(self):
+        return self.num_samples
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "processed_indices": set(self.processed_indices)}
+
+    def load_state_dict(self, state: dict):
+        self.epoch = state["epoch"]
+        self.processed_indices = set(state["processed_indices"])
+        self.reset()
+
+
+class TorchState(State):
+    """Elastic state with torch-aware handlers (reference:
+    torch/elastic/state.py:27-140): ``model``s sync via in-place parameter
+    broadcast, ``optimizer``s via optimizer-state broadcast, samplers merge
+    processed indices across the old world before re-partitioning."""
+
+    def __init__(self, model: Optional[torch.nn.Module] = None,
+                 optimizer: Optional[torch.optim.Optimizer] = None,
+                 sampler: Optional[ElasticSampler] = None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self.sampler = sampler
+        self._model_state = None
+        self._optimizer_state = None
+        self._sampler_state = None
+        super().__init__(**kwargs)
+
+    # -- commit/restore ------------------------------------------------------
+
+    def commit_no_check(self):
+        if self.model is not None:
+            self._model_state = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._optimizer_state = copy.deepcopy(
+                self.optimizer.state_dict())
+        if self.sampler is not None:
+            self._sampler_state = self.sampler.state_dict()
+        super().commit_no_check()
+
+    def restore(self):
+        if self.model is not None and self._model_state is not None:
+            self.model.load_state_dict(self._model_state)
+        if self.optimizer is not None and self._optimizer_state is not None:
+            self.optimizer.load_state_dict(self._optimizer_state)
+        if self.sampler is not None and self._sampler_state is not None:
+            self.sampler.load_state_dict(self._sampler_state)
+        super().restore()
+
+    def sync(self):
+        if basics._context().engine is not None:
+            if self.model is not None:
+                torch_functions.broadcast_parameters(
+                    self.model.state_dict(), root_rank=0)
+            if self.optimizer is not None:
+                torch_functions.broadcast_optimizer_state(
+                    self.optimizer, root_rank=0)
+            if self.sampler is not None:
+                # union of every rank's processed set — a departed rank's
+                # progress came in via the last committed broadcast state;
+                # surviving ranks merge so no sample is replayed
+                merged = torch_functions.allgather_object(
+                    self.sampler.processed_indices,
+                    name="elastic_sampler_sync")
+                union = set()
+                for s in merged:
+                    union |= s
+                self.sampler.processed_indices = union
+                self.sampler.reset()
+        super().sync()
+
+    def on_reset(self):
+        if self.sampler is not None:
+            self.sampler.reset()
+        super().on_reset()

@@ -1,0 +1,143 @@
+"""Parameter/object broadcast helpers for torch models.
+
+Reference analog: horovod/torch/functions.py — broadcast_parameters
+(:29-112), broadcast_optimizer_state (:113-185), broadcast_object (:186-228),
+allgather_object. The checkpoint-consistency primitives: after rank 0 loads
+or initializes, every rank is synced before the first training collective.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+import torch
+
+from horovod_tpu.common import basics
+from horovod_tpu.torch import mpi_ops
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a parameter collection from ``root_rank``
+    (reference: functions.py:29-112). Accepts a ``state_dict()`` (name →
+    tensor mapping) or an iterable of (name, tensor) — the
+    ``model.named_parameters()`` pattern. Async-submits every entry then
+    synchronizes, letting the engine fuse the transfers."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None or not isinstance(p, torch.Tensor):
+            continue
+        t = p.data if isinstance(p, torch.nn.Parameter) else p
+        handles.append(mpi_ops.broadcast_async_(
+            t, root_rank, name=f"bcast_params.{name}"))
+    for h in handles:
+        mpi_ops.synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state from root (reference: functions.py:113-185).
+
+    Tensor state entries (momentum buffers, exp_avg, ...) broadcast in place
+    as tensors; the structural remainder (step counts, param_group scalars)
+    rides one pickled object broadcast and is load_state_dict'ed on non-root
+    ranks so newly created state (e.g. before the first step on late ranks)
+    materializes consistently."""
+    if _single_process():
+        return
+    state_dict = optimizer.state_dict()
+    # Structure first: ranks whose optimizer has not materialized state yet
+    # (no step taken) adopt root's structure before tensor broadcasts.
+    meta = {
+        "param_groups": state_dict["param_groups"],
+        "state_keys": {
+            k: {sk: (tuple(sv.shape), str(sv.dtype))
+                if isinstance(sv, torch.Tensor) else ("py", repr(type(sv)))
+                for sk, sv in v.items()}
+            for k, v in state_dict["state"].items()},
+    }
+    root_meta = broadcast_object(meta, root_rank, name="opt_state_meta")
+    if basics._context().rank != root_rank:
+        # Materialize missing tensor slots with the right shapes/dtypes.
+        for k, slots in root_meta["state_keys"].items():
+            st = state_dict["state"].setdefault(k, {})
+            for sk, (shape, dtype) in slots.items():
+                if shape == "py":
+                    continue
+                if sk not in st or not isinstance(st[sk], torch.Tensor):
+                    st[sk] = torch.zeros(
+                        shape, dtype=getattr(torch, dtype.split(".")[-1]))
+    handles = []
+    scalars = {}
+    for k, v in sorted(state_dict["state"].items()):
+        for sk, sv in sorted(v.items()):
+            if isinstance(sv, torch.Tensor):
+                handles.append(mpi_ops.broadcast_async_(
+                    sv, root_rank, name=f"bcast_opt.{k}.{sk}"))
+            else:
+                scalars[(k, sk)] = sv
+    for h in handles:
+        mpi_ops.synchronize(h)
+    scalars = broadcast_object(scalars, root_rank, name="opt_state_scalars")
+    if basics._context().rank != root_rank:
+        for (k, sk), sv in scalars.items():
+            state_dict["state"][k][sk] = sv
+        state_dict["param_groups"] = root_meta["param_groups"]
+        optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Pickle + broadcast an arbitrary python object (reference:
+    functions.py:186-228: size broadcast, then payload)."""
+    name = name or "broadcast_object"
+    if _single_process():
+        return obj
+    if basics._context().rank == root_rank:
+        buf = io.BytesIO()
+        pickle.dump(obj, buf)
+        payload = torch.from_numpy(
+            np.frombuffer(buf.getvalue(), np.uint8).copy())
+    else:
+        payload = torch.zeros(0, dtype=torch.uint8)
+    sz = torch.tensor([payload.numel()], dtype=torch.int64)
+    sz = mpi_ops.synchronize(
+        mpi_ops.broadcast_async(sz, root_rank, name=name + ".sz"))
+    if basics._context().rank != root_rank:
+        payload = torch.zeros(int(sz[0]), dtype=torch.uint8)
+    data = mpi_ops.synchronize(
+        mpi_ops.broadcast_async(payload, root_rank, name=name + ".data"))
+    return pickle.loads(data.numpy().tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    """Gather one python object per rank (reference: torch/functions.py
+    allgather_object): pickled blobs ride the ragged allgather."""
+    name = name or "allgather_object"
+    if _single_process():
+        return [obj]
+    buf = io.BytesIO()
+    pickle.dump(obj, buf)
+    payload = torch.from_numpy(np.frombuffer(buf.getvalue(), np.uint8).copy())
+    sizes = mpi_ops.synchronize(mpi_ops.allgather_async(
+        torch.tensor([payload.numel()], dtype=torch.int64),
+        name=name + ".sz"))
+    data = mpi_ops.synchronize(
+        mpi_ops.allgather_async(payload, name=name + ".data")).numpy()
+    out = []
+    off = 0
+    for s in sizes.ravel().tolist():
+        out.append(pickle.loads(data[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out
+
+
+def _single_process() -> bool:
+    ctx = basics._context()
+    return ctx.engine is None

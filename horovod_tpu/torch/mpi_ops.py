@@ -1,0 +1,305 @@
+"""PyTorch eager collective operations over the native coordination engine.
+
+Reference analog: horovod/torch/mpi_ops.py (the full sync + ``_async`` +
+in-place ``_``-suffixed surface, handles with ``synchronize``/``poll``,
+autograd-aware sync ops) and horovod/torch/mpi_ops_v2.cc (the C++ adapter
+whose role — tensor staging + handle management — is played here by the
+framework-neutral executor in horovod_tpu/common/eager.py).
+
+TPU-native design: torch is a *frontend*. Tensors are staged to host numpy
+buffers (the reference's *CudaOnCPU pattern, torch/mpi_ops_v2.cc), the C++
+engine negotiates + fuses across ranks, and the host data plane executes.
+There is no torch C++ extension because there is nothing device-specific to
+adapt — the TPU compute path lives in jit (horovod_tpu.jax); this surface
+serves torch training loops, parameter broadcasts, and API parity.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import torch
+
+from horovod_tpu.common import eager as _eager
+from horovod_tpu.common.reduce_ops import (  # noqa: F401  (re-exported)
+    Adasum, Average, Max, Min, Op, Product, Sum,
+)
+
+# ---------------------------------------------------------------------------
+# torch <-> numpy staging (exact bit round-trips, incl. bf16/f16)
+
+
+def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
+    t = tensor.detach().contiguous().cpu()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        return t.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _from_numpy(arr: np.ndarray) -> torch.Tensor:
+    import ml_dtypes
+    if arr.dtype == ml_dtypes.bfloat16:
+        return torch.from_numpy(arr.view(np.int16).copy()).view(torch.bfloat16)
+    return torch.from_numpy(np.ascontiguousarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# handle table (reference: HandleManager, torch/mpi_ops_v2.cc:441-477 —
+# int handles so torch-side callers can poll/synchronize out of order)
+
+_handle_lock = threading.Lock()
+_next_handle = [0]
+_handles: dict = {}  # int -> (eager handle, output torch tensor or None)
+
+
+def _register(eager_handle, output: Optional[torch.Tensor]) -> int:
+    with _handle_lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _handles[h] = (eager_handle, output)
+    return h
+
+
+def poll(handle: int) -> bool:
+    """True once the async op has completed (reference: mpi_ops.py:807-822)."""
+    with _handle_lock:
+        entry = _handles.get(handle)
+    if entry is None:
+        raise ValueError(f"unknown handle {handle}")
+    return _eager.poll(entry[0])
+
+
+def synchronize(handle: int) -> Optional[torch.Tensor]:
+    """Wait for an async op and return its output tensor (reference:
+    mpi_ops.py:823-845). For in-place ops the input tensor is updated and
+    returned."""
+    with _handle_lock:
+        entry = _handles.pop(handle, None)
+    if entry is None:
+        raise ValueError(f"unknown handle {handle}")
+    eager_handle, output = entry
+    result = _eager.synchronize(eager_handle)
+    if result is None:
+        return output
+    out = _from_numpy(np.asarray(result))
+    if output is not None:
+        if output.shape != out.shape:
+            output.resize_(out.shape)
+        output.copy_(out.to(output.dtype))
+        return output
+    return out
+
+
+# ---------------------------------------------------------------------------
+# async API
+
+
+def allreduce_async(tensor: torch.Tensor, average=None,
+                    name: Optional[str] = None, op=None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    h = _eager.allreduce_async(_to_numpy(tensor), average, name, op,
+                               prescale_factor, postscale_factor)
+    return _register(h, None)
+
+
+def allreduce_async_(tensor: torch.Tensor, average=None,
+                     name: Optional[str] = None, op=None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> int:
+    """In-place: the reduced result is written back into ``tensor`` at
+    synchronize (reference: mpi_ops.py allreduce_async_)."""
+    h = _eager.allreduce_async(_to_numpy(tensor), average, name, op,
+                               prescale_factor, postscale_factor)
+    return _register(h, tensor)
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    h = _eager.allgather_async(_to_numpy(tensor), name)
+    return _register(h, None)
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    h = _eager.broadcast_async(_to_numpy(tensor), root_rank, name)
+    return _register(h, None)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    h = _eager.broadcast_async(_to_numpy(tensor), root_rank, name)
+    return _register(h, tensor)
+
+
+def alltoall_async(tensor: torch.Tensor, splits=None,
+                   name: Optional[str] = None) -> int:
+    if isinstance(splits, torch.Tensor):
+        splits = splits.tolist()
+    h = _eager.alltoall_async(_to_numpy(tensor), splits, name)
+    return _register(h, None)
+
+
+def grouped_allreduce_async(tensors, average=None, name: Optional[str] = None,
+                            op=None, prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> list:
+    hs = _eager.grouped_allreduce_async([_to_numpy(t) for t in tensors],
+                                        average, name, op,
+                                        prescale_factor, postscale_factor)
+    return [_register(h, None) for h in hs]
+
+
+def grouped_allreduce_async_(tensors, average=None, name: Optional[str] = None,
+                             op=None, prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0) -> list:
+    hs = _eager.grouped_allreduce_async([_to_numpy(t) for t in tensors],
+                                        average, name, op,
+                                        prescale_factor, postscale_factor)
+    return [_register(h, t) for h, t in zip(hs, tensors)]
+
+
+# ---------------------------------------------------------------------------
+# autograd-aware sync API (reference: the torch.autograd.Function wrappers,
+# torch/mpi_ops.py:163-181 allreduce grad = mirror allreduce; :538-558
+# allgather grad = reduce + slice own rows; broadcast grad = reduce to root)
+
+
+class _HorovodAllreduce(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, op, prescale_factor, postscale_factor, name):
+        ctx.op = op
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        return synchronize(allreduce_async(tensor, name=name, op=op,
+                                           prescale_factor=prescale_factor,
+                                           postscale_factor=postscale_factor))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        g = synchronize(allreduce_async(grad_output, op=ctx.op,
+                                        prescale_factor=ctx.prescale_factor,
+                                        postscale_factor=ctx.postscale_factor))
+        return g, None, None, None, None
+
+
+class _HorovodAllgather(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        from horovod_tpu.common import basics
+        ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
+        ctx.rank = basics._context().rank
+        out = synchronize(allgather_async(tensor, name=name))
+        # row offsets of this rank's slice, for the backward slice
+        sizes = synchronize(allgather_async(
+            torch.tensor([ctx.dim0], dtype=torch.int64)))
+        ctx.offset = int(sizes[:ctx.rank].sum())
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        g = synchronize(allreduce_async(grad_output, op=Sum))
+        return g[ctx.offset:ctx.offset + ctx.dim0], None
+
+
+class _HorovodBroadcast(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        from horovod_tpu.common import basics
+        ctx.root_rank = root_rank
+        ctx.rank = basics._context().rank
+        return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        g = synchronize(allreduce_async(grad_output, op=Sum))
+        if ctx.rank != ctx.root_rank:
+            g = torch.zeros_like(g)
+        return g, None, None
+
+
+class _HorovodAlltoall(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, splits, name):
+        handle = alltoall_async(tensor, splits, name)
+        with _handle_lock:
+            eager_handle = _handles[handle][0]
+        out = synchronize(handle)
+        ex = getattr(eager_handle, "_executor", None)
+        recv = ex.take_recv_splits() if ex is not None else None
+        ctx.recv_splits = [int(x) for x in recv] if recv is not None else None
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        g = synchronize(alltoall_async(grad_output, ctx.recv_splits))
+        return g, None, None
+
+
+def allreduce(tensor: torch.Tensor, average=None, name: Optional[str] = None,
+              compression=None, op=None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> torch.Tensor:
+    """Differentiable allreduce returning a new tensor (reference:
+    mpi_ops.py allreduce — gradient is the mirror allreduce)."""
+    from horovod_tpu.torch.compression import Compression
+    compression = compression or Compression.none
+    tensor_compressed, ctx = compression.compress(tensor)
+    reduced = _HorovodAllreduce.apply(tensor_compressed, _eager.resolve_op(
+        op, average), prescale_factor, postscale_factor, name)
+    return compression.decompress(reduced, ctx)
+
+
+def allreduce_(tensor: torch.Tensor, average=None,
+               name: Optional[str] = None, op=None,
+               prescale_factor: float = 1.0,
+               postscale_factor: float = 1.0) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name, op,
+                                        prescale_factor, postscale_factor))
+
+
+def allgather(tensor: torch.Tensor,
+              name: Optional[str] = None) -> torch.Tensor:
+    return _HorovodAllgather.apply(tensor, name)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    return _HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def alltoall(tensor: torch.Tensor, splits=None,
+             name: Optional[str] = None) -> torch.Tensor:
+    return _HorovodAlltoall.apply(tensor, splits, name)
+
+
+def grouped_allreduce(tensors, average=None, name: Optional[str] = None,
+                      op=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> list:
+    handles = grouped_allreduce_async(tensors, average, name, op,
+                                      prescale_factor, postscale_factor)
+    return [synchronize(h) for h in handles]
+
+
+def grouped_allreduce_(tensors, average=None, name: Optional[str] = None,
+                       op=None, prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0) -> list:
+    handles = grouped_allreduce_async_(tensors, average, name, op,
+                                       prescale_factor, postscale_factor)
+    return [synchronize(h) for h in handles]
+
+
+def join(device: int = -1) -> int:
+    """Block until every rank joins; returns the last joined rank
+    (reference: torch/mpi_ops.py:846+). ``device`` is accepted for API
+    parity; the data plane is host-side so it is unused."""
+    return _eager.join()
+
+
+def barrier():
+    _eager.barrier()

@@ -1,0 +1,234 @@
+"""Distributed optimizer wrappers for torch.
+
+Reference analog: horovod/torch/optimizer.py — ``_DistributedOptimizer``
+fires ``allreduce_async_`` from per-parameter gradient-accumulator hooks as
+soon as each gradient is ready (:110-198), and ``step()`` → ``synchronize()``
+waits the handles and decompresses (:200-260); ``backward_passes_per_step``
+delay counters; ``_DistributedAdasumOptimizer`` (:270-440) applies the LR
+*before* reduction and Adasum-combines parameter deltas.
+
+The hook mechanism is torch-2.x native (`register_post_accumulate_grad_hook`)
+instead of the reference's grad_fn accumulator introspection; the overlap
+property is the same — reductions for early layers start while later layers
+are still in backward.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import torch
+
+from horovod_tpu.common import basics
+from horovod_tpu.torch import mpi_ops
+from horovod_tpu.torch.compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin applied over the user's optimizer class (the reference's
+    dynamic-subclass pattern, optimizer.py:443-508) — isinstance checks and
+    LR schedulers keep working against the original class."""
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step, op,
+                 gradient_predivide_factor, groups):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression or Compression.none
+        self._bpps = int(backward_passes_per_step)
+        if self._bpps < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self._op = op
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self._groups = groups
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+            self._param_names = {id(p): name for name, p in named_parameters}
+        else:
+            self._param_names = {
+                id(p): f"param.{gi}.{pi}"
+                for gi, g in enumerate(self.param_groups)
+                for pi, p in enumerate(g["params"])}
+        dups = _find_duplicates(self._param_names.values())
+        if dups:
+            raise ValueError(
+                f"duplicate parameter names: {sorted(dups)} — collective "
+                "tensor names must be unique across the model")
+        self._handles = {}       # param -> (handle, compression ctx)
+        self._delay = {}         # param -> remaining backward passes
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        self._hook_handles = []
+        if basics._context().engine is not None or basics._context().size > 1:
+            self._register_hooks()
+
+    # -- hooks ---------------------------------------------------------------
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._delay[p] = self._bpps
+                    self._hook_handles.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook(p)))
+
+    def _make_hook(self, p):
+        def hook(*ignore):
+            if p in self._handles and self._handles[p][0] is not None:
+                if self._delay[p] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step.")
+            self._delay[p] -= 1
+            if self._delay[p] == 0:
+                self._handles[p] = self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._param_names.get(id(p)) or f"param.{id(p)}"
+        tensor = p.grad
+        if self._op is mpi_ops.Average \
+                and self._gradient_predivide_factor != 1.0:
+            prescale = 1.0 / self._gradient_predivide_factor
+            postscale = self._gradient_predivide_factor \
+                / basics._context().size
+            tensor_compressed, ctx = self._compression.compress(tensor)
+            handle = mpi_ops.allreduce_async_(
+                tensor_compressed, name=f"allreduce.{name}", op=mpi_ops.Sum,
+                prescale_factor=prescale, postscale_factor=postscale)
+        else:
+            tensor_compressed, ctx = self._compression.compress(tensor)
+            handle = mpi_ops.allreduce_async_(
+                tensor_compressed, name=f"allreduce.{name}", op=self._op)
+        return handle, (tensor_compressed, ctx)
+
+    # -- synchronize ---------------------------------------------------------
+
+    def synchronize(self):
+        """Wait outstanding gradient reductions and write reduced grads back
+        (reference: optimizer.py:200-260)."""
+        for p in self._requires_update:
+            if p not in self._handles and p.grad is not None:
+                # hook never fired (grads set manually, or step() called
+                # mid-accumulation) — force the reduction, as the reference
+                # synchronize() does (optimizer.py:200-232)
+                self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, (tensor_compressed, ctx)) in self._handles.items():
+            mpi_ops.synchronize(handle)
+            self._delay[p] = self._bpps
+            grad = self._compression.decompress(tensor_compressed, ctx)
+            if grad.data_ptr() != p.grad.data_ptr():
+                p.grad.copy_(grad.to(p.grad.dtype))
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Advanced: user already called ``synchronize()`` manually
+        (reference: optimizer.py skip_synchronize)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+                warnings.warn(
+                    "optimizer.step() called without a preceding backward; "
+                    "gradients were already synchronized")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, set_to_none: bool = True):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad called with outstanding gradient reductions — "
+                "call step() or synchronize() first")
+        return super(self.__class__, self).zero_grad(set_to_none)
+
+
+class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+    """Adasum applies the learning rate *before* reduction and combines
+    parameter deltas scale-invariantly (reference: optimizer.py:270-440).
+
+    step(): snapshot params → inner step on local grads → delta = new-old →
+    Adasum-allreduce deltas → params = old + combined delta."""
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression or Compression.none
+        self._bpps = int(backward_passes_per_step)
+        self._step_count = 0
+        if named_parameters is not None:
+            self._param_names = {id(p): name
+                                 for name, p in list(named_parameters)}
+        else:
+            self._param_names = {}
+
+    def step(self, closure=None):
+        self._step_count += 1
+        if self._bpps > 1 and (self._step_count % self._bpps) != 0:
+            return None  # local accumulation continues
+        starts = {}
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.grad is not None:
+                    starts[p] = p.detach().clone()
+        loss = super(self.__class__, self).step(closure)
+        handles = []
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.grad is None:
+                    continue
+                delta = p.detach() - starts[p]
+                name = self._param_names.get(id(p)) or f"param.{id(p)}"
+                compressed, cctx = self._compression.compress(delta)
+                h = mpi_ops.allreduce_async(
+                    compressed, name=f"adasum.{name}", op=mpi_ops.Adasum)
+                handles.append((p, h, cctx))
+        for p, h, cctx in handles:
+            combined = self._compression.decompress(mpi_ops.synchronize(h),
+                                                    cctx)
+            with torch.no_grad():
+                p.copy_(starts[p] + combined.to(p.dtype))
+        return loss
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[Iterator] = None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op=mpi_ops.Average,
+                         gradient_predivide_factor: float = 1.0,
+                         groups=None) -> torch.optim.Optimizer:
+    """Wrap a torch optimizer with hook-driven gradient allreduce
+    (reference: horovod/torch/optimizer.py:443-508)."""
+    if gradient_predivide_factor != 1.0 and op is not mpi_ops.Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if op is mpi_ops.Adasum:
+        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                   dict(_DistributedAdasumOptimizer.__dict__))
+        return cls(optimizer.param_groups, named_parameters, compression,
+                   backward_passes_per_step)
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, gradient_predivide_factor,
+               groups)
+
+
+def _find_duplicates(names) -> set:
+    seen, dups = set(), set()
+    for n in names:
+        (dups if n in seen else seen).add(n)
+    return dups

@@ -1,0 +1,71 @@
+"""Cross-rank synchronized batch normalization for torch.
+
+Reference analog: horovod/torch/sync_batch_norm.py — batch statistics
+computed over the global batch (all ranks), used when per-rank batches are
+too small for stable BN.
+
+Design: instead of the reference's hand-derived backward (allgather of
+mean/invstd + a custom autograd Function), the statistics are computed with
+the *differentiable* eager allreduce (horovod_tpu.torch.mpi_ops.allreduce,
+whose backward is the mirror allreduce) — autograd then produces exactly the
+synchronized gradients, with no bespoke backward to keep in sync.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from horovod_tpu.common import basics
+from horovod_tpu.torch import mpi_ops
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in BatchNorm that synchronizes statistics across ranks during
+    training (reference: torch/sync_batch_norm.py SyncBatchNorm)."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+
+    def forward(self, input):
+        ctx = basics._context()
+        world = ctx.size if ctx.initialized else 1
+        if not self.training or world == 1:
+            return super().forward(input)
+        self._check_input_dim(input)
+
+        # per-channel local sums over every dim but the channel dim (1)
+        dims = [0] + list(range(2, input.dim()))
+        local_count = input.numel() // input.shape[1]
+        local_sum = input.sum(dim=dims)
+        local_sqsum = (input * input).sum(dim=dims)
+
+        counts = mpi_ops.synchronize(mpi_ops.allgather_async(
+            torch.tensor([local_count], dtype=torch.int64)))
+        total = int(counts.sum())
+        mean = mpi_ops.allreduce(local_sum, op=mpi_ops.Sum) / total
+        sqmean = mpi_ops.allreduce(local_sqsum, op=mpi_ops.Sum) / total
+        var = sqmean - mean * mean
+
+        if self.track_running_stats:
+            with torch.no_grad():
+                m = self.momentum if self.momentum is not None else 0.1
+                unbiased = var * total / max(total - 1, 1)
+                self.running_mean.mul_(1 - m).add_(mean.detach(), alpha=m)
+                self.running_var.mul_(1 - m).add_(unbiased.detach(), alpha=m)
+                self.num_batches_tracked += 1
+
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        out = (input - mean.reshape(shape)) \
+            / torch.sqrt(var.reshape(shape) + self.eps)
+        if self.affine:
+            out = out * self.weight.reshape(shape) \
+                + self.bias.reshape(shape)
+        return out
