@@ -48,8 +48,9 @@ class Handle:
 class LocalHandle:
     """Size-1 fallback: already-complete result."""
 
-    def __init__(self, result):
+    def __init__(self, result, aux=None):
         self.result = result
+        self.aux = aux or {}
 
 
 class EagerExecutor:
@@ -315,14 +316,24 @@ def local_allreduce(tensor, op, prescale, postscale):
                                           postscale != 1.0) else arr
 
 
-def allreduce_async(tensor, average=None, name=None, op=None,
-                    prescale_factor=1.0, postscale_factor=1.0):
-    op = resolve_op(op, average)
+def _engine_or_local():
+    """The executor, or None when size-1 semantics are valid. Raises when
+    size>1 but the engine is absent — silently returning local results
+    would be replica divergence, not graceful degradation."""
     ex = get_executor()
     if ex is None:
         n = basics._context().size if basics._context().initialized else 1
         if n != 1:
-            raise HorovodInternalError("eager ops need the engine when size>1")
+            raise HorovodInternalError(
+                "eager ops need the engine when size>1")
+    return ex
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0):
+    op = resolve_op(op, average)
+    ex = _engine_or_local()
+    if ex is None:
         result = local_allreduce(tensor, op, prescale_factor,
                                  postscale_factor)
         return LocalHandle(result)
@@ -333,16 +344,18 @@ def allreduce_async(tensor, average=None, name=None, op=None,
 
 
 def allgather_async(tensor, name=None):
-    ex = get_executor()
+    ex = _engine_or_local()
     if ex is None:
-        return LocalHandle(np.asarray(tensor))
+        arr = np.asarray(tensor)
+        rows = arr.shape[0] if arr.ndim > 0 else 1
+        return LocalHandle(arr, aux={"rank_sizes": np.asarray([rows])})
     name = name or ex.auto_name("allgather")
     h = ex.submit(name, OP_ALLGATHER, tensor)
     return Handle(ex, h, name)
 
 
 def broadcast_async(tensor, root_rank, name=None):
-    ex = get_executor()
+    ex = _engine_or_local()
     if ex is None:
         return LocalHandle(np.asarray(tensor))
     name = name or ex.auto_name("broadcast")
@@ -351,10 +364,12 @@ def broadcast_async(tensor, root_rank, name=None):
 
 
 def alltoall_async(tensor, splits=None, name=None):
-    ex = get_executor()
+    ex = _engine_or_local()
     if ex is None:
         arr = np.asarray(tensor)
-        return LocalHandle(arr)
+        rows = arr.shape[0] if arr.ndim > 0 else 1
+        recv = list(splits) if splits is not None else [rows]
+        return LocalHandle(arr, aux={"recv_splits": np.asarray(recv)})
     name = name or ex.auto_name("alltoall")
     h = ex.submit(name, OP_ALLTOALL, tensor,
                   splits=list(splits) if splits is not None else None)
@@ -364,7 +379,7 @@ def alltoall_async(tensor, splits=None, name=None):
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             prescale_factor=1.0, postscale_factor=1.0):
     op = resolve_op(op, average)
-    ex = get_executor()
+    ex = _engine_or_local()
     if ex is None:
         return [LocalHandle(local_allreduce(t, op, prescale_factor,
                                             postscale_factor))
@@ -394,7 +409,7 @@ def join() -> int:
     (with identity elements) in collectives other ranks complete during the
     join epoch.
     """
-    ex = get_executor()
+    ex = _engine_or_local()
     if ex is None:
         return -1
     h = ex.session.join()
@@ -403,7 +418,7 @@ def join() -> int:
 
 
 def barrier():
-    ex = get_executor()
+    ex = _engine_or_local()
     if ex is None:
         return
     name = ex.auto_name("barrier")
@@ -430,8 +445,8 @@ def synchronize(handle, timeout: float = 0.0):
         ex.session.wait(handle._engine_handle, timeout=timeout)
     except HorovodInternalError:
         if handle._name:
-            ex.take_result(handle._name)
+            ex.take_result(handle._name, aux_out=handle.aux)
         raise
     if handle._name is None:
         return None
-    return ex.take_result(handle._name)
+    return ex.take_result(handle._name, aux_out=handle.aux)

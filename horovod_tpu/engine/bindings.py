@@ -48,6 +48,10 @@ _EXECUTE_CB = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_char_p,
 _lib = None
 _lib_lock = threading.Lock()
 
+# Must match hvdtpu_abi_version() in src/c_api.cc; bumped together with any
+# semantic ABI change so a stale prebuilt .so is rejected at load time.
+ABI_VERSION = 2
+
 
 def _lib_path() -> Path:
     return Path(__file__).parent / "build" / "libhvdtpu_core.so"
@@ -81,6 +85,16 @@ def load_library():
             return _lib
         path = build_library()
         lib = ctypes.CDLL(str(path))
+        try:
+            lib.hvdtpu_abi_version.restype = ctypes.c_int32
+            abi = lib.hvdtpu_abi_version()
+        except AttributeError:
+            abi = -1
+        if abi != ABI_VERSION:
+            raise HorovodInternalError(
+                f"stale engine library {path}: ABI {abi}, expected "
+                f"{ABI_VERSION} — rebuild with `make -C "
+                f"{Path(__file__).parent}`")
         lib.hvdtpu_create_session.restype = ctypes.c_int64
         lib.hvdtpu_create_session.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,

@@ -36,6 +36,12 @@ void SetError(const std::string& msg) { g_last_error = msg; }
 
 extern "C" {
 
+// Bumped with any semantic change to the C ABI (new/removed symbols,
+// changed return-code contracts). bindings.py refuses a prebuilt .so
+// whose version doesn't match, so a stale library fails loudly instead
+// of silently changing behavior.
+int32_t hvdtpu_abi_version() { return 2; }
+
 // Returns session id > 0, or <= 0 on failure (error via
 // hvdtpu_last_error()). transport_kind: "loopback" or "tcp".
 int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,

@@ -73,10 +73,14 @@ class ElasticSampler(torch.utils.data.Sampler):
             if remaining else 0
         self.total_size = self.num_samples * world
         # pad so every rank sees the same number of batches (standard
-        # DistributedSampler contract; collectives stay in lockstep)
+        # DistributedSampler contract; collectives stay in lockstep) —
+        # repeating the remainder as many times as needed, since at an epoch
+        # tail len(remaining) can be smaller than the pad itself
         if remaining:
-            remaining = remaining + \
-                remaining[:self.total_size - len(remaining)]
+            pad = self.total_size - len(remaining)
+            if pad > 0:
+                remaining = remaining + \
+                    (remaining * math.ceil(pad / len(remaining)))[:pad]
         self.indices = remaining[rank:self.total_size:world]
 
     def __iter__(self):

@@ -76,21 +76,28 @@ def synchronize(handle: int) -> Optional[torch.Tensor]:
     """Wait for an async op and return its output tensor (reference:
     mpi_ops.py:823-845). For in-place ops the input tensor is updated and
     returned."""
+    return _synchronize_with_aux(handle)[0]
+
+
+def _synchronize_with_aux(handle: int):
+    """synchronize() plus the op's auxiliary outputs (alltoall recv_splits,
+    allgather rank_sizes) that ride the eager handle."""
     with _handle_lock:
         entry = _handles.pop(handle, None)
     if entry is None:
         raise ValueError(f"unknown handle {handle}")
     eager_handle, output = entry
     result = _eager.synchronize(eager_handle)
+    aux = getattr(eager_handle, "aux", {})
     if result is None:
-        return output
+        return output, aux
     out = _from_numpy(np.asarray(result))
     if output is not None:
         if output.shape != out.shape:
             output.resize_(out.shape)
         output.copy_(out.to(output.dtype))
-        return output
-    return out
+        return output, aux
+    return out, aux
 
 
 # ---------------------------------------------------------------------------
@@ -190,11 +197,13 @@ class _HorovodAllgather(torch.autograd.Function):
         from horovod_tpu.common import basics
         ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
         ctx.rank = basics._context().rank
-        out = synchronize(allgather_async(tensor, name=name))
-        # row offsets of this rank's slice, for the backward slice
-        sizes = synchronize(allgather_async(
-            torch.tensor([ctx.dim0], dtype=torch.int64)))
-        ctx.offset = int(sizes[:ctx.rank].sum())
+        # Per-rank contributed row counts ride the handle's aux channel
+        # (filled by the executor from the same allgatherv exchange), so the
+        # backward slice offset needs no second collective.
+        out, aux = _synchronize_with_aux(allgather_async(tensor, name=name))
+        sizes = aux.get("rank_sizes")
+        ctx.offset = (int(np.asarray(sizes)[:ctx.rank].sum())
+                      if sizes is not None else 0)
         return out
 
     @staticmethod
@@ -222,12 +231,8 @@ class _HorovodBroadcast(torch.autograd.Function):
 class _HorovodAlltoall(torch.autograd.Function):
     @staticmethod
     def forward(ctx, tensor, splits, name):
-        handle = alltoall_async(tensor, splits, name)
-        with _handle_lock:
-            eager_handle = _handles[handle][0]
-        out = synchronize(handle)
-        ex = getattr(eager_handle, "_executor", None)
-        recv = ex.take_recv_splits() if ex is not None else None
+        out, aux = _synchronize_with_aux(alltoall_async(tensor, splits, name))
+        recv = aux.get("recv_splits")
         ctx.recv_splits = [int(x) for x in recv] if recv is not None else None
         return out
 

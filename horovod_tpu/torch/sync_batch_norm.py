@@ -56,11 +56,14 @@ class SyncBatchNorm(_BatchNorm):
 
         if self.track_running_stats:
             with torch.no_grad():
-                m = self.momentum if self.momentum is not None else 0.1
+                self.num_batches_tracked += 1
+                # momentum=None means cumulative moving average, matching
+                # the _BatchNorm contract
+                m = self.momentum if self.momentum is not None \
+                    else 1.0 / float(self.num_batches_tracked)
                 unbiased = var * total / max(total - 1, 1)
                 self.running_mean.mul_(1 - m).add_(mean.detach(), alpha=m)
                 self.running_var.mul_(1 - m).add_(unbiased.detach(), alpha=m)
-                self.num_batches_tracked += 1
 
         shape = [1, -1] + [1] * (input.dim() - 2)
         out = (input - mean.reshape(shape)) \
