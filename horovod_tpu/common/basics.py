@@ -74,18 +74,25 @@ class _HorovodTpuContext:
             try:
                 self.mesh = mesh_lib.build_mesh(mesh_spec, devices)
                 if start_engine is None:
-                    # The engine serves the eager multi-process path. A
-                    # jax.distributed SPMD job (process_count > 1) does its
-                    # collectives inside jit and doesn't need it.
-                    start_engine = self.size > 1 and jax.process_count() == 1
+                    # The engine serves the eager multi-process path
+                    # (broadcast_object, metric_average, elastic State.sync).
+                    # Its host-TCP controller coexists with a jax.distributed
+                    # SPMD job, so it boots whenever the process world is >1 —
+                    # otherwise those ops would silently return local results
+                    # and diverge across replicas. Pure-SPMD jobs that never
+                    # touch the eager path can pass start_engine=False.
+                    start_engine = self.size > 1
                 if start_engine:
+                    from horovod_tpu.common.exceptions import \
+                        HorovodInternalError
                     from horovod_tpu.engine import bindings
                     try:
                         self.engine = bindings.EngineSession(
                             rank=self.rank, size=self.size,
                             local_rank=self.local_rank,
                             local_size=self.local_size)
-                    except (ImportError, OSError,
+                    except (ImportError, OSError, ValueError,
+                            HorovodInternalError,
                             subprocess.CalledProcessError) as e:
                         raise RuntimeError(
                             "the native coordination engine could not be "

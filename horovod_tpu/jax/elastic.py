@@ -106,8 +106,9 @@ class State:
         """Broadcast committed state from rank 0 (reference:
         elastic.py:86-105 + torch/elastic/state.py handlers)."""
         from horovod_tpu.jax import functions
-        if basics._context().engine is None:
-            return
+        ctx = basics._context()
+        if (ctx.size if ctx.initialized else 1) == 1:
+            return  # single process: broadcast-from-0 is the identity
         for k in self._tracked:
             v = getattr(self, k)
             if isinstance(v, jax.Array) or _is_pytree_of_arrays(v):

@@ -59,7 +59,8 @@ def broadcast_object(obj: Any, root_rank: int = 0,
     functions.py:186-228: size broadcast, then payload)."""
     name = name or "broadcast_object"
     from horovod_tpu.common import basics
-    if basics._context().engine is None:
+    ctx = basics._context()
+    if (ctx.size if ctx.initialized else 1) == 1:
         return obj
     if basics.rank() == root_rank:
         buf = io.BytesIO()
@@ -82,7 +83,8 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> list:
     allgather, per-rank byte counts ride a fixed-size allgather."""
     name = name or "allgather_object"
     from horovod_tpu.common import basics
-    if basics._context().engine is None:
+    ctx = basics._context()
+    if (ctx.size if ctx.initialized else 1) == 1:
         return [obj]
     buf = io.BytesIO()
     pickle.dump(obj, buf)

@@ -140,4 +140,4 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> list:
 
 def _single_process() -> bool:
     ctx = basics._context()
-    return ctx.engine is None
+    return (ctx.size if ctx.initialized else 1) == 1
