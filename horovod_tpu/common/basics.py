@@ -64,8 +64,14 @@ class _HorovodTpuContext:
                 # both on first spawn and on elastic re-init (reference:
                 # gloo_context.cc:154-200 re-init scope query).
                 elastic_worker.rendezvous()
-            self.rank = _env_int("HOROVOD_RANK", 0)
-            self.size = _env_int("HOROVOD_SIZE", 1)
+            # Topology: launcher env contract first; failing that, a live
+            # jax.distributed job defines the process world — otherwise a
+            # multi-host job launched outside hvdrun-tpu would read size=1
+            # and every "single-process" fallback would silently diverge.
+            jaxd = jax.process_count() if jax.process_count() > 1 else 1
+            self.rank = _env_int("HOROVOD_RANK",
+                                 jax.process_index() if jaxd > 1 else 0)
+            self.size = _env_int("HOROVOD_SIZE", jaxd)
             self.local_rank = _env_int("HOROVOD_LOCAL_RANK", 0)
             self.local_size = _env_int("HOROVOD_LOCAL_SIZE", 1)
             self.cross_rank = _env_int("HOROVOD_CROSS_RANK", self.rank)
@@ -80,8 +86,13 @@ class _HorovodTpuContext:
                     # SPMD job, so it boots whenever the process world is >1 —
                     # otherwise those ops would silently return local results
                     # and diverge across replicas. Pure-SPMD jobs that never
-                    # touch the eager path can pass start_engine=False.
-                    start_engine = self.size > 1
+                    # touch the eager path can pass start_engine=False; a
+                    # jax.distributed job launched outside hvdrun-tpu (no
+                    # controller rendezvous in the env) gets that default,
+                    # and eager ops raise loudly rather than degrade.
+                    start_engine = self.size > 1 and (
+                        "HOROVOD_SIZE" in os.environ or
+                        "HOROVOD_CONTROLLER_PORT" in os.environ)
                 if start_engine:
                     from horovod_tpu.common.exceptions import \
                         HorovodInternalError
@@ -121,6 +132,14 @@ _ctx = _HorovodTpuContext()
 
 def _context() -> _HorovodTpuContext:
     return _ctx
+
+
+def _single_process() -> bool:
+    """True when size-1 semantics apply (uninitialized counts as size 1).
+    The one shared predicate behind every local-fallback fast path — eager
+    ops raise (rather than degrade) when this is False and the engine is
+    absent."""
+    return (_ctx.size if _ctx.initialized else 1) == 1
 
 
 def _require_init():

@@ -321,11 +321,11 @@ def _engine_or_local():
     size>1 but the engine is absent — silently returning local results
     would be replica divergence, not graceful degradation."""
     ex = get_executor()
-    if ex is None:
-        n = basics._context().size if basics._context().initialized else 1
-        if n != 1:
-            raise HorovodInternalError(
-                "eager ops need the engine when size>1")
+    if ex is None and not basics._single_process():
+        raise HorovodInternalError(
+            "eager ops need the engine when size>1 (init() boots it under "
+            "the launcher env contract; pass start_engine=True for "
+            "hand-rolled jobs with a controller rendezvous)")
     return ex
 
 

@@ -106,12 +106,16 @@ class State:
         """Broadcast committed state from rank 0 (reference:
         elastic.py:86-105 + torch/elastic/state.py handlers)."""
         from horovod_tpu.jax import functions
-        ctx = basics._context()
-        if (ctx.size if ctx.initialized else 1) == 1:
+        if basics._single_process():
             return  # single process: broadcast-from-0 is the identity
         for k in self._tracked:
             v = getattr(self, k)
             if isinstance(v, jax.Array) or _is_pytree_of_arrays(v):
+                if not _fully_addressable(v):
+                    # globally-sharded SPMD arrays can't stage to host here
+                    # (and are consistent by construction under SPMD) —
+                    # skip rather than crash the elastic retry loop
+                    continue
                 setattr(self, k, functions.broadcast_parameters(v, 0))
             else:
                 setattr(self, k, functions.broadcast_object(
@@ -123,6 +127,13 @@ class State:
 
     def on_hosts_updated(self):
         """Hook when a host-change notification arrives."""
+
+
+def _fully_addressable(v) -> bool:
+    for leaf in jax.tree_util.tree_leaves(v):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return False
+    return True
 
 
 def _is_pytree_of_arrays(v) -> bool:

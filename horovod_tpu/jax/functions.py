@@ -59,8 +59,7 @@ def broadcast_object(obj: Any, root_rank: int = 0,
     functions.py:186-228: size broadcast, then payload)."""
     name = name or "broadcast_object"
     from horovod_tpu.common import basics
-    ctx = basics._context()
-    if (ctx.size if ctx.initialized else 1) == 1:
+    if basics._single_process():
         return obj
     if basics.rank() == root_rank:
         buf = io.BytesIO()
@@ -83,8 +82,7 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> list:
     allgather, per-rank byte counts ride a fixed-size allgather."""
     name = name or "allgather_object"
     from horovod_tpu.common import basics
-    ctx = basics._context()
-    if (ctx.size if ctx.initialized else 1) == 1:
+    if basics._single_process():
         return [obj]
     buf = io.BytesIO()
     pickle.dump(obj, buf)

@@ -1,0 +1,12 @@
+"""Keras frontend (reference: horovod/keras/__init__.py — the standalone
+keras entry point; same surface as horovod_tpu.tensorflow.keras)."""
+
+from horovod_tpu.tensorflow import (  # noqa: F401
+    Adasum, Average, Compression, Max, Min, Op, Product, Sum,
+    DistributedOptimizer, DistributedGradientTape,
+    allgather, allgather_object, allreduce, alltoall, barrier, broadcast,
+    broadcast_model, broadcast_object, broadcast_variables,
+    grouped_allreduce, init, is_initialized, join, local_rank, local_size,
+    metric_average, rank, shutdown, size,
+)
+from horovod_tpu.keras import callbacks  # noqa: F401

@@ -1,0 +1,175 @@
+"""Keras callbacks (reference: horovod/_keras/callbacks.py:22-192 and the
+thin keras-facing wrappers in horovod/keras/callbacks.py).
+
+Keras-3 native: learning-rate access goes through
+``model.optimizer.learning_rate`` (a variable) rather than the K.get_value
+backend shims the reference needed for tf1/tf2 duality.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import keras
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.common import eager as _eager
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast model + optimizer state from root after variables exist
+    (reference: _keras/callbacks.py:22-47 — runs on the first batch end so
+    lazily-built variables are included)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        from horovod_tpu.tensorflow.functions import broadcast_model
+        broadcast_model(self.model, self.root_rank,
+                        optimizer=getattr(self.model, "optimizer", None))
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over ranks before other callbacks (checkpoint,
+    early stopping, lr schedules) read them (reference:
+    _keras/callbacks.py:48-88)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or basics.size() == 1:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if np.isscalar(v) or getattr(v, "ndim", 1) == 0)
+        if not keys:
+            return
+        vals = np.asarray([float(logs[k]) for k in keys], np.float64)
+        avg = _eager.synchronize(_eager.allreduce_async(
+            vals, op=_eager.Average, name=f"metric_avg.e{epoch}"))
+        for k, v in zip(keys, np.asarray(avg)):
+            logs[k] = float(v)
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the initial lr by ``multiplier(epoch)`` over
+    [start_epoch, end_epoch) (reference: _keras/callbacks.py:89-171)."""
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True, steps_per_epoch=None):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self._restore_momentum = None
+        if not callable(multiplier):
+            self.multiplier = lambda epoch: multiplier
+            self.constant_multiplier = True
+        else:
+            self.multiplier = multiplier
+            self.constant_multiplier = False
+
+    def _in_range(self) -> bool:
+        return self.current_epoch >= self.start_epoch and \
+            (self.end_epoch is None or self.current_epoch < self.end_epoch)
+
+    def _assign_lr(self, epoch_frac: float):
+        lr = self.initial_lr * self.multiplier(epoch_frac)
+        self.model.optimizer.learning_rate.assign(lr)
+        return lr
+
+    def _adjust_momentum(self, restore: bool = False):
+        # momentum correction: scale momentum so velocity stays consistent
+        # across an lr jump (reference: _keras/callbacks.py:140-160)
+        opt = self.model.optimizer
+        m = getattr(opt, "momentum", None)
+        if m is None or self.constant_multiplier:
+            return
+        if restore and self._restore_momentum is not None:
+            val = self._restore_momentum
+            self._restore_momentum = None
+        elif not restore:
+            self._restore_momentum = float(
+                m.numpy() if hasattr(m, "numpy") else m)
+            lr0 = self.initial_lr * self.multiplier(
+                max(self.current_epoch - 1, self.start_epoch))
+            lr1 = self.initial_lr * self.multiplier(self.current_epoch)
+            val = self._restore_momentum * (lr1 / max(lr0, 1e-12))
+        else:
+            return
+        if hasattr(m, "assign"):
+            m.assign(val)
+        else:
+            opt.momentum = val
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if not self._in_range():
+            return
+        if self.staircase:
+            if self.momentum_correction:
+                self._adjust_momentum()
+            self._assign_lr(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.staircase or not self._in_range():
+            return
+        if self.steps_per_epoch is None:
+            raise ValueError(
+                "steps_per_epoch is required for non-staircase schedules")
+        self._assign_lr(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.momentum_correction and self.staircase and self._in_range():
+            self._adjust_momentum(restore=True)
+        if logs is not None:
+            lr = self.model.optimizer.learning_rate
+            logs["lr"] = float(lr.numpy() if hasattr(lr, "numpy") else lr)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warmup from lr to lr*size over warmup_epochs (reference:
+    _keras/callbacks.py:172-192 — the gradual-warmup recipe of the
+    large-minibatch paper, docs/benchmarks analog)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        self.verbose = verbose
+        world = basics.size() if basics.is_initialized() else 1
+
+        def multiplier(epoch):
+            # epoch 0 -> 1/size ... warmup end -> 1.0, in units of the
+            # post-warmup (already size-scaled) initial_lr
+            if warmup_epochs <= 0:
+                return 1.0
+            frac = min(epoch / float(warmup_epochs), 1.0)
+            return (1.0 / world) * (1 + frac * (world - 1))
+        super().__init__(initial_lr=initial_lr, multiplier=multiplier,
+                         start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        super().on_epoch_begin(epoch, logs)
+        # warmup over: pin the exact target lr (batch-fraction assignments
+        # end one fractional step short of it)
+        if epoch >= self.end_epoch:
+            self.model.optimizer.learning_rate.assign(self.initial_lr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if self.verbose and self.current_epoch == self.end_epoch - 1 and \
+                basics.rank() == 0:
+            print("Epoch %d: finished gradual learning rate warmup to %g." %
+                  (epoch + 1, self.initial_lr))

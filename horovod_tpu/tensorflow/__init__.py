@@ -1,0 +1,196 @@
+"""TensorFlow frontend: Horovod-parity API over the TPU-native engine.
+
+Reference analog: horovod/tensorflow/__init__.py — the op surface
+(allreduce/allgather/broadcast/alltoall, :54-330), DistributedOptimizer
+(:568-670), DistributedGradientTape (:674-742) — rebuilt over the
+framework-neutral eager layer instead of per-framework C++ kernels.
+
+Usage mirrors the reference::
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    tape = hvd.DistributedGradientTape(tape)
+    # or
+    opt = hvd.DistributedOptimizer(opt)
+    hvd.broadcast_variables(model.variables, root_rank=0)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import tensorflow as tf
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, mesh, num_replicas, is_homogeneous,
+    mpi_threads_supported, mpi_enabled, mpi_built, gloo_enabled, gloo_built,
+    nccl_built, ddl_built, ccl_built, cuda_built, rocm_built,
+    start_timeline, stop_timeline,
+)
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
+from horovod_tpu.tensorflow.functions import (  # noqa: F401
+    allgather_object, broadcast_object, broadcast_model, broadcast_variables,
+)
+from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: F401
+    SyncBatchNormalization,
+)
+from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Op, Product, Sum,
+    allgather, allreduce, alltoall, barrier, broadcast, grouped_allreduce,
+    join, local_rank_op, local_size_op, rank_op, size_op,
+)
+
+
+def _make_allreduce_grads_fn(compression, op, gradient_predivide_factor,
+                             num_groups):
+    """Gradient-combining closure shared by the tape and optimizer wrappers
+    (reference: tensorflow/__init__.py:334-418 _make_allreduce_grads_fn +
+    _make_cached_allreduce_grads_fn)."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+
+    def _allreduce_grads(grads):
+        prescale = postscale = 1.0
+        red_op = op
+        if gradient_predivide_factor != 1.0:
+            # split the averaging around the sum (reference:
+            # __init__.py:118-125); size() is read per call so pre-init
+            # construction and elastic resizes can't bake in a stale world
+            prescale = 1.0 / gradient_predivide_factor
+            postscale = gradient_predivide_factor / size()
+            red_op = Sum
+        idx = [i for i, g in enumerate(grads) if g is not None]
+        dense = [tf.convert_to_tensor(grads[i]) for i in idx]
+        if not dense:
+            return list(grads)
+        if num_groups > 0:
+            reduced = []
+            n = max(1, (len(dense) + num_groups - 1) // num_groups)
+            for s in range(0, len(dense), n):
+                reduced.extend(grouped_allreduce(
+                    dense[s:s + n], op=red_op, compression=compression,
+                    prescale_factor=prescale, postscale_factor=postscale))
+        else:
+            reduced = grouped_allreduce(
+                dense, op=red_op, compression=compression,
+                prescale_factor=prescale, postscale_factor=postscale)
+        out = list(grads)
+        for i, r in zip(idx, reduced):
+            out[i] = r
+        return out
+
+    return _allreduce_grads
+
+
+def _class_body(mixin) -> dict:
+    """A mixin's methods, minus the instance-layout descriptors a standalone
+    class carries (they don't transplant onto a dynamic subclass)."""
+    return {k: v for k, v in mixin.__dict__.items()
+            if k not in ("__dict__", "__weakref__")}
+
+
+class _DistributedOptimizer:
+    """Methods grafted onto a dynamic subclass of the wrapped keras
+    optimizer's class (reference: _keras/__init__.py:24-137 — the same
+    type()-composition trick, so isinstance checks and get_config
+    round-trips keep working)."""
+
+    _HVD_ATTR = "_hvd_state"
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        st = getattr(self, self._HVD_ATTR)
+        pairs = [(g, v) for g, v in grads_and_vars]
+        grads = [g for g, _ in pairs]
+        varss = [v for _, v in pairs]
+        bpps = st["backward_passes_per_step"]
+        if bpps > 1:
+            # local aggregation: allreduce + apply every bpps-th call
+            # (reference: gradient_aggregation_eager.py
+            # LocalGradientAggregationHelperEager)
+            acc = st.setdefault("acc", [None] * len(grads))
+            for i, g in enumerate(grads):
+                if g is None:
+                    continue
+                acc[i] = g if acc[i] is None else acc[i] + g
+            st["count"] = st.get("count", 0) + 1
+            if st["count"] < bpps:
+                return None
+            grads = [None if a is None else
+                     (a / float(bpps) if st["average_aggregated_gradients"]
+                      else a) for a in acc]
+            st["acc"] = [None] * len(grads)
+            st["count"] = 0
+        reduced = st["allreduce_grads"](grads)
+        return super(self.__class__, self).apply_gradients(
+            [(g, v) for g, v in zip(reduced, varss)], *args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         use_locking: bool = False, device_dense: str = "",
+                         device_sparse: str = "",
+                         compression=Compression.none,
+                         sparse_as_dense: bool = False,
+                         backward_passes_per_step: int = 1,
+                         op=Average, gradient_predivide_factor: float = 1.0,
+                         average_aggregated_gradients: bool = False,
+                         num_groups: int = 0):
+    """Wrap a keras optimizer so apply_gradients combines gradients across
+    ranks first (reference: tensorflow/__init__.py:568-670). device_dense /
+    device_sparse / use_locking / sparse_as_dense are accepted for API
+    parity; placement is the engine's concern here."""
+    if op == Adasum and average_aggregated_gradients:
+        raise ValueError(
+            "Adasum does not support average_aggregated_gradients")
+    _ = (name, use_locking, device_dense, device_sparse, sparse_as_dense)
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               _class_body(_DistributedOptimizer))
+    opt = cls.from_config(optimizer.get_config())
+    setattr(opt, _DistributedOptimizer._HVD_ATTR, {
+        "allreduce_grads": _make_allreduce_grads_fn(
+            compression, op, gradient_predivide_factor, num_groups),
+        "backward_passes_per_step": backward_passes_per_step,
+        "average_aggregated_gradients": average_aggregated_gradients,
+    })
+    return opt
+
+
+class _DistributedGradientTape:
+    def gradient(self, target, sources, output_gradients=None):
+        grads = super(self.__class__, self).gradient(target, sources,
+                                                     output_gradients)
+        one = not isinstance(grads, (list, tuple))
+        reduced = self._hvd_allreduce_grads([grads] if one else list(grads))
+        return reduced[0] if one else reduced
+
+
+def DistributedGradientTape(gradtape: tf.GradientTape, device_dense: str = "",
+                            device_sparse: str = "",
+                            compression=Compression.none,
+                            sparse_as_dense: bool = False, op=Average,
+                            gradient_predivide_factor: float = 1.0,
+                            num_groups: int = 0):
+    """Wrap a tf.GradientTape so .gradient() returns rank-combined gradients
+    (reference: tensorflow/__init__.py:674-742, same dynamic-subclass
+    shape)."""
+    _ = (device_dense, device_sparse, sparse_as_dense)
+    cls = type(gradtape.__class__.__name__, (gradtape.__class__,),
+               _class_body(_DistributedGradientTape))
+    tape = cls.__new__(cls)
+    tape.__dict__.update(gradtape.__dict__)
+    tape._hvd_allreduce_grads = _make_allreduce_grads_fn(
+        compression, op, gradient_predivide_factor, num_groups)
+    return tape
+
+
+def metric_average(value, name: Optional[str] = None):
+    """Average a python/tf scalar across ranks (used by the keras
+    MetricAverageCallback; reference: _keras/callbacks.py:48-88)."""
+    import numpy as np
+    out = allreduce(tf.convert_to_tensor(np.asarray(value, np.float32)),
+                    op=Average, name=name)
+    return float(out.numpy())

@@ -1,0 +1,65 @@
+"""Gradient compression for the TensorFlow frontend.
+
+Reference analog: horovod/tensorflow/compression.py (NoneCompressor /
+FP16Compressor selected via the ``Compression`` enum-class). Adds a bf16
+compressor — the TPU-native 16-bit format with fp32 range.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface: compress before allreduce, decompress after."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context) — context feeds decompress."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Pick a compressor by attribute (reference: compression.py Compression).
+    """
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,65 @@
+"""Elastic training state for TensorFlow/Keras.
+
+Reference analog: horovod/tensorflow/elastic.py — TensorFlowKerasState
+(:91-155, keras model + optimizer handlers) and the shared retry loop. The
+run() wrapper and commit/restore/interrupt machinery are framework-neutral
+and come from horovod_tpu.jax.elastic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from horovod_tpu.common import basics
+from horovod_tpu.jax.elastic import (  # noqa: F401  (re-exported)
+    HostsUpdatedInterrupt, State, run,
+)
+
+
+class TensorFlowKerasState(State):
+    """Elastic state wrapping a keras model (+ optimizer): commit snapshots
+    weights host-side, restore reloads them, sync broadcasts variables from
+    rank 0 (reference: tensorflow/elastic.py:91-155)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer if optimizer is not None else \
+            getattr(model, "optimizer", None)
+        self._model_weights = None
+        self._optimizer_weights = None
+        super().__init__(**kwargs)
+
+    def _opt_vars(self):
+        if self.optimizer is None:
+            return []
+        v = getattr(self.optimizer, "variables", [])
+        return v() if callable(v) else list(v)
+
+    def commit_no_check(self):
+        if self.model is not None:
+            self._model_weights = [w.copy() for w in self.model.get_weights()]
+        self._optimizer_weights = [v.numpy().copy() for v in self._opt_vars()]
+        super().commit_no_check()
+
+    def restore(self):
+        if self.model is not None and self._model_weights is not None:
+            self.model.set_weights(self._model_weights)
+        if self._optimizer_weights:
+            for var, w in zip(self._opt_vars(), self._optimizer_weights):
+                var.assign(w)
+        super().restore()
+
+    def sync(self):
+        if not basics._single_process():
+            from horovod_tpu.tensorflow.functions import broadcast_variables
+            if self.model is not None:
+                broadcast_variables(self.model.variables, 0)
+            opt_vars = self._opt_vars()
+            if opt_vars:
+                broadcast_variables(opt_vars, 0)
+        super().sync()
+
+
+# alias for parity with the pure-tf state of the reference (variables are
+# keras-managed in tf2; the keras state covers both)
+TensorFlowState = TensorFlowKerasState

@@ -1,0 +1,49 @@
+"""Variable/object broadcast helpers for the TensorFlow frontend.
+
+Reference analog: horovod/tensorflow/functions.py — broadcast_variables
+(:47-58), broadcast_object (:59-102), allgather_object (:136-161).
+
+Object transport is framework-neutral (pickle + numpy over the engine), so
+it delegates to the jax frontend's implementations, which operate purely on
+numpy buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu.common import eager as _eager
+from horovod_tpu.jax.functions import (  # noqa: F401  (re-exported)
+    allgather_object, broadcast_object,
+)
+
+
+def broadcast_variables(variables: Iterable[tf.Variable], root_rank: int = 0):
+    """Assign every variable its root-rank value (reference:
+    functions.py:47-58 — the post-init consistency sync).
+
+    Async-submits every leaf then synchronizes, letting the engine pipeline
+    and fuse the transfers.
+    """
+    variables = list(variables)
+    handles = [_eager.broadcast_async(np.asarray(v.numpy()), root_rank,
+                                      name=f"bcast_vars.{i}")
+               for i, v in enumerate(variables)]
+    for v, h in zip(variables, handles):
+        out = tf.cast(_eager.synchronize(h), v.dtype)
+        # the engine normalizes 0-d scalars to rank-1; restore the shape
+        v.assign(tf.reshape(out, v.shape))
+
+
+def broadcast_model(model, root_rank: int = 0, optimizer=None):
+    """Broadcast a keras model's (and optionally optimizer's) variables
+    (reference: the BroadcastGlobalVariablesCallback body,
+    _keras/callbacks.py:22-47)."""
+    broadcast_variables(model.variables, root_rank)
+    if optimizer is not None and getattr(optimizer, "variables", None):
+        opt_vars = optimizer.variables
+        opt_vars = opt_vars() if callable(opt_vars) else opt_vars
+        broadcast_variables(opt_vars, root_rank)

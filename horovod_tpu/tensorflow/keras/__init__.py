@@ -1,0 +1,16 @@
+"""Keras sub-frontend (reference: horovod/tensorflow/keras/__init__.py).
+
+Re-exports the tensorflow surface plus the keras callbacks; the
+DistributedOptimizer here is the keras-flavored one (same implementation —
+the tf frontend already targets keras-3 optimizers).
+"""
+
+from horovod_tpu.tensorflow import (  # noqa: F401
+    Adasum, Average, Compression, Max, Min, Op, Product, Sum,
+    DistributedOptimizer, DistributedGradientTape,
+    allgather, allgather_object, allreduce, alltoall, barrier, broadcast,
+    broadcast_model, broadcast_object, broadcast_variables,
+    grouped_allreduce, init, is_initialized, join, local_rank, local_size,
+    metric_average, rank, shutdown, size,
+)
+from horovod_tpu.keras import callbacks  # noqa: F401

@@ -1,0 +1,243 @@
+"""TensorFlow eager/graph collective ops over the native coordination engine.
+
+Reference analog: horovod/tensorflow/mpi_ops.py (op wrappers + gradient
+registration, :95-320) and horovod/tensorflow/mpi_ops.cc (the C++ kernels).
+
+TPU-native design: like torch, TensorFlow is a *frontend* over the
+framework-neutral eager layer (horovod_tpu/common/eager.py) — tensors stage
+to host numpy, the C++ engine negotiates/fuses across ranks, the host data
+plane executes. Instead of registering graph-op gradients with
+``ops.RegisterGradient`` against custom kernels, each op is a
+``tf.custom_gradient`` around a ``tf.py_function``, which makes it
+differentiable and usable from both eager code and ``tf.function`` graphs
+with zero native TF code. The TPU compute path stays in jit
+(horovod_tpu.jax); this surface serves tf training loops and API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu.common import basics
+from horovod_tpu.common import eager as _eager
+from horovod_tpu.common.reduce_ops import (  # noqa: F401  (re-exported)
+    Adasum, Average, Max, Min, Op, Product, Sum,
+)
+
+# re-exported context surface (reference: mpi_ops.py init/rank/size exports)
+init = basics.init
+shutdown = basics.shutdown
+is_initialized = basics.is_initialized
+rank = basics.rank
+size = basics.size
+local_rank = basics.local_rank
+local_size = basics.local_size
+cross_rank = basics.cross_rank
+cross_size = basics.cross_size
+
+
+def _np(t: tf.Tensor) -> np.ndarray:
+    # tf numpy interop preserves dtype incl. bfloat16 (ml_dtypes-backed)
+    return np.asarray(t.numpy())
+
+
+def _scalar_normalize(out: tf.Tensor, like: tf.Tensor) -> tf.Tensor:
+    return tf.ensure_shape(out, like.shape) if like.shape.is_fully_defined() \
+        else out
+
+
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=None):
+    """Differentiable allreduce (reference: tensorflow/__init__.py:54-155 +
+    mpi_ops.py:95-134; gradient = the mirror allreduce)."""
+    from horovod_tpu.tensorflow.compression import Compression
+    compression = compression or Compression.none
+    red_op = _eager.resolve_op(op, average)
+    tensor = tf.convert_to_tensor(tensor)
+    compressed, ctx = compression.compress(tensor)
+
+    @tf.custom_gradient
+    def _fn(t):
+        def _run(x):
+            return _eager.synchronize(_eager.allreduce_async(
+                _np(x), name=name, op=red_op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor))
+        out = tf.py_function(_run, [t], t.dtype)
+        out = _scalar_normalize(out, t)
+
+        def grad(dy):
+            return allreduce(dy, op=red_op,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor)
+        return out, grad
+
+    return compression.decompress(_fn(compressed), ctx)
+
+
+def grouped_allreduce(tensors, average=None, name: Optional[str] = None,
+                      op=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0, compression=None):
+    """One negotiation round for a list of tensors (reference:
+    tensorflow/__init__.py:156-232). Grouped entries fuse unconditionally in
+    the engine."""
+    from horovod_tpu.tensorflow.compression import Compression
+    compression = compression or Compression.none
+    red_op = _eager.resolve_op(op, average)
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    comp = [compression.compress(t) for t in tensors]
+
+    @tf.custom_gradient
+    def _fn(*ts):
+        def _run(*xs):
+            hs = _eager.grouped_allreduce_async(
+                [_np(x) for x in xs], name=name, op=red_op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            return [_eager.synchronize(h) for h in hs]
+        outs = tf.py_function(_run, list(ts), [t.dtype for t in ts])
+        outs = [_scalar_normalize(o, t) for o, t in zip(outs, ts)]
+
+        def grad(*dys):
+            return grouped_allreduce(list(dys), op=red_op,
+                                     prescale_factor=prescale_factor,
+                                     postscale_factor=postscale_factor)
+        return outs, grad
+
+    reduced = _fn(*[c for c, _ in comp])
+    return [compression.decompress(r, ctx)
+            for r, (_, ctx) in zip(reduced, comp)]
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Differentiable allgather along dim 0; ranks may contribute different
+    row counts (reference: mpi_ops.py:184-230; gradient = allreduce-sum +
+    slice of this rank's rows, using the per-rank sizes that ride the
+    handle's aux channel — no second collective)."""
+    tensor = tf.convert_to_tensor(tensor)
+
+    @tf.custom_gradient
+    def _fn(t):
+        def _run(x):
+            h = _eager.allgather_async(_np(x), name=name)
+            out = _eager.synchronize(h)
+            sizes = h.aux.get("rank_sizes")
+            if sizes is None:
+                sizes = np.asarray([out.shape[0] if out.ndim else 1])
+            return out, np.asarray(sizes, np.int64)
+        out, sizes = tf.py_function(_run, [t], [t.dtype, tf.int64])
+
+        def grad(dy):
+            g = allreduce(dy, op=Sum)
+            r = basics._context().rank
+            off = tf.reduce_sum(sizes[:r])
+            return g[off:off + sizes[r]]
+        return out, grad
+
+    return _fn(tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    """Differentiable broadcast (reference: mpi_ops.py:231-267; gradient
+    reduces to the root, zeros elsewhere)."""
+    tensor = tf.convert_to_tensor(tensor)
+
+    @tf.custom_gradient
+    def _fn(t):
+        def _run(x):
+            return _eager.synchronize(_eager.broadcast_async(
+                _np(x), root_rank, name=name))
+        out = tf.py_function(_run, [t], t.dtype)
+        out = _scalar_normalize(out, t)
+
+        def grad(dy):
+            g = allreduce(dy, op=Sum)
+            if basics._context().rank != root_rank:
+                g = tf.zeros_like(g)
+            return g
+        return out, grad
+
+    return _fn(tensor)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """Differentiable alltoall (reference: mpi_ops.py:268-322; gradient =
+    alltoall back along the received splits)."""
+    tensor = tf.convert_to_tensor(tensor)
+    if splits is not None and isinstance(splits, tf.Tensor):
+        splits = splits.numpy().tolist()
+
+    @tf.custom_gradient
+    def _fn(t):
+        def _run(x):
+            h = _eager.alltoall_async(_np(x), splits=splits, name=name)
+            out = _eager.synchronize(h)
+            recv = h.aux.get("recv_splits")
+            if recv is None:
+                recv = [out.shape[0] if out.ndim else 1]
+            return out, np.asarray(recv, np.int64)
+        # recv splits ride a tensor output (not a python side-channel) so
+        # the gradient is correct under tf.function, where the grad fn is
+        # traced before the forward py_function ever runs
+        out, recv_splits = tf.py_function(_run, [t], [t.dtype, tf.int64])
+
+        def grad(dy):
+            return _alltoall_dynamic(dy, recv_splits)
+        return out, grad
+
+    return _fn(tensor)
+
+
+def _alltoall_dynamic(tensor, splits_t):
+    """alltoall whose splits arrive as a tensor (the backward path)."""
+    @tf.custom_gradient
+    def _fn(t, s):
+        def _run(x, sp):
+            h = _eager.alltoall_async(
+                _np(x), splits=[int(v) for v in np.asarray(sp)])
+            out = _eager.synchronize(h)
+            recv = h.aux.get("recv_splits")
+            if recv is None:
+                recv = [out.shape[0] if out.ndim else 1]
+            return out, np.asarray(recv, np.int64)
+        out, recv = tf.py_function(_run, [t, s], [t.dtype, tf.int64])
+
+        def grad(dy, *_unused):
+            return _alltoall_dynamic(dy, recv), None
+        return (out, recv), grad
+
+    return _fn(tensor, splits_t)[0]
+
+
+def join() -> int:
+    """Block until every rank joins; returns the last joined rank
+    (reference: mpi_ops.py:323-326)."""
+    return _eager.join()
+
+
+def barrier():
+    _eager.barrier()
+
+
+# -- graph-friendly topology ops (reference: mpi_ops.py:327-392 size_op etc.;
+# here topology is static per generation, so constants suffice) -------------
+
+
+def size_op(name: Optional[str] = None):
+    return tf.constant(basics.size(), tf.int32, name=name)
+
+
+def local_size_op(name: Optional[str] = None):
+    return tf.constant(basics.local_size(), tf.int32, name=name)
+
+
+def rank_op(name: Optional[str] = None):
+    return tf.constant(basics.rank(), tf.int32, name=name)
+
+
+def local_rank_op(name: Optional[str] = None):
+    return tf.constant(basics.local_rank(), tf.int32, name=name)

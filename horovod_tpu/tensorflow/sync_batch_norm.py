@@ -1,0 +1,85 @@
+"""Cross-rank synchronized batch normalization for TensorFlow/Keras.
+
+Reference analog: horovod/tensorflow/sync_batch_norm.py
+(SyncBatchNormalization overriding _moments with allreduced statistics).
+
+Design (same as the torch frontend's sync BN): statistics are computed with
+the *differentiable* allreduce, so autograd produces exactly the
+synchronized gradients — no hand-derived backward. Implemented as a
+standalone keras-3 layer because keras-3's BatchNormalization no longer
+exposes a _moments hook.
+"""
+
+from __future__ import annotations
+
+import keras
+import tensorflow as tf
+
+from horovod_tpu.common import basics
+from horovod_tpu.tensorflow import mpi_ops
+
+
+class SyncBatchNormalization(keras.layers.Layer):
+    """BatchNormalization whose batch statistics are computed over the
+    global batch (all ranks), for when per-rank batches are too small for
+    stable BN. Channels-last (axis=-1)."""
+
+    def __init__(self, axis: int = -1, momentum: float = 0.99,
+                 epsilon: float = 1e-3, center: bool = True,
+                 scale: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        if axis != -1:
+            raise ValueError("SyncBatchNormalization supports axis=-1 "
+                             "(channels-last) only")
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.center = center
+        self.scale = scale
+
+    def build(self, input_shape):
+        ch = int(input_shape[-1])
+        if self.scale:
+            self.gamma = self.add_weight(name="gamma", shape=(ch,),
+                                         initializer="ones", trainable=True)
+        if self.center:
+            self.beta = self.add_weight(name="beta", shape=(ch,),
+                                        initializer="zeros", trainable=True)
+        self.moving_mean = self.add_weight(
+            name="moving_mean", shape=(ch,), initializer="zeros",
+            trainable=False)
+        self.moving_variance = self.add_weight(
+            name="moving_variance", shape=(ch,), initializer="ones",
+            trainable=False)
+        super().build(input_shape)
+
+    def call(self, inputs, training=False):
+        ctx = basics._context()
+        world = ctx.size if ctx.initialized else 1
+        if not training:
+            mean, var = self.moving_mean, self.moving_variance
+        else:
+            axes = list(range(inputs.shape.rank - 1))
+            local_count = tf.cast(
+                tf.reduce_prod(tf.shape(inputs)[:-1]), tf.float32)
+            local_sum = tf.reduce_sum(inputs, axis=axes)
+            local_sqsum = tf.reduce_sum(tf.square(inputs), axis=axes)
+            if world > 1:
+                total = mpi_ops.allreduce(
+                    tf.reshape(local_count, (1,)), op=mpi_ops.Sum)[0]
+                gsum = mpi_ops.allreduce(local_sum, op=mpi_ops.Sum)
+                gsqsum = mpi_ops.allreduce(local_sqsum, op=mpi_ops.Sum)
+            else:
+                total, gsum, gsqsum = local_count, local_sum, local_sqsum
+            mean = gsum / total
+            var = gsqsum / total - tf.square(mean)
+            m = self.momentum
+            self.moving_mean.assign(self.moving_mean * m +
+                                    tf.stop_gradient(mean) * (1 - m))
+            self.moving_variance.assign(self.moving_variance * m +
+                                        tf.stop_gradient(var) * (1 - m))
+        out = (inputs - mean) * tf.math.rsqrt(var + self.epsilon)
+        if self.scale:
+            out = out * self.gamma
+        if self.center:
+            out = out + self.beta
+        return out

@@ -139,5 +139,4 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> list:
 
 
 def _single_process() -> bool:
-    ctx = basics._context()
-    return (ctx.size if ctx.initialized else 1) == 1
+    return basics._single_process()
