@@ -133,6 +133,59 @@ def grouped_allreduce(xs: Sequence[jax.Array],
     return fused_apply(fn, xs)
 
 
+def hierarchical_allreduce(x: jax.Array,
+                           op: Op = Average,
+                           outer_axis="data",
+                           inner_axis=("fsdp",),
+                           prescale_factor: float = 1.0,
+                           postscale_factor: float = 1.0,
+                           accumulate_in_fp32: bool = True) -> jax.Array:
+    """Two-level allreduce: reduce-scatter over the fast ``inner_axis``
+    (intra-slice ICI), allreduce the 1/inner-sized shards over the slow
+    ``outer_axis`` (cross-slice DCN), then all-gather over ``inner_axis``.
+
+    Reference analog: NCCLHierarchicalAllreduce
+    (ops/nccl_operations.cc:186-398 — NCCL ReduceScatter intra-node, MPI
+    allreduce across nodes on rank-0 GPUs, NCCL Allgather back) and the
+    HOROVOD_HIERARCHICAL_ALLREDUCE knob (operations.cc:470-494). The TPU
+    form needs no staging through host rank-0: every device keeps a shard,
+    so the DCN phase moves 1/inner of the bytes and is itself parallel
+    across the slice's devices.
+
+    Mesh contract: ``outer_axis`` is the axis whose links are slow (cross
+    -slice DCN), ``inner_axis`` the fast intra-slice axes — AXIS_ORDER
+    already places slow axes first (parallel/mesh.py).
+    """
+    if op not in (Average, Sum):
+        # min/max/product have no reduce-scatter form; the flat path is
+        # correct and these are off the hot path
+        return allreduce(x, op=op,
+                         axis=(*_axes(outer_axis), *_axes(inner_axis)),
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor,
+                         accumulate_in_fp32=accumulate_in_fp32)
+    x = _scale(x, prescale_factor)
+    orig_dtype = x.dtype
+    orig_shape = x.shape
+    if accumulate_in_fp32 and orig_dtype in (jnp.float16, jnp.bfloat16):
+        x = x.astype(jnp.float32)
+    inner = _axes(inner_axis)
+    n_inner = axis_size(inner)
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n_inner
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(flat, inner, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, outer_axis)
+    out = lax.all_gather(shard, inner, axis=0, tiled=True)
+    if pad:
+        out = out[:flat.size - pad]
+    out = out.reshape(orig_shape)
+    if op is Average:
+        out = out / (axis_size(outer_axis) * n_inner)
+    return _scale(out.astype(orig_dtype), postscale_factor)
+
+
 def allgather(x: jax.Array, axis=DEFAULT_AXIS) -> jax.Array:
     """Concatenate ``x`` from every rank along dim 0 (reference:
     EnqueueTensorAllgather, horovod/common/operations.cc:1027; output

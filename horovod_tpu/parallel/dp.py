@@ -16,6 +16,7 @@ Adasum, and prescale/postscale, matching reference knobs.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -29,6 +30,48 @@ from horovod_tpu.parallel.collectives import Average, Op
 
 # The replica axes a pure-DP step reduces over.
 DP_AXES = ("data", "fsdp")
+
+
+def _resolve_hierarchical(hierarchical: Optional[bool],
+                          axes: Tuple[str, ...]) -> bool:
+    """Env-default the two-level reduction knob (reference:
+    HOROVOD_HIERARCHICAL_ALLREDUCE, operations.cc:470-494). Needs at least
+    two reduce axes — the first is the slow/DCN level."""
+    if hierarchical is None:
+        hierarchical = os.environ.get(
+            "HOROVOD_HIERARCHICAL_ALLREDUCE", "0") == "1"
+    return hierarchical and len(axes) >= 2
+
+
+def _make_grad_allreduce(op, axes, compression, prescale_factor,
+                         postscale_factor, hierarchical):
+    """The gradient-combining tree map shared by both step builders."""
+    if op is collectives.Adasum:
+        def adasum_tree(tree):
+            # Per-tensor coefficients — must not be elementwise-fused.
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            outs = collectives.grouped_allreduce(
+                leaves, op=op, axis=axes, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            return jax.tree_util.tree_unflatten(treedef, outs)
+        return adasum_tree
+
+    def red(v):
+        if compression is not None:
+            v, ctx = compression.compress(v)
+        kwargs = dict(op=op, prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      accumulate_in_fp32=compression is None)
+        if hierarchical:
+            out = collectives.hierarchical_allreduce(
+                v, outer_axis=axes[0], inner_axis=axes[1:], **kwargs)
+        else:
+            out = collectives.allreduce(v, axis=axes, **kwargs)
+        if compression is not None:
+            out = compression.decompress(out, ctx)
+        return out
+
+    return lambda tree: fused_apply_tree(red, tree)
 
 
 class TrainStepOutput(NamedTuple):
@@ -55,6 +98,7 @@ def make_train_step(loss_fn: Callable,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
                     axes: Tuple[str, ...] = DP_AXES,
+                    hierarchical: Optional[bool] = None,
                     donate: bool = True) -> Callable:
     """Build a jitted data-parallel train step.
 
@@ -75,27 +119,9 @@ def make_train_step(loss_fn: Callable,
     from horovod_tpu.jax.compression import Compression
     if compression is Compression.none:
         compression = None
-
-    def _allreduce_grads(tree):
-        if op is collectives.Adasum:
-            # Per-tensor coefficients — must not be elementwise-fused.
-            leaves, treedef = jax.tree_util.tree_flatten(tree)
-            outs = collectives.grouped_allreduce(
-                leaves, op=op, axis=axes, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor)
-            return jax.tree_util.tree_unflatten(treedef, outs)
-
-        def red(v):
-            if compression is not None:
-                v, ctx = compression.compress(v)
-            out = collectives.allreduce(v, op=op, axis=axes,
-                                        prescale_factor=prescale_factor,
-                                        postscale_factor=postscale_factor,
-                                        accumulate_in_fp32=compression is None)
-            if compression is not None:
-                out = compression.decompress(out, ctx)
-            return out
-        return fused_apply_tree(red, tree)
+    _allreduce_grads = _make_grad_allreduce(
+        op, axes, compression, prescale_factor, postscale_factor,
+        _resolve_hierarchical(hierarchical, axes))
 
     def _sync_aux(aux):
         def sync(v):
@@ -141,6 +167,7 @@ def make_stateful_train_step(loss_fn: Callable,
                              prescale_factor: float = 1.0,
                              postscale_factor: float = 1.0,
                              axes: Tuple[str, ...] = DP_AXES,
+                             hierarchical: Optional[bool] = None,
                              donate: bool = True) -> Callable:
     """Train step for models with non-gradient state (BatchNorm running
     statistics etc.).
@@ -156,26 +183,9 @@ def make_stateful_train_step(loss_fn: Callable,
     from horovod_tpu.jax.compression import Compression
     if compression is Compression.none:
         compression = None
-
-    def _allreduce_grads(tree):
-        if op is collectives.Adasum:
-            leaves, treedef = jax.tree_util.tree_flatten(tree)
-            outs = collectives.grouped_allreduce(
-                leaves, op=op, axis=axes, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor)
-            return jax.tree_util.tree_unflatten(treedef, outs)
-
-        def red(v):
-            if compression is not None:
-                v, ctx = compression.compress(v)
-            out = collectives.allreduce(v, op=op, axis=axes,
-                                        prescale_factor=prescale_factor,
-                                        postscale_factor=postscale_factor,
-                                        accumulate_in_fp32=compression is None)
-            if compression is not None:
-                out = compression.decompress(out, ctx)
-            return out
-        return fused_apply_tree(red, tree)
+    _allreduce_grads = _make_grad_allreduce(
+        op, axes, compression, prescale_factor, postscale_factor,
+        _resolve_hierarchical(hierarchical, axes))
 
     def _sync_state(tree):
         def sync(v):

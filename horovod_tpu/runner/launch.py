@@ -56,6 +56,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--stall-check-time-seconds", type=float, default=None)
     p.add_argument("--stall-shutdown-time-seconds", type=float, default=None)
     p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--hierarchical-allreduce", action="store_true",
+                   help="two-level gradient reduction: reduce-scatter over "
+                        "the fast (ICI) mesh axes, cross-slice allreduce "
+                        "over the slow axis, all-gather back "
+                        "(HOROVOD_HIERARCHICAL_ALLREDUCE)")
     p.add_argument("--autotune", action="store_true",
                    help="enable online Bayesian tuning of cycle time / "
                         "fusion threshold / cache (HOROVOD_AUTOTUNE)")
@@ -93,6 +98,8 @@ def _engine_env(args) -> dict:
             args.stall_shutdown_time_seconds)
     if args.no_stall_check:
         env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.hierarchical_allreduce:
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.autotune:
         env["HOROVOD_AUTOTUNE"] = "1"
     if args.autotune_log:

@@ -231,3 +231,77 @@ def test_reducescatter_rejects_unsupported_op(dp_mesh):
     with pytest.raises(ValueError, match="reducescatter"):
         run_spmd(lambda v: c.reducescatter(v, op=c.Min), dp_mesh,
                  per_rank_values((8, 2), jnp.float32), out_specs=P("data"))
+
+
+# -- hierarchical two-level allreduce ---------------------------------------
+# (reference analog: NCCLHierarchicalAllreduce, ops/nccl_operations.cc:186-398)
+
+
+@pytest.fixture
+def two_level_mesh():
+    """2 (slow, 'data' = cross-slice) x 4 (fast, 'fsdp' = intra-slice)."""
+    from horovod_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.build_mesh(mesh_lib.MeshSpec(data=2, fsdp=4))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("shape", [(4,), (3, 5), (7,)])  # 7: pad path
+@pytest.mark.parametrize("op", [c.Sum, c.Average])
+def test_hierarchical_allreduce_matches_flat(two_level_mesh, dtype, shape,
+                                             op):
+    x = per_rank_values(shape, dtype, seed=3)
+
+    def hier(v):
+        return c.hierarchical_allreduce(v, op=op, outer_axis="data",
+                                        inner_axis=("fsdp",))
+
+    def flat(v):
+        return c.allreduce(v, op=op, axis=("data", "fsdp"))
+
+    specs = (P(("data", "fsdp")),)
+    got = run_spmd(hier, two_level_mesh, x, in_specs=specs)
+    want = run_spmd(flat, two_level_mesh, x, in_specs=specs)
+    # hierarchical sums in a different association order than flat psum
+    np.testing.assert_allclose(np.asarray(got, np.float64),
+                               np.asarray(want, np.float64),
+                               rtol=2e-2 if dtype == jnp.bfloat16 else 1e-5)
+
+
+def test_hierarchical_allreduce_scales(two_level_mesh):
+    x = per_rank_values((6,), jnp.float32, seed=4)
+
+    def hier(v):
+        return c.hierarchical_allreduce(v, op=c.Sum, outer_axis="data",
+                                        inner_axis=("fsdp",),
+                                        prescale_factor=0.5,
+                                        postscale_factor=2.0)
+
+    got = run_spmd(hier, two_level_mesh, x, in_specs=(P(("data", "fsdp")),))
+    want = np.asarray(x, np.float64).sum(0) * 0.5 * 2.0
+    np.testing.assert_allclose(np.asarray(got, np.float64), want, rtol=1e-5)
+
+
+def test_train_step_hierarchical_matches_flat(two_level_mesh):
+    import optax
+    from horovod_tpu.parallel import dp
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(4, 2), jnp.float32)}
+    batch = {"x": jnp.asarray(rng.randn(16, 4), jnp.float32),
+             "y": jnp.asarray(rng.randn(16, 2), jnp.float32)}
+    opt = optax.sgd(0.1)
+
+    outs = {}
+    for mode in (False, True):
+        step = dp.make_train_step(loss_fn, opt, two_level_mesh,
+                                  hierarchical=mode, donate=False)
+        p = dp.replicate(params, two_level_mesh)
+        s = dp.replicate(opt.init(params), two_level_mesh)
+        b = dp.shard_batch(batch, two_level_mesh)
+        out = step(p, s, b, jax.random.PRNGKey(0))
+        outs[mode] = np.asarray(out.params["w"])
+    np.testing.assert_allclose(outs[True], outs[False], rtol=1e-6)
