@@ -50,7 +50,7 @@ _lib_lock = threading.Lock()
 
 # Must match hvdtpu_abi_version() in src/c_api.cc; bumped together with any
 # semantic ABI change so a stale prebuilt .so is rejected at load time.
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 
 def _lib_path() -> Path:
@@ -158,6 +158,8 @@ def load_library():
         lib.hvdtpu_data_fetch.restype = ctypes.c_int32
         lib.hvdtpu_data_fetch.argtypes = [ctypes.c_int64, ctypes.c_void_p,
                                           ctypes.c_int64]
+        lib.hvdtpu_data_ring_ops.restype = ctypes.c_int64
+        lib.hvdtpu_data_ring_ops.argtypes = [ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -261,6 +263,10 @@ class EngineSession:
     @property
     def healthy(self):
         return self._lib.hvdtpu_healthy(self._session) == 1
+
+    def data_ring_ops(self) -> int:
+        """Collectives served by the ring data path (diagnostics)."""
+        return self._lib.hvdtpu_data_ring_ops(self._session)
 
     # -- data plane hookup --------------------------------------------------
 

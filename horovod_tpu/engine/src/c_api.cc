@@ -40,7 +40,14 @@ extern "C" {
 // changed return-code contracts). bindings.py refuses a prebuilt .so
 // whose version doesn't match, so a stale library fails loudly instead
 // of silently changing behavior.
-int32_t hvdtpu_abi_version() { return 2; }
+int32_t hvdtpu_abi_version() { return 3; }
+
+// Collectives served by the ring data path (diagnostics/tests).
+int64_t hvdtpu_data_ring_ops(int64_t session) {
+  Engine* e = GetSession(session);
+  if (!e || !e->data_plane()) return -1;
+  return e->data_plane()->ring_ops();
+}
 
 // Returns session id > 0, or <= 0 on failure (error via
 // hvdtpu_last_error()). transport_kind: "loopback" or "tcp".

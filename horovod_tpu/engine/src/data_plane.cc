@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cmath>
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
@@ -221,6 +222,85 @@ void AdasumPair(std::vector<double>& a, const std::vector<double>& b) {
 
 }  // namespace
 
+DataPlane::DataPlane(std::shared_ptr<ControllerTransport> transport)
+    : transport_(std::move(transport)) {
+  // Below this, star latency wins; above it, ring bandwidth wins
+  // (reference knob analog: HOROVOD_FUSION_THRESHOLD sizing).
+  ring_threshold_ = 1 << 20;
+  if (const char* env = std::getenv("HOROVOD_RING_THRESHOLD_BYTES")) {
+    if (*env) ring_threshold_ = std::atoll(env);
+  }
+}
+
+Status DataPlane::RingAllreduce(void* buffer, int64_t num_elements,
+                                DataType dtype, ReduceKind kind) {
+  const int size = transport_->size();
+  const int rank = transport_->rank();
+  const int64_t es = DataTypeSize(dtype);
+  char* buf = static_cast<char*>(buffer);
+  // chunk c covers counts[c] elements at offs[c]
+  std::vector<int64_t> counts(size), offs(size);
+  const int64_t base = num_elements / size;
+  const int64_t rem = num_elements % size;
+  int64_t off = 0;
+  for (int c = 0; c < size; ++c) {
+    counts[c] = base + (c < rem ? 1 : 0);
+    offs[c] = off;
+    off += counts[c];
+  }
+  // reduce-scatter: after step s each rank's chunk (rank-s-1) holds s+2
+  // contributions; rank ends owning fully-reduced chunk (rank+1)%size
+  std::string incoming;
+  for (int s = 0; s < size - 1; ++s) {
+    const int sc = ((rank - s) % size + size) % size;
+    const int rc = ((rank - s - 1) % size + size) % size;
+    auto st = transport_->RingExchange(buf + offs[sc] * es, counts[sc] * es,
+                                       &incoming);
+    if (!st.ok()) return st;
+    Combine(buf + offs[rc] * es, incoming.data(), counts[rc], dtype, kind);
+  }
+  // allgather: circulate the reduced chunks
+  for (int s = 0; s < size - 1; ++s) {
+    const int sc = ((rank + 1 - s) % size + size) % size;
+    const int rc = ((rank - s) % size + size) % size;
+    auto st = transport_->RingExchange(buf + offs[sc] * es, counts[sc] * es,
+                                       &incoming);
+    if (!st.ok()) return st;
+    std::memcpy(buf + offs[rc] * es, incoming.data(), counts[rc] * es);
+  }
+  ++ring_ops_;
+  return Status::OK();
+}
+
+Status DataPlane::RingBcast(void* buffer, int64_t nbytes, int32_t root) {
+  const int size = transport_->size();
+  const int rank = transport_->rank();
+  const int64_t kChunk = 1 << 20;
+  char* buf = static_cast<char*>(buffer);
+  const bool tail = (rank + 1) % size == root;  // last relay before root
+  for (int64_t off = 0; off < nbytes; off += kChunk) {
+    const int64_t n = std::min(kChunk, nbytes - off);
+    if (rank == root) {
+      auto st = transport_->RingSend(std::string(buf + off, n));
+      if (!st.ok()) return st;
+    } else {
+      std::string chunk;
+      auto st = transport_->RingRecv(&chunk);
+      if (!st.ok()) return st;
+      if (static_cast<int64_t>(chunk.size()) != n) {
+        return Status::Unknown("ring bcast chunk size mismatch");
+      }
+      std::memcpy(buf + off, chunk.data(), n);
+      if (!tail) {
+        st = transport_->RingSend(chunk);
+        if (!st.ok()) return st;
+      }
+    }
+  }
+  ++ring_ops_;
+  return Status::OK();
+}
+
 Status DataPlane::Allreduce(void* buffer, int64_t num_elements,
                             DataType dtype, ReduceKind kind, double prescale,
                             double postscale) {
@@ -232,6 +312,16 @@ Status DataPlane::Allreduce(void* buffer, int64_t num_elements,
         std::string(DataTypeName(dtype)));
   }
   if (prescale != 1.0) ScaleBuffer(buffer, num_elements, dtype, prescale);
+  if (size > 1 && kind != ReduceKind::ADASUM && nbytes >= ring_threshold_ &&
+      num_elements >= size) {
+    auto st = RingAllreduce(buffer, num_elements, dtype, kind);
+    if (!st.ok()) return st;
+    if (kind == ReduceKind::AVERAGE) {
+      ScaleBuffer(buffer, num_elements, dtype, 1.0 / size);
+    }
+    if (postscale != 1.0) ScaleBuffer(buffer, num_elements, dtype, postscale);
+    return Status::OK();
+  }
   if (size > 1) {
     std::string mine(static_cast<const char*>(buffer), nbytes);
     std::vector<std::string> all;
@@ -309,6 +399,9 @@ Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
 }
 
 Status DataPlane::Bcast(void* buffer, int64_t nbytes, int32_t root) {
+  if (transport_->size() > 1 && nbytes >= ring_threshold_) {
+    return RingBcast(buffer, nbytes, root);
+  }
   // Star topology with rank-0 hub: non-zero roots relay through rank 0.
   const int rank = transport_->rank();
   if (root != 0) {

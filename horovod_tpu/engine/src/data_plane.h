@@ -7,7 +7,11 @@
 // (broadcast_object, metric averaging, optimizer-state sync, CPU-staged
 // tensors) the way the reference's MPI/Gloo CPU ops do.
 //
-// Topology: star via ControllerTransport (root combines, broadcasts).
+// Topology: control-sized payloads ride the rank-0 star (one round trip,
+// minimal latency); payloads >= HOROVOD_RING_THRESHOLD_BYTES take ring
+// algorithms over neighbor p2p links — O(bytes) traffic per rank
+// independent of world size (reference analog: gloo's ring/halving-doubling
+// ops, ops/gloo_operations.cc).
 // Reduction math: typed kernels including fp16/bf16 accumulation (half.cc)
 // and a binary-tree Adasum (reference: adasum_mpi.cc VHDD — same pairwise
 // combination, tree order).
@@ -35,8 +39,11 @@ enum class ReduceKind : int32_t {
 
 class DataPlane {
  public:
-  explicit DataPlane(std::shared_ptr<ControllerTransport> transport)
-      : transport_(std::move(transport)) {}
+  explicit DataPlane(std::shared_ptr<ControllerTransport> transport);
+
+  // Number of collectives served by the ring path (tests assert the ring
+  // actually engaged for large payloads).
+  int64_t ring_ops() const { return ring_ops_; }
 
   // In-place allreduce over num_elements of dtype.
   Status Allreduce(void* buffer, int64_t num_elements, DataType dtype,
@@ -56,7 +63,16 @@ class DataPlane {
                    std::string* out, std::vector<int64_t>* recv_bytes);
 
  private:
+  // O(bytes)-per-rank ring algorithms for payloads >= ring_threshold_:
+  // reduce-scatter + allgather around the ring (allreduce), pipelined
+  // chunk relay (bcast).
+  Status RingAllreduce(void* buffer, int64_t num_elements, DataType dtype,
+                       ReduceKind kind);
+  Status RingBcast(void* buffer, int64_t nbytes, int32_t root);
+
   std::shared_ptr<ControllerTransport> transport_;
+  int64_t ring_threshold_;
+  int64_t ring_ops_ = 0;
 };
 
 }  // namespace hvdtpu

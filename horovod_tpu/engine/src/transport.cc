@@ -4,6 +4,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
@@ -19,7 +20,9 @@ namespace hvdtpu {
 // ---------------------------------------------------------------------------
 // Loopback
 
-LoopbackHub::LoopbackHub(int size_in) : size(size_in), slots(size_in) {}
+LoopbackHub::LoopbackHub(int size_in)
+    : size(size_in), slots(size_in), ring_slots(size_in),
+      ring_full(size_in, false) {}
 
 void LoopbackHub::BarrierWait() {
   std::unique_lock<std::mutex> lock(mu);
@@ -125,6 +128,39 @@ Status LoopbackTransport::Barrier() {
   return hub_->aborted ? Status::Aborted("loopback hub aborted") : Status::OK();
 }
 
+Status LoopbackTransport::RingSend(const std::string& payload) {
+  std::unique_lock<std::mutex> lock(hub_->mu);
+  hub_->cv.wait(lock,
+                [&] { return !hub_->ring_full[rank_] || hub_->aborted; });
+  if (hub_->aborted) return Status::Aborted("loopback hub aborted");
+  hub_->ring_slots[rank_] = payload;
+  hub_->ring_full[rank_] = true;
+  hub_->cv.notify_all();
+  return Status::OK();
+}
+
+Status LoopbackTransport::RingRecv(std::string* payload) {
+  const int prev = (rank_ - 1 + hub_->size) % hub_->size;
+  std::unique_lock<std::mutex> lock(hub_->mu);
+  hub_->cv.wait(lock,
+                [&] { return hub_->ring_full[prev] || hub_->aborted; });
+  if (hub_->aborted) return Status::Aborted("loopback hub aborted");
+  *payload = std::move(hub_->ring_slots[prev]);
+  hub_->ring_slots[prev].clear();
+  hub_->ring_full[prev] = false;
+  hub_->cv.notify_all();
+  return Status::OK();
+}
+
+Status LoopbackTransport::RingExchange(const void* send, int64_t send_len,
+                                       std::string* recv) {
+  // Every rank's mailbox has a distinct single producer/consumer, so
+  // send-then-recv cannot deadlock when all ranks participate.
+  auto st = RingSend(std::string(static_cast<const char*>(send), send_len));
+  if (!st.ok()) return st;
+  return RingRecv(recv);
+}
+
 namespace {
 std::mutex g_hub_mu;
 std::unordered_map<std::string, std::shared_ptr<LoopbackHub>> g_hubs;
@@ -202,6 +238,9 @@ TcpTransport::~TcpTransport() {
   for (int fd : worker_fds_) {
     if (fd >= 0 && fd != root_fd_) ::close(fd);
   }
+  if (ring_listen_fd_ >= 0) ::close(ring_listen_fd_);
+  if (ring_next_fd_ >= 0) ::close(ring_next_fd_);
+  if (ring_prev_fd_ >= 0) ::close(ring_prev_fd_);
 }
 
 Status TcpTransport::Init() {
@@ -382,6 +421,215 @@ Status TcpTransport::Barrier() {
   if (!st.ok()) return st;
   std::string empty;
   return Bcast(&empty);
+}
+
+Status TcpTransport::EnsureRing() {
+  if (ring_next_fd_ >= 0 || size_ == 1) return Status::OK();
+  // Any failure closes partial state: a half-built ring must not leak fds
+  // or leave a dead listener advertised; the error fails the collective
+  // loudly (engine FailAll) rather than wedging a retry mid-rendezvous.
+  auto fail = [this](const std::string& msg) {
+    if (ring_listen_fd_ >= 0) { ::close(ring_listen_fd_); ring_listen_fd_ = -1; }
+    if (ring_next_fd_ >= 0) { ::close(ring_next_fd_); ring_next_fd_ = -1; }
+    if (ring_prev_fd_ >= 0) { ::close(ring_prev_fd_); ring_prev_fd_ = -1; }
+    return Status::Unknown(msg);
+  };
+  // 1. ephemeral listener for the predecessor's connection
+  ring_listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (ring_listen_fd_ < 0) return fail("ring socket() failed");
+  int one = 1;
+  setsockopt(ring_listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = INADDR_ANY;
+  sa.sin_port = 0;
+  if (::bind(ring_listen_fd_, reinterpret_cast<sockaddr*>(&sa),
+             sizeof(sa)) != 0 ||
+      ::listen(ring_listen_fd_, 2) != 0) {
+    return fail("ring bind/listen failed");
+  }
+  socklen_t slen = sizeof(sa);
+  getsockname(ring_listen_fd_, reinterpret_cast<sockaddr*>(&sa), &slen);
+  const int my_port = ntohs(sa.sin_port);
+
+  // 2. my reachable address: the local IP of the star link to root (root
+  // advertises the controller address the launcher handed out)
+  std::string my_ip = addr_;
+  if (rank_ != 0) {
+    sockaddr_in local{};
+    socklen_t llen = sizeof(local);
+    getsockname(root_fd_, reinterpret_cast<sockaddr*>(&local), &llen);
+    char buf[INET_ADDRSTRLEN];
+    inet_ntop(AF_INET, &local.sin_addr, buf, sizeof(buf));
+    my_ip = buf;
+  }
+
+  // 3. address table rides the star
+  std::vector<std::string> table;
+  auto st = Gather(my_ip + ":" + std::to_string(my_port),
+                   rank_ == 0 ? &table : nullptr);
+  if (!st.ok()) { fail(""); return st; }
+  std::string packed;
+  if (rank_ == 0) {
+    for (auto& a : table) packed += a + "\n";
+  }
+  st = Bcast(&packed);
+  if (!st.ok()) { fail(""); return st; }
+  std::vector<std::string> addrs;
+  size_t pos = 0;
+  while (pos < packed.size()) {
+    size_t nl = packed.find('\n', pos);
+    addrs.push_back(packed.substr(pos, nl - pos));
+    pos = nl + 1;
+  }
+  if (static_cast<int>(addrs.size()) != size_) {
+    return fail("ring address table size mismatch");
+  }
+
+  // 4. connect to successor (completes via its listen backlog), then
+  // accept the predecessor — no ordering deadlock
+  const std::string& next = addrs[(rank_ + 1) % size_];
+  const size_t colon = next.rfind(':');
+  const std::string next_ip = next.substr(0, colon);
+  const int next_port = std::stoi(next.substr(colon + 1));
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(
+                      timeout_sec_ > 0 ? timeout_sec_ : 60.0);
+  while (true) {
+    ring_next_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (ring_next_fd_ < 0) return fail("ring socket() failed");
+    sockaddr_in peer{};
+    peer.sin_family = AF_INET;
+    peer.sin_port = htons(static_cast<uint16_t>(next_port));
+    if (inet_pton(AF_INET, next_ip.c_str(), &peer.sin_addr) != 1) {
+      return fail("bad ring peer address " + next_ip);
+    }
+    if (::connect(ring_next_fd_, reinterpret_cast<sockaddr*>(&peer),
+                  sizeof(peer)) == 0) {
+      break;
+    }
+    ::close(ring_next_fd_);
+    ring_next_fd_ = -1;
+    if (std::chrono::steady_clock::now() > deadline) {
+      return fail("timed out connecting ring successor " + next);
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  setsockopt(ring_next_fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  SetTimeout(ring_next_fd_, timeout_sec_);
+  // bounded accept: a predecessor that died after the address exchange must
+  // fail this rank loudly, not hang it
+  struct pollfd lp = {ring_listen_fd_, POLLIN, 0};
+  int prc = ::poll(&lp, 1, static_cast<int>(
+      (timeout_sec_ > 0 ? timeout_sec_ : 60.0) * 1000));
+  if (prc <= 0) return fail("timed out waiting for ring predecessor");
+  ring_prev_fd_ = ::accept(ring_listen_fd_, nullptr, nullptr);
+  if (ring_prev_fd_ < 0) return fail("ring accept failed");
+  setsockopt(ring_prev_fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  SetTimeout(ring_prev_fd_, timeout_sec_);
+  return Status::OK();
+}
+
+Status TcpTransport::RingSend(const std::string& payload) {
+  auto st = EnsureRing();
+  if (!st.ok()) return st;
+  return SendFrame(ring_next_fd_, payload);
+}
+
+Status TcpTransport::RingRecv(std::string* payload) {
+  auto st = EnsureRing();
+  if (!st.ok()) return st;
+  return RecvFrame(ring_prev_fd_, payload);
+}
+
+Status TcpTransport::RingExchange(const void* send, int64_t send_len,
+                                  std::string* recv) {
+  auto st = EnsureRing();
+  if (!st.ok()) return st;
+  // Full-duplex: interleave the outgoing frame to the successor with the
+  // incoming frame from the predecessor via poll(), so simultaneous large
+  // frames around the ring can't deadlock on filled socket buffers. Sends
+  // and recvs use MSG_DONTWAIT — poll() only guarantees *some* progress is
+  // possible, and a blocking send of a frame larger than the socket buffer
+  // would stall the receive side and re-create the deadlock.
+  // Same uint32 framing as SendFrame/RecvFrame, so RingSend/RingRecv and
+  // RingExchange can be mixed across (lockstep) collectives. The payload is
+  // streamed straight from the caller's buffer (header kept separately) —
+  // no staging copy.
+  const char* send_data = static_cast<const char*>(send);
+  uint32_t send_hdr = static_cast<uint32_t>(send_len);
+  size_t hdr_sent = 0;
+  int64_t sent = 0;
+  uint32_t recv_len = 0;
+  size_t recv_hdr = 0;
+  int64_t recvd = 0;
+  bool recv_hdr_done = false;
+  while (hdr_sent < sizeof(send_hdr) || sent < send_len || !recv_hdr_done ||
+         recvd < static_cast<int64_t>(recv_len)) {
+    struct pollfd fds[2];
+    int n = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (hdr_sent < sizeof(send_hdr) || sent < send_len) {
+      fds[n] = {ring_next_fd_, POLLOUT, 0};
+      send_idx = n++;
+    }
+    if (!recv_hdr_done || recvd < static_cast<int64_t>(recv_len)) {
+      fds[n] = {ring_prev_fd_, POLLIN, 0};
+      recv_idx = n++;
+    }
+    int rc = ::poll(fds, n, static_cast<int>(
+        (timeout_sec_ > 0 ? timeout_sec_ : 60.0) * 1000));
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("ring poll failed: ") +
+                             strerror(errno));
+    }
+    if (rc == 0) return Status::Unknown("ring exchange timed out");
+    if (send_idx >= 0 && (fds[send_idx].revents & (POLLOUT | POLLERR))) {
+      ssize_t w;
+      if (hdr_sent < sizeof(send_hdr)) {
+        w = ::send(ring_next_fd_,
+                   reinterpret_cast<const char*>(&send_hdr) + hdr_sent,
+                   sizeof(send_hdr) - hdr_sent,
+                   MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (w > 0) hdr_sent += static_cast<size_t>(w);
+      } else {
+        w = ::send(ring_next_fd_, send_data + sent, send_len - sent,
+                   MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (w > 0) sent += w;
+      }
+      if (w < 0 && errno != EINTR && errno != EAGAIN &&
+          errno != EWOULDBLOCK) {
+        return Status::Unknown(std::string("ring send failed: ") +
+                               strerror(errno));
+      }
+    }
+    if (recv_idx >= 0 &&
+        (fds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t r;
+      if (!recv_hdr_done) {
+        char* hdr = reinterpret_cast<char*>(&recv_len);
+        r = ::recv(ring_prev_fd_, hdr + recv_hdr,
+                   sizeof(recv_len) - recv_hdr, MSG_DONTWAIT);
+        if (r > 0) recv_hdr += static_cast<size_t>(r);
+        if (recv_hdr == sizeof(recv_len)) {
+          recv_hdr_done = true;
+          recv->resize(recv_len);
+        }
+      } else {
+        r = ::recv(ring_prev_fd_, recv->data() + recvd, recv_len - recvd,
+                   MSG_DONTWAIT);
+        if (r > 0) recvd += r;
+      }
+      if (r == 0) return Status::Aborted("ring peer closed");
+      if (r < 0 && errno != EINTR && errno != EAGAIN &&
+          errno != EWOULDBLOCK) {
+        return Status::Unknown(std::string("ring recv failed: ") +
+                               strerror(errno));
+      }
+    }
+  }
+  return Status::OK();
 }
 
 }  // namespace hvdtpu

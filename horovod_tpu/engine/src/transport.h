@@ -51,6 +51,18 @@ class ControllerTransport {
   virtual Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) = 0;
 
   virtual Status Barrier() = 0;
+
+  // -- ring neighbor p2p (large-payload data plane) -------------------------
+  // Framed transfers to rank (r+1)%size / from (r-1+size)%size. Links are
+  // established lazily on first use; all ranks must call collectively (the
+  // data plane invokes these in lockstep). RingExchange performs the send
+  // and receive concurrently (full-duplex) so ring algorithms cannot
+  // deadlock on large frames; it takes a raw pointer so callers stream
+  // straight out of the reduction buffer with no staging copy.
+  virtual Status RingSend(const std::string& payload) = 0;
+  virtual Status RingRecv(std::string* payload) = 0;
+  virtual Status RingExchange(const void* send, int64_t send_len,
+                              std::string* recv) = 0;
 };
 
 // ---------------------------------------------------------------------------
@@ -69,6 +81,9 @@ struct LoopbackHub {
   int arrived = 0;
   uint64_t generation = 0;
   bool aborted = false;
+  // ring mailboxes: slot r is written by rank r, consumed by rank (r+1)%size
+  std::vector<std::string> ring_slots;
+  std::vector<bool> ring_full;
 
   void BarrierWait();
   void Abort();
@@ -87,6 +102,10 @@ class LoopbackTransport : public ControllerTransport {
                  std::string* mine) override;
   Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) override;
   Status Barrier() override;
+  Status RingSend(const std::string& payload) override;
+  Status RingRecv(std::string* payload) override;
+  Status RingExchange(const void* send, int64_t send_len,
+                      std::string* recv) override;
 
  private:
   std::shared_ptr<LoopbackHub> hub_;
@@ -120,10 +139,18 @@ class TcpTransport : public ControllerTransport {
                  std::string* mine) override;
   Status BitAllreduce(std::vector<uint64_t>* bits, bool is_and) override;
   Status Barrier() override;
+  Status RingSend(const std::string& payload) override;
+  Status RingRecv(std::string* payload) override;
+  Status RingExchange(const void* send, int64_t send_len,
+                      std::string* recv) override;
 
  private:
   Status SendFrame(int fd, const std::string& payload);
   Status RecvFrame(int fd, std::string* payload);
+  // Lazily builds neighbor links: every rank binds an ephemeral listener,
+  // addresses ride a Gather+Bcast on the star, then each rank connects to
+  // its successor and accepts from its predecessor.
+  Status EnsureRing();
 
   int rank_;
   int size_;
@@ -133,6 +160,9 @@ class TcpTransport : public ControllerTransport {
   int listen_fd_ = -1;
   int root_fd_ = -1;                 // worker→root socket (workers)
   std::vector<int> worker_fds_;      // root's sockets indexed by rank
+  int ring_listen_fd_ = -1;
+  int ring_next_fd_ = -1;            // to (rank+1)%size
+  int ring_prev_fd_ = -1;            // from (rank-1+size)%size
 };
 
 }  // namespace hvdtpu
