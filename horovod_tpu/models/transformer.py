@@ -1,0 +1,73 @@
+"""Transformer encoder (BERT family) — the second benchmark flagship.
+
+Parity target: the reference benchmarks BERT-Large pretraining with tensor
+fusion + fp16 gradient compression (reference: docs/benchmarks.rst:67-83
+protocol; BASELINE.md config 3). From-scratch flax.linen, TPU-first: bf16
+activations on the MXU with fp32 params, static shapes, bias-free layernorm
+residual blocks in the pre-LN arrangement XLA fuses cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class EncoderBlock(nn.Module):
+    hidden: int
+    heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype,
+            deterministic=deterministic)(h, h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.hidden, dtype=self.dtype)(h)
+        return x + h
+
+
+class BertEncoder(nn.Module):
+    """Masked-LM encoder: embeddings -> N blocks -> tied-ish LM head."""
+
+    vocab: int = 30522
+    layers: int = 12
+    hidden: int = 768
+    heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        pos = jnp.arange(tokens.shape[1])[None, :]
+        embed = nn.Embed(self.vocab, self.hidden, dtype=self.dtype)
+        x = embed(tokens)
+        x = x + nn.Embed(self.max_len, self.hidden,
+                         dtype=self.dtype)(pos)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        for _ in range(self.layers):
+            x = EncoderBlock(self.hidden, self.heads, self.mlp_dim,
+                             self.dtype)(x, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        # LM head tied to the input embedding (BERT geometry)
+        logits = embed.attend(x)
+        logits = logits + self.param("lm_bias", nn.initializers.zeros,
+                                     (self.vocab,), jnp.float32)
+        return logits.astype(jnp.float32)
+
+
+def BertBase(**kw) -> BertEncoder:
+    return BertEncoder(layers=12, hidden=768, heads=12, mlp_dim=3072, **kw)
+
+
+def BertLarge(**kw) -> BertEncoder:
+    return BertEncoder(layers=24, hidden=1024, heads=16, mlp_dim=4096, **kw)
