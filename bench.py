@@ -252,8 +252,9 @@ def main():
     scaling_eff = _run_scaling_probe()
     try:
         bert_seq_per_sec = _bert_bench(mesh, n_dev)
-    except Exception:
-        bert_seq_per_sec = -1.0  # secondary figure must not sink the bench
+    except Exception as e:  # secondary figure must not sink the bench
+        print(f"bert bench failed: {e!r}", file=sys.stderr)
+        bert_seq_per_sec = -1.0
 
     images_per_sec = batch_size * ITERS / best_dt
     per_chip = images_per_sec / n_dev

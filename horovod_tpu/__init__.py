@@ -61,3 +61,13 @@ from horovod_tpu.parallel import (  # noqa: F401
     build_mesh,
     data_parallel_mesh,
 )
+
+
+def run(func, args=(), kwargs=None, np=1, hosts=None, start_timeout=120.0,
+        extra_args=None, verbose=False):
+    """Programmatic in-process launcher (reference: horovod.run,
+    runner/__init__.py:206). See horovod_tpu.runner.run."""
+    from horovod_tpu.runner import run as _run
+    return _run(func, args=args, kwargs=kwargs, np=np, hosts=hosts,
+                start_timeout=start_timeout, extra_args=extra_args,
+                verbose=verbose)

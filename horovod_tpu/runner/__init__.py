@@ -1,0 +1,94 @@
+"""Programmatic launcher (reference: horovod/runner/__init__.py:206 —
+``horovod.run(func, np=N)`` returning each rank's result).
+
+Reuses the hvdrun-tpu machinery (rendezvous KV, env contract, fail-fast
+supervision) with a worker command that executes a cloudpickled function
+and ships its return value back through a shared results directory.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from typing import Any, List, Optional
+
+
+def run(func,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        np: int = 1,
+        hosts: Optional[str] = None,
+        start_timeout: float = 120.0,
+        extra_args: Optional[List[str]] = None,
+        verbose: bool = False) -> List[Any]:
+    """Run ``func(*args, **kwargs)`` on ``np`` coordinated worker processes
+    and return the per-rank results in rank order (reference:
+    runner/__init__.py run()).
+
+    ``hosts`` takes the launcher's "host:slots,..." syntax; results are
+    collected from a shared directory, so remote hosts need it on a shared
+    filesystem (the reference ships results over its task service —
+    localhost jobs, the interactive-run staple, need nothing).
+    ``extra_args`` passes additional hvdrun-tpu flags (engine knobs).
+    """
+    import cloudpickle  # lazy: CLI launches must not require it
+
+    from horovod_tpu.runner import launch as launch_lib
+
+    kwargs = kwargs or {}
+
+    def wrapped():
+        return func(*args, **kwargs)
+
+    with tempfile.TemporaryDirectory(prefix="hvdtpu_run_") as td:
+        fn_path = os.path.join(td, "func.pkl")
+        with open(fn_path, "wb") as f:
+            cloudpickle.dump(wrapped, f)
+        command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
+                   fn_path, td]
+        argv = ["-np", str(np),
+                "-H", hosts or f"localhost:{np}",
+                "--start-timeout", str(start_timeout)]
+        if verbose:
+            argv.append("--verbose")
+        argv += list(extra_args or [])
+        argv += ["--"] + command
+        try:
+            parsed = launch_lib.make_parser().parse_args(argv)
+        except SystemExit as e:
+            # library API: a bad extra_args flag must raise, not kill the
+            # caller's process via argparse's sys.exit
+            raise ValueError(
+                f"invalid launcher arguments {extra_args!r}") from e
+        parsed.command = command
+
+        import time
+        deadline = time.monotonic() + start_timeout
+        all_started = [False]
+
+        def not_started_by_deadline():
+            if all_started[0] or time.monotonic() < deadline:
+                return None
+            missing = [r for r in range(np) if not os.path.exists(
+                os.path.join(td, f"started.{r}"))]
+            if missing:
+                return (f"ranks {missing} did not start within "
+                        f"{start_timeout}s")
+            all_started[0] = True
+            return None
+
+        rc = launch_lib.run_static(parsed,
+                                   liveness_check=not_started_by_deadline)
+        if rc != 0:
+            raise RuntimeError(f"horovod_tpu.run failed with exit code {rc}")
+        results = []
+        for r in range(np):
+            path = os.path.join(td, f"result.{r}.pkl")
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    f"no result from rank {r}: remote hosts need the "
+                    "results directory on a shared filesystem")
+            with open(path, "rb") as f:
+                results.append(cloudpickle.load(f))
+        return results

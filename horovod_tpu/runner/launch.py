@@ -175,7 +175,7 @@ def worker_env(slot, controller_addr, controller_port, data_port,
     return env
 
 
-def run_static(args) -> int:
+def run_static(args, liveness_check=None) -> int:
     host_string = args.hosts or f"localhost:{args.num_proc}"
     host_list = hosts_lib.parse_hosts(host_string)
     np_ = args.num_proc or sum(h.slots for h in host_list)
@@ -197,18 +197,29 @@ def run_static(args) -> int:
                              kv.port, extra, rendezvous_addr=rdv_addr)
             workers.append(WorkerProcess(s.hostname, s.rank, args.command,
                                          env))
-        return _wait_all(workers)
+        return _wait_all(workers, liveness_check)
     finally:
         kv.stop()
 
 
-def _wait_all(workers: List[WorkerProcess]) -> int:
+def _wait_all(workers: List[WorkerProcess], liveness_check=None) -> int:
     """Fail fast: first non-zero exit kills the rest (reference:
-    gloo_run terminate-on-failure)."""
+    gloo_run terminate-on-failure). ``liveness_check()`` (if given) runs
+    every poll; a non-None error string aborts the job — the programmatic
+    run() uses it to enforce start_timeout."""
     rc = 0
     pending = {w.rank: w for w in workers}
     try:
         while pending:
+            if liveness_check is not None:
+                err = liveness_check()
+                if err is not None:
+                    sys.stderr.write(f"[launcher] {err}; terminating job\n")
+                    for other in pending.values():
+                        other.terminate()
+                    for other in pending.values():
+                        other.wait(timeout=10)
+                    return 1
             for rank, w in list(pending.items()):
                 code = w.poll()
                 if code is None:

@@ -1,0 +1,36 @@
+"""Worker entry for the programmatic run() API.
+
+Reference analog: horovod/runner/run_task.py + the SafeShell func wrapper
+(runner/__init__.py:206 run(func) → per-worker func execution with the
+return value shipped back to the launcher).
+
+Executes the cloudpickled function and drops its return value into the
+shared results directory as ``result.<rank>.pkl``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import cloudpickle
+
+
+def main():
+    fn_path, out_dir = sys.argv[1], sys.argv[2]
+    rank0 = os.environ.get("HOROVOD_RANK", "0")
+    # start marker: the launcher's start_timeout watches for these
+    with open(os.path.join(out_dir, f"started.{rank0}"), "w"):
+        pass
+    with open(fn_path, "rb") as f:
+        fn = cloudpickle.load(f)
+    result = fn()
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    tmp = os.path.join(out_dir, f".result.{rank}.tmp")
+    with open(tmp, "wb") as f:
+        cloudpickle.dump(result, f)
+    os.replace(tmp, os.path.join(out_dir, f"result.{rank}.pkl"))
+
+
+if __name__ == "__main__":
+    main()

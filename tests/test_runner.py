@@ -133,3 +133,59 @@ def test_static_launch_failure_propagates(tmp_path, monkeypatch):
 def test_cli_requires_command():
     with pytest.raises(SystemExit):
         run_commandline(["-np", "2"])
+
+
+def test_programmatic_run_returns_per_rank_results():
+    """horovod_tpu.run(fn, np=N) executes fn on N coordinated processes and
+    returns rank-ordered results (reference:
+    test/integration/test_interactiverun.py:94)."""
+    import horovod_tpu
+
+    def fn(scale):
+        import numpy as np
+        import horovod_tpu as hvd
+        import horovod_tpu.jax as hvd_jax
+        hvd.init()
+        total = float(np.asarray(hvd_jax.allreduce(
+            np.asarray([float(hvd.rank())], np.float32), op=hvd_jax.Sum))[0])
+        out = (hvd.rank(), hvd.size(), total * scale)
+        hvd.shutdown()
+        return out
+
+    results = horovod_tpu.run(fn, args=(2.0,), np=3)
+    assert results == [(r, 3, 6.0) for r in range(3)], results
+
+
+def test_programmatic_run_propagates_failure():
+    import pytest
+    import horovod_tpu
+
+    def boom():
+        raise RuntimeError("worker exploded")
+
+    with pytest.raises(RuntimeError, match="exit code"):
+        horovod_tpu.run(boom, np=2)
+
+
+def test_programmatic_run_start_timeout():
+    """The liveness hook aborts a job whose workers never start (the
+    mechanism behind run()'s start_timeout) instead of hanging forever."""
+    import sys
+    import time
+    from horovod_tpu.runner import launch as launch_lib
+
+    argv = ["-np", "1", "-H", "localhost:1", "--",
+            sys.executable, "-c", "import time; time.sleep(120)"]
+    parsed = launch_lib.make_parser().parse_args(argv)
+    parsed.command = argv[-3:]
+
+    t0 = time.monotonic()
+
+    def never_started():
+        if time.monotonic() - t0 > 2.0:
+            return "ranks [0] did not start within 2.0s"
+        return None
+
+    rc = launch_lib.run_static(parsed, liveness_check=never_started)
+    assert rc == 1
+    assert time.monotonic() - t0 < 30, "liveness abort did not bound the job"
