@@ -63,11 +63,5 @@ from horovod_tpu.parallel import (  # noqa: F401
 )
 
 
-def run(func, args=(), kwargs=None, np=1, hosts=None, start_timeout=120.0,
-        extra_args=None, verbose=False):
-    """Programmatic in-process launcher (reference: horovod.run,
-    runner/__init__.py:206). See horovod_tpu.runner.run."""
-    from horovod_tpu.runner import run as _run
-    return _run(func, args=args, kwargs=kwargs, np=np, hosts=hosts,
-                start_timeout=start_timeout, extra_args=extra_args,
-                verbose=verbose)
+# Programmatic launcher (reference: horovod.run, runner/__init__.py:206).
+from horovod_tpu.runner import run  # noqa: F401,E402

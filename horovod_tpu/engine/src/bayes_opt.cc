@@ -127,12 +127,16 @@ std::vector<double> BayesianOptimizer::Suggest() {
   static const int kPrimes[] = {2, 3, 5, 7, 11, 13};
   // Cold start: space-fill with the Halton sequence until we have enough
   // samples for a useful surrogate (reference seeds its GP the same way).
+  auto snap = [this](std::vector<double>& x) {
+    for (int d : categorical_dims_) x[d] = x[d] >= 0.5 ? 1.0 : 0.0;
+  };
   if (ys_.size() < 3) {
     std::vector<double> x(dim_);
     for (int d = 0; d < dim_; ++d) {
       x[d] = NextHalton(halton_index_, kPrimes[d % 6]);
     }
     ++halton_index_;
+    snap(x);
     return x;
   }
   // Normalize y to zero mean / unit variance for GP stability.
@@ -163,6 +167,7 @@ std::vector<double> BayesianOptimizer::Suggest() {
       x[d] = NextHalton(halton_index_, kPrimes[d % 6]);
     }
     ++halton_index_;
+    snap(x);
     cands.push_back(std::move(x));
   }
   auto inc = BestPoint();
@@ -170,6 +175,12 @@ std::vector<double> BayesianOptimizer::Suggest() {
     std::vector<double> x(dim_);
     for (int d = 0; d < dim_; ++d) {
       x[d] = std::min(1.0, std::max(0.0, inc[d] + 0.1 * (xorshift() - 0.5)));
+    }
+    // jitter explores the incumbent's plane; flip the categorical axes
+    // occasionally so the other plane keeps getting probed
+    for (int d : categorical_dims_) {
+      double base = inc[d] >= 0.5 ? 1.0 : 0.0;
+      x[d] = xorshift() < 0.25 ? 1.0 - base : base;
     }
     cands.push_back(std::move(x));
   }

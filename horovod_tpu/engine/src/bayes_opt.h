@@ -46,6 +46,12 @@ class BayesianOptimizer {
   // Next point to evaluate: argmax of expected improvement over a Halton
   // candidate set (plus local jitter around the incumbent).
   std::vector<double> Suggest();
+
+  // Mark a dimension as categorical {0,1}: every candidate (Halton and
+  // incumbent-jitter) snaps that coordinate, so the acquisition never
+  // scores the meaningless interpolation between the two planes and
+  // samples stay on them.
+  void SetCategoricalDim(int dim) { categorical_dims_.push_back(dim); }
   // Best observed point so far (empty before any sample).
   std::vector<double> BestPoint() const;
   double BestValue() const;
@@ -57,6 +63,7 @@ class BayesianOptimizer {
   int dim_;
   uint64_t rng_state_;
   int halton_index_ = 1;
+  std::vector<int> categorical_dims_;
   std::vector<std::vector<double>> xs_;
   std::vector<double> ys_;
 };

@@ -70,6 +70,7 @@ void ParameterManager::Initialize(const EngineOptions& opts,
   sample_cycles_ = opts.autotune_sample_cycles;
   if (!active_) return;
   opt_ = std::make_unique<BayesianOptimizer>(/*dim=*/3);
+  opt_->SetCategoricalDim(2);  // cache_enabled is {off,on}, not a scale
   if (is_coordinator_ && !opts.autotune_log_path.empty()) {
     log_file_ = std::fopen(opts.autotune_log_path.c_str(), "w");
     if (log_file_ != nullptr) {

@@ -157,26 +157,36 @@ def run(func: Callable) -> Callable:
     def wrapper(state: State, *args, **kwargs):
         start_notification_poller()
         skip_sync = False
-        while True:
-            try:
-                # Sync-first, including the very first iteration: a freshly
-                # spawned worker receives the committed state before its
-                # first training collective (reference: common/elastic.py
-                # run_fn). sync() itself runs collectives, so it sits inside
-                # the retry scope: a peer dying mid-sync restores + resets
-                # instead of crashing this worker.
-                if not skip_sync:
-                    state.sync()
-                result = func(state, *args, **kwargs)
-                _record_final_state(success=True)
-                return result
-            except HorovodInternalError:
-                state.restore()
-                skip_sync = False
-            except HostsUpdatedInterrupt as e:
-                skip_sync = e.skip_sync
-            _reset()
-            state.on_reset()
+        try:
+            while True:
+                try:
+                    # Sync-first, including the very first iteration: a
+                    # freshly spawned worker receives the committed state
+                    # before its first training collective (reference:
+                    # common/elastic.py run_fn). sync() itself runs
+                    # collectives, so it sits inside the retry scope: a peer
+                    # dying mid-sync restores + resets instead of crashing
+                    # this worker.
+                    if not skip_sync:
+                        state.sync()
+                    result = func(state, *args, **kwargs)
+                    _record_final_state(success=True)
+                    return result
+                except HorovodInternalError:
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    skip_sync = e.skip_sync
+                _reset()
+                state.on_reset()
+        except SystemExit:
+            raise  # clean slot removal, not a failure
+        except BaseException:
+            # fatal user/framework error: tell the driver's registry so a
+            # generation waiting on this slot's READY rebalances immediately
+            # instead of sitting out the go-barrier timeout
+            _record_final_state(success=False)
+            raise
 
     return wrapper
 

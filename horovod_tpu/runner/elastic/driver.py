@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Tuple
 from horovod_tpu.runner import hosts as hosts_lib
 from horovod_tpu.runner.elastic.discovery import HostDiscovery, HostManager
 from horovod_tpu.runner.elastic.registration import (
+    FAILURE,
     READY,
     SUCCESS,
     WorkerStateRegistry,
@@ -155,6 +156,16 @@ class ElasticDriver:
                     self._rebalance_needed.set()
                 continue
             counts = self._registry.count(gen, dict.fromkeys(expected))
+            if counts.get(FAILURE, 0) > 0:
+                # a slot already failed this generation: waiting out the
+                # barrier would stall everyone for the full timeout — go
+                # straight to the next topology round
+                self._log(f"slot FAILURE at generation {gen} ({counts}); "
+                          f"rebalancing immediately")
+                with self._lock:
+                    self._go_published.add(gen)  # stop polling this gen
+                self._rebalance_needed.set()
+                continue
             if counts.get(READY, 0) + counts.get(SUCCESS, 0) >= len(expected):
                 self._log(f"all {len(expected)} slots READY at generation "
                           f"{gen}; releasing go barrier")
@@ -213,6 +224,16 @@ class ElasticDriver:
                                     for s in slots]
             self._go_deadline = time.monotonic() + GO_BARRIER_TIMEOUT_SECS
             self._kv.put_json("notify", {"generation": gen})
+            # GC stale generations (keep the previous one: stragglers may
+            # still be reading it while re-rendezvousing into gen)
+            old = gen - 2
+            if old >= 0:
+                # trailing "/" so g1 can't swallow g10's keys
+                self._kv.delete_prefix(f"rank_and_size/g{old}/")
+                self._kv.delete_prefix(f"worker_state/g{old}/")
+                self._kv.delete(f"go/g{old}")
+                self._kv.delete(f"reset_request/g{old}")
+                self._go_published.discard(old)
             # spawn workers for slots that have no live process
             for s in slots:
                 key = (s.hostname, s.local_rank)

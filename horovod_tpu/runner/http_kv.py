@@ -86,6 +86,14 @@ class KVServer:
         with self._lock:
             self._store.pop(key, None)
 
+    def delete_prefix(self, prefix: str):
+        """Drop every key under a prefix (generation GC: old topologies,
+        worker states, go/reset records would otherwise accumulate for the
+        life of an elastic job)."""
+        with self._lock:
+            for k in [k for k in self._store if k.startswith(prefix)]:
+                del self._store[k]
+
 
 class KVClient:
     """Worker-side client (reference: runner/http/http_client.py)."""

@@ -202,6 +202,19 @@ def run_static(args, liveness_check=None) -> int:
         kv.stop()
 
 
+def _terminate_all(workers):
+    """SIGTERM + bounded wait, escalating to SIGKILL for processes that
+    trap the signal — the abort paths must return, not raise."""
+    workers = list(workers)
+    for w in workers:
+        w.terminate()
+    for w in workers:
+        try:
+            w.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — TimeoutExpired etc.
+            w.kill()
+
+
 def _wait_all(workers: List[WorkerProcess], liveness_check=None) -> int:
     """Fail fast: first non-zero exit kills the rest (reference:
     gloo_run terminate-on-failure). ``liveness_check()`` (if given) runs
@@ -215,10 +228,7 @@ def _wait_all(workers: List[WorkerProcess], liveness_check=None) -> int:
                 err = liveness_check()
                 if err is not None:
                     sys.stderr.write(f"[launcher] {err}; terminating job\n")
-                    for other in pending.values():
-                        other.terminate()
-                    for other in pending.values():
-                        other.wait(timeout=10)
+                    _terminate_all(pending.values())
                     return 1
             for rank, w in list(pending.items()):
                 code = w.poll()
@@ -230,10 +240,7 @@ def _wait_all(workers: List[WorkerProcess], liveness_check=None) -> int:
                         f"[launcher] worker rank {rank} on {w.hostname} "
                         f"exited with code {code}; terminating job\n")
                     rc = code
-                    for other in pending.values():
-                        other.terminate()
-                    for other in pending.values():
-                        other.wait(timeout=10)
+                    _terminate_all(pending.values())
                     return rc
             time.sleep(0.1)
     except KeyboardInterrupt:

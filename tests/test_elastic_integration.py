@@ -152,3 +152,26 @@ def test_elastic_worker_failure_recovers(tmp_path):
     text = job.finish(timeout=180)
     assert job.proc.returncode == 0, text
     assert "worker-done" in text, text
+
+
+def test_elastic_scale_down(tmp_path):
+    """Remove a host (slot) from discovery mid-run: the dropped worker
+    exits cleanly, survivors re-rendezvous at the smaller world and finish
+    (reference: elastic_common.py:35-62 drives both directions)."""
+    job, hosts_file = _launch_elastic(tmp_path, "localhost:3\n",
+                                      min_np=2, max_np=3, total_steps=40)
+    assert job.wait_for_line("step=2 size=3", timeout=90), \
+        "".join(job.lines)
+    hosts_file.write_text("localhost:2\n")
+    text = job.finish(timeout=180)
+    assert job.proc.returncode == 0, text
+    assert "size=3" in text, text
+    done = [line for line in text.splitlines() if "worker-done" in line]
+    assert done and all("size=2" in line for line in done), \
+        f"job did not finish at the reduced size:\n{text}"
+    # progress must continue (not restart) across the shrink
+    steps_at_2 = [int(line.split("step=")[1].split()[0])
+                  for line in text.splitlines()
+                  if "progress" in line and "size=2" in line]
+    assert steps_at_2 and min(steps_at_2) > 0, \
+        f"survivors restarted from step 0:\n{text}"
