@@ -53,7 +53,8 @@ class _HorovodTpuContext:
     def init(self,
              mesh_spec: Optional[mesh_lib.MeshSpec] = None,
              devices: Optional[Sequence[jax.Device]] = None,
-             start_engine: Optional[bool] = None):
+             start_engine: Optional[bool] = None,
+             comm: Optional[Sequence[int]] = None):
         with self._lock:
             if self.initialized:
                 return
@@ -77,6 +78,43 @@ class _HorovodTpuContext:
             self.cross_rank = _env_int("HOROVOD_CROSS_RANK", self.rank)
             self.cross_size = _env_int("HOROVOD_CROSS_SIZE", self.size)
             self.elastic = os.environ.get("HOROVOD_ELASTIC", "0") == "1"
+            # Process-subset communicator (reference: hvd.init(comm=[ranks]),
+            # operations.cc:712-714 + mpi_context.cc:126-138 MPI_Group_incl):
+            # members re-rank into the subset; non-members become size-1
+            # singletons excluded from the job's collectives.
+            subset_ports = None  # (controller, data) override for comm=
+            in_subset = False
+            if comm is not None:
+                members = sorted({int(r) for r in comm})
+                world = self.size
+                bad = [r for r in members if r < 0 or r >= world]
+                if bad:
+                    raise ValueError(
+                        f"comm ranks {bad} outside the world of {world}")
+                if self.rank in members:
+                    in_subset = True
+                    subset_ports = _negotiate_subset_ports(
+                        members, is_leader=self.rank == members[0])
+                    if subset_ports is None:
+                        # no rendezvous KV (hand-rolled env): arithmetic
+                        # offset — distinct per disjoint subset, though not
+                        # reserved against other services
+                        base = _env_int("HOROVOD_CONTROLLER_PORT", 0)
+                        if base:
+                            off = base + 2 * (1 + members[0])
+                            subset_ports = (off, off + 1)
+                    self.rank = members.index(self.rank)
+                    self.size = len(members)
+                    self.cross_rank = self.rank
+                    self.cross_size = self.size
+                else:
+                    import warnings
+                    warnings.warn(
+                        f"rank {self.rank} is not in comm={members}; "
+                        "continuing as a size-1 singleton outside the job")
+                    self.rank = 0
+                    self.size = 1
+                    self.cross_rank, self.cross_size = 0, 1
             try:
                 self.mesh = mesh_lib.build_mesh(mesh_spec, devices)
                 if start_engine is None:
@@ -101,15 +139,27 @@ class _HorovodTpuContext:
                         self.engine = bindings.EngineSession(
                             rank=self.rank, size=self.size,
                             local_rank=self.local_rank,
-                            local_size=self.local_size)
+                            local_size=self.local_size,
+                            port=subset_ports[0] if subset_ports else None,
+                            data_port=subset_ports[1] if subset_ports
+                            else None)
                     except (ImportError, OSError, ValueError,
                             HorovodInternalError,
                             subprocess.CalledProcessError) as e:
+                        hint = ""
+                        if in_subset:
+                            hint = (" Note: subset communicators "
+                                    "(init(comm=...)) require the lowest "
+                                    "comm rank to run on the controller "
+                                    "host (HOROVOD_CONTROLLER_ADDR) — its "
+                                    "engine hosts the subset's "
+                                    "coordination endpoint.")
                         raise RuntimeError(
                             "the native coordination engine could not be "
                             "loaded/built (run `make -C horovod_tpu/engine`); "
                             "pass init(start_engine=False) for a pure-SPMD "
-                            f"run without the eager path. Cause: {e}") from e
+                            f"run without the eager path.{hint} "
+                            f"Cause: {e}") from e
                 self.initialized = True
             except BaseException:
                 self.mesh = None
@@ -134,6 +184,35 @@ def _context() -> _HorovodTpuContext:
     return _ctx
 
 
+def _negotiate_subset_ports(members, is_leader: bool):
+    """Reserve the subset's controller/data ports through the launcher's
+    rendezvous KV (collision-free, unlike arithmetic offsets): the lowest
+    member allocates free ports on its host — where its engine will bind —
+    and publishes them; other members poll. Returns (port, data_port) or
+    None when no rendezvous KV is in the env."""
+    import time
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+    if not addr or not port:
+        return None
+    from horovod_tpu.runner.http_kv import KVClient
+    client = KVClient(addr, int(port))
+    key = "subset_ports/" + "-".join(str(m) for m in members)
+    if is_leader:
+        from horovod_tpu.runner.launch import free_port
+        ports = (free_port(), free_port())
+        client.put_json(key, {"port": ports[0], "data_port": ports[1]})
+        return ports
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        info = client.get_json(key, timeout=5.0)
+        if info:
+            return (int(info["port"]), int(info["data_port"]))
+        time.sleep(0.2)
+    raise RuntimeError(
+        f"subset leader never published ports for comm={members}")
+
+
 def _single_process() -> bool:
     """True when size-1 semantics apply (uninitialized counts as size 1).
     The one shared predicate behind every local-fallback fast path — eager
@@ -150,9 +229,16 @@ def _require_init():
 
 def init(mesh_spec: Optional[mesh_lib.MeshSpec] = None,
          devices: Optional[Sequence[jax.Device]] = None,
-         start_engine: Optional[bool] = None):
-    """Initialize the framework (reference: hvd.init, basics.py:33-65)."""
-    _ctx.init(mesh_spec=mesh_spec, devices=devices, start_engine=start_engine)
+         start_engine: Optional[bool] = None,
+         comm: Optional[Sequence[int]] = None):
+    """Initialize the framework (reference: hvd.init, basics.py:33-65).
+    ``comm``: optional list of global ranks forming the working communicator
+    (reference: init(comm=[ranks]), operations.cc:712-714); other processes
+    continue as size-1 singletons. The lowest comm rank must run on the
+    controller host (HOROVOD_CONTROLLER_ADDR) — its engine hosts the
+    subset's coordination endpoint."""
+    _ctx.init(mesh_spec=mesh_spec, devices=devices, start_engine=start_engine,
+              comm=comm)
 
 
 def shutdown():

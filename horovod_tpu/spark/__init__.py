@@ -1,0 +1,68 @@
+"""Spark integration: run a horovod_tpu job on Spark executors.
+
+Reference analog: horovod/spark/runner.py:195-302 — ``horovod.spark.run(fn,
+num_proc=N)`` schedules N simultaneous tasks (a barrier stage), wires the
+coordination env into each, executes ``fn`` and returns the per-rank
+results. The estimator stack (spark/common/store.py) is out of scope for a
+TPU framework — Spark here is a scheduler, not a data plane; Petastorm-style
+ingestion belongs to the input pipeline.
+
+pyspark is imported lazily: the module is importable (and the orchestration
+testable via the local-process backend) without it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from horovod_tpu.runner.cluster_job import ClusterJobSpec, task_body
+
+
+def _default_spark_context():
+    try:
+        import pyspark
+    except ImportError as e:
+        raise RuntimeError(
+            "horovod_tpu.spark.run needs pyspark (not installed); use "
+            "horovod_tpu.run / hvdrun-tpu for non-Spark clusters") from e
+    sc = pyspark.SparkContext._active_spark_context
+    if sc is None:
+        raise RuntimeError("no active SparkContext; create one first")
+    return sc
+
+
+def run(fn: Callable,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        num_proc: Optional[int] = None,
+        spark_context=None,
+        extra_env: Optional[dict] = None,
+        controller_addr: Optional[str] = None,
+        verbose: bool = False) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``num_proc`` Spark tasks as one
+    coordinated job; returns results in rank order (reference:
+    spark/runner.py:195-302).
+
+    The tasks must start simultaneously — a Spark *barrier* stage
+    (``RDD.barrier()``) guarantees it; plain stages could schedule tasks
+    sequentially and deadlock the rendezvous.
+    """
+    kwargs = kwargs or {}
+    sc = spark_context if spark_context is not None \
+        else _default_spark_context()
+    num_proc = num_proc or sc.defaultParallelism
+    spec = ClusterJobSpec(num_proc, controller_addr=controller_addr,
+                          extra_env=extra_env)
+    envs = [spec.worker_env(r) for r in range(num_proc)]
+
+    def _task(index, _iterator):
+        yield index, task_body(envs[index], fn, args, kwargs)
+
+    rdd = sc.parallelize(range(num_proc), num_proc)
+    pairs = rdd.barrier().mapPartitionsWithIndex(_task).collect()
+    results = dict(pairs)
+    missing = [r for r in range(num_proc) if r not in results]
+    if missing:
+        raise RuntimeError(f"spark job returned no result for ranks "
+                           f"{missing}")
+    return [results[r] for r in range(num_proc)]

@@ -92,3 +92,57 @@ def test_public_api_three_processes(tmp_path):
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"public-api worker {r} OK" in out
+
+
+SUBSET_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd_top
+    import horovod_tpu.jax as hvd
+
+    global_rank = int(os.environ["HOROVOD_RANK"])
+    hvd_top.init(comm=[0, 2])
+    if global_rank in (0, 2):
+        # members re-rank into the subset
+        assert hvd_top.size() == 2, hvd_top.size()
+        assert hvd_top.rank() == (0 if global_rank == 0 else 1)
+        out = np.asarray(hvd.allreduce(
+            np.asarray([float(global_rank + 1)], np.float32), op=hvd.Sum))
+        assert np.allclose(out, 4.0), out  # 1 + 3: rank 1 excluded
+        g = hvd.allgather_object(global_rank)
+        assert g == [0, 2], g
+    else:
+        # non-member: size-1 singleton, local semantics
+        assert hvd_top.size() == 1, hvd_top.size()
+        out = np.asarray(hvd.allreduce(
+            np.asarray([5.0], np.float32), op=hvd.Sum))
+        assert np.allclose(out, 5.0), out
+    hvd_top.shutdown()
+    print(f"subset worker {{global_rank}} OK")
+""")
+
+
+def test_subset_communicator(tmp_path):
+    """hvd.init(comm=[0, 2]) on a 3-process world: members form a size-2
+    job with re-ranked collectives, the excluded rank runs size-1
+    (reference: operations.cc:712-714, controller.h:112-117)."""
+    script = tmp_path / "subset.py"
+    script.write_text(SUBSET_WORKER.format(repo=REPO))
+    port = _free_port()
+    procs = []
+    for r in range(3):
+        env = dict(os.environ,
+                   HOROVOD_RANK=str(r), HOROVOD_SIZE="3",
+                   HOROVOD_LOCAL_RANK=str(r), HOROVOD_LOCAL_SIZE="3",
+                   HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                   HOROVOD_CONTROLLER_PORT=str(port),
+                   JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen([sys.executable, str(script)], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"subset worker {r} OK" in out

@@ -189,3 +189,23 @@ def test_programmatic_run_start_timeout():
     rc = launch_lib.run_static(parsed, liveness_check=never_started)
     assert rc == 1
     assert time.monotonic() - t0 < 30, "liveness abort did not bound the job"
+
+
+def test_programmatic_run_with_subset_comm():
+    """init(comm=...) under the real launcher negotiates subset ports
+    through the rendezvous KV (no arithmetic-offset collisions)."""
+    import horovod_tpu
+
+    def fn():
+        import numpy as np
+        import horovod_tpu as hvd
+        import horovod_tpu.jax as hvd_jax
+        hvd.init(comm=[0, 1])
+        out = float(np.asarray(hvd_jax.allreduce(
+            np.asarray([1.0], np.float32), op=hvd_jax.Sum))[0])
+        r = (hvd.rank(), hvd.size(), out)
+        hvd.shutdown()
+        return r
+
+    results = horovod_tpu.run(fn, np=3)
+    assert sorted(results) == [(0, 1, 1.0), (0, 2, 2.0), (1, 2, 2.0)], results
