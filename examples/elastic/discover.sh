@@ -1,0 +1,4 @@
+#!/bin/sh
+# Host discovery for the elastic demo: print "host:slots" lines.
+# Edit this file (or its output) while the job runs to scale it.
+echo "localhost:2"

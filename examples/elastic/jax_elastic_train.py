@@ -1,0 +1,77 @@
+"""Elastic training demo: membership follows the discovery script.
+
+Reference analog: examples/elastic/pytorch/pytorch_mnist_elastic.py — the
+@hvd.elastic.run retry loop with committed state, surviving host
+additions, removals, and worker failures.
+
+Run (membership = discover.sh output, editable live):
+``python -m horovod_tpu.runner.launch --min-np 2 --max-np 4
+--host-discovery-script examples/elastic/discover.sh
+python examples/elastic/jax_elastic_train.py``
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvd_jax
+from horovod_tpu.jax import elastic
+from horovod_tpu.models import MnistConvNet
+from horovod_tpu.parallel import dp
+
+TOTAL_STEPS = 200
+
+
+def main():
+    hvd.init()
+    model = MnistConvNet()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    opt = optax.sgd(0.01, momentum=0.9)
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["image"], train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean(), {}
+
+    state = elastic.State(step=0, params=params,
+                          opt_state=opt.init(params))
+
+    @elastic.run
+    def train(state):
+        # (re)build the step for the current topology every (re)entry
+        mesh = hvd.mesh()
+        step = dp.make_train_step(loss_fn, opt, mesh, donate=False)
+        rng = np.random.RandomState(100 + hvd.rank())
+        while state.step < TOTAL_STEPS:
+            batch = {
+                "image": dp.shard_batch(jnp.asarray(
+                    rng.rand(32, 28, 28, 1), jnp.float32), mesh),
+                "label": dp.shard_batch(jnp.asarray(
+                    rng.randint(0, 10, 32)), mesh),
+            }
+            out = step(dp.replicate(state.params, mesh),
+                       dp.replicate(state.opt_state, mesh),
+                       batch, jax.random.key(state.step))
+            state.params = jax.device_get(out.params)
+            state.opt_state = jax.device_get(out.opt_state)
+            state.step += 1
+            if state.step % 10 == 0:
+                state.commit()  # checkpoint for elastic restore
+                if hvd.rank() == 0:
+                    print(f"step {state.step} size {hvd.size()} "
+                          f"loss {float(out.loss):.4f}", flush=True)
+            time.sleep(0.01)
+        return state.step
+
+    steps = train(state)
+    if hvd.rank() == 0:
+        print(f"finished at step {steps} with {hvd.size()} workers")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""ResNet synthetic throughput benchmark, user-facing.
+
+Reference analog: examples/pytorch/pytorch_synthetic_benchmark.py
+(docs/benchmarks.rst protocol: synthetic ImageNet-shaped data, timed train
+steps, images/sec).
+
+Run: ``python examples/jax/jax_synthetic_benchmark.py --model ResNet50``
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import models
+from horovod_tpu.parallel import dp
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="ResNet50",
+                   choices=["ResNet18", "ResNet34", "ResNet50", "ResNet101"])
+    p.add_argument("--batch-per-chip", type=int, default=128)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-warmup", type=int, default=3)
+    p.add_argument("--image-size", type=int, default=224)
+    args = p.parse_args()
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n_dev = len(jax.devices())
+    batch = args.batch_per_chip * n_dev
+
+    model = getattr(models, args.model)(num_classes=1000,
+                                        dtype=jnp.bfloat16)
+    sz = args.image_size
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((8, sz, sz, 3), jnp.bfloat16),
+                           train=True)
+    opt = optax.sgd(0.05, momentum=0.9)
+
+    def loss_fn(params, model_state, b, rng):
+        logits, new_state = model.apply(
+            {"params": params, "batch_stats": model_state},
+            b["image"], train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["label"]).mean()
+        return loss, (new_state["batch_stats"], {})
+
+    step = dp.make_stateful_train_step(loss_fn, opt, mesh, donate=True)
+    rs = np.random.RandomState(0)
+    b = {"image": dp.shard_batch(
+            jnp.asarray(rs.rand(batch, sz, sz, 3), jnp.bfloat16), mesh),
+         "label": dp.shard_batch(jnp.asarray(rs.randint(0, 1000, batch)),
+                                 mesh)}
+    p_d = dp.replicate(variables["params"], mesh)
+    s_d = dp.replicate(opt.init(variables["params"]), mesh)
+    st_d = dp.replicate(variables.get("batch_stats", {}), mesh)
+    key = jax.random.key(1)
+
+    for _ in range(args.num_warmup):
+        out = step(p_d, s_d, st_d, b, key)
+        p_d, s_d, st_d = out.params, out.opt_state, out.model_state
+    float(out.loss)  # force completion with a host transfer
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        out = step(p_d, s_d, st_d, b, key)
+        p_d, s_d, st_d = out.params, out.opt_state, out.model_state
+    float(out.loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * args.num_iters / dt
+    if hvd.rank() == 0:
+        print(f"{args.model}: {img_s:.1f} img/sec over {n_dev} device(s) "
+              f"({img_s / n_dev:.1f} img/sec/device)")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

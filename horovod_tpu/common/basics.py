@@ -107,6 +107,12 @@ class _HorovodTpuContext:
                     self.size = len(members)
                     self.cross_rank = self.rank
                     self.cross_size = self.size
+                    # keep the context self-consistent: world-scoped local
+                    # dims can exceed the subset (local placement of the
+                    # other members is unknown here)
+                    if self.local_size > self.size:
+                        self.local_rank = self.rank
+                        self.local_size = self.size
                 else:
                     import warnings
                     warnings.warn(
@@ -184,6 +190,9 @@ def _context() -> _HorovodTpuContext:
     return _ctx
 
 
+_subset_round = 0
+
+
 def _negotiate_subset_ports(members, is_leader: bool):
     """Reserve the subset's controller/data ports through the launcher's
     rendezvous KV (collision-free, unlike arithmetic offsets): the lowest
@@ -197,7 +206,13 @@ def _negotiate_subset_ports(members, is_leader: bool):
         return None
     from horovod_tpu.runner.http_kv import KVClient
     client = KVClient(addr, int(port))
-    key = "subset_ports/" + "-".join(str(m) for m in members)
+    # per-init round counter (all members call init in lockstep), so a
+    # second init(comm=...) in the same processes can't read the previous
+    # round's — now closed — ports
+    global _subset_round
+    _subset_round += 1
+    key = ("subset_ports/" + "-".join(str(m) for m in members) +
+           f"/r{_subset_round}")
     if is_leader:
         from horovod_tpu.runner.launch import free_port
         ports = (free_port(), free_port())

@@ -70,6 +70,9 @@ class RayExecutor:
 
     def start(self):
         """Create the actor pool (reference: runner.py:140-180)."""
+        if self._workers:
+            raise RuntimeError(
+                "executor already started; shutdown() first")
         ray = self._ray_mod()
         self._spec = ClusterJobSpec(self.num_workers,
                                     controller_addr=self._controller_addr,
@@ -104,10 +107,9 @@ class RayExecutor:
 
     def shutdown(self):
         """Release the actors (reference: runner.py:230-235)."""
-        ray = self._ray if self._ray is not None else None
-        for w in self._workers:
-            kill = getattr(ray, "kill", None) if ray else None
-            if kill is not None:
+        kill = getattr(self._ray, "kill", None)
+        if kill is not None:
+            for w in self._workers:
                 try:
                     kill(w)
                 except Exception:  # noqa: BLE001 — actor may be gone

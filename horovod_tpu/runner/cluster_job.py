@@ -28,15 +28,31 @@ class ClusterJobSpec:
         if num_proc < 1:
             raise ValueError(f"num_proc must be >= 1, got {num_proc}")
         self.num_proc = num_proc
-        # Rank 0's engine binds the controller port on ITS host; the driver
-        # address is only the default for single-host/driver-colocated runs.
+        # Rank 0's engine binds the controller port on ITS host. 127.0.0.1
+        # is only correct when every task shares the driver's host — on a
+        # multi-node cluster the adapters must pass the rank-0 host, so
+        # fail loudly rather than let remote workers spin on loopback.
+        if controller_addr is None and num_proc > 1:
+            import warnings
+            warnings.warn(
+                "ClusterJobSpec without controller_addr assumes all tasks "
+                "run on the driver's host (127.0.0.1); pass the rank-0 "
+                "host's address for multi-node schedulers")
         self.controller_addr = controller_addr or launcher_addr([])
         self.controller_port = free_port()
         self.data_port = free_port()
         self.extra_env = dict(extra_env or {})
 
-    def worker_env(self, rank: int, local_rank: int = 0,
-                   local_size: int = 1) -> Dict[str, str]:
+    def worker_env(self, rank: int, local_rank: Optional[int] = None,
+                   local_size: Optional[int] = None) -> Dict[str, str]:
+        """Env for one task. Without explicit placement info the spec's
+        single-host assumption applies (local == global); schedulers that
+        know node placement (reference RayExecutor groups workers by node
+        IP) should pass real local_rank/local_size."""
+        if local_rank is None:
+            local_rank = rank
+        if local_size is None:
+            local_size = self.num_proc
         env = dict(self.extra_env)
         env.update({
             "HOROVOD_RANK": str(rank),
@@ -47,8 +63,11 @@ class ClusterJobSpec:
             "HOROVOD_CONTROLLER_PORT": str(self.controller_port),
             "HOROVOD_CONTROLLER_DATA_PORT": str(self.data_port),
         })
-        env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS",
-                                                       "cpu"))
+        # Deliberately no JAX_PLATFORMS default: on a TPU pod the workers
+        # must auto-detect their accelerator; only an explicit driver
+        # setting (or extra_env) is forwarded.
+        if "JAX_PLATFORMS" in os.environ:
+            env.setdefault("JAX_PLATFORMS", os.environ["JAX_PLATFORMS"])
         return env
 
 
@@ -89,14 +108,26 @@ def run_local_processes(spec: ClusterJobSpec, fn: Callable, args: tuple,
                 "result = fn(*args, **kwargs)\n"
                 f"cloudpickle.dump(result, open(os.path.join({td!r}, f'r{{rank}}.pkl'), 'wb'))\n")  # noqa: E501
         procs = []
-        for r in range(spec.num_proc):
-            env = dict(os.environ)
-            env.update(spec.worker_env(r))
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            procs.append(subprocess.Popen(
-                [sys.executable, script, str(r)], env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-        outs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+        try:
+            for r in range(spec.num_proc):
+                env = dict(os.environ)
+                env.update(spec.worker_env(r))
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+                procs.append(subprocess.Popen(
+                    [sys.executable, script, str(r)], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+            import time
+            deadline = time.monotonic() + timeout
+            outs = []
+            for p in procs:
+                left = max(1.0, deadline - time.monotonic())
+                outs.append(p.communicate(timeout=left)[0].decode())
+        finally:
+            # a stuck or failed rank must not leave peers blocked in
+            # rendezvous holding the ports
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         for r, (p, out) in enumerate(zip(procs, outs)):
             if p.returncode != 0:
                 raise RuntimeError(f"task rank {r} failed:\n{out}")

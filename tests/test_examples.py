@@ -1,0 +1,50 @@
+"""The shipped examples run end-to-end via the launcher (reference keeps
+its examples working through test/integration runs of the example scripts).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(path, np_, extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "-np", str(np_), "-H", f"localhost:{np_}", "--",
+           sys.executable, os.path.join(REPO, path), *extra]
+    out = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                         timeout=420)
+    text = out.stdout.decode() + out.stderr.decode()
+    assert out.returncode == 0, text
+    return text
+
+
+def test_jax_mnist_example():
+    text = _run_example("examples/jax/jax_mnist.py", 2,
+                        ("--steps", "12", "--batch-per-replica", "8"))
+    assert "done: final loss" in text, text
+
+
+def test_pytorch_mnist_example():
+    text = _run_example("examples/pytorch/pytorch_mnist.py", 2,
+                        ("--steps", "12", "--batch-size", "8"))
+    assert "done: final loss" in text, text
+
+
+def test_pytorch_mnist_example_fp16_adasum():
+    text = _run_example(
+        "examples/pytorch/pytorch_mnist.py", 2,
+        ("--steps", "6", "--batch-size", "8", "--fp16-allreduce",
+         "--use-adasum"))
+    assert "done: final loss" in text, text
+
+
+def test_tf_keras_mnist_example():
+    text = _run_example("examples/tensorflow/tensorflow2_keras_mnist.py", 2,
+                        ("--epochs", "2", "--batch-size", "16"))
+    assert "final averaged loss" in text, text
