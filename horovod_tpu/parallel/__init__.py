@@ -8,6 +8,10 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     replicated,
 )
 from horovod_tpu.parallel import collectives  # noqa: F401
+from horovod_tpu.parallel.sp import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
 from horovod_tpu.parallel.collectives import (  # noqa: F401
     Adasum,
     Average,

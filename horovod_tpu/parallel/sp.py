@@ -1,0 +1,148 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Reference analog: SURVEY §5.7 — the reference scales batch, never sequence;
+its alltoall/allgather primitives are the building blocks an SP strategy
+needs. Here the strategies themselves are first-class, TPU-native: the
+sequence dimension shards over the ``seq`` mesh axis and the exchanges ride
+ICI as `lax.ppermute` (ring) or `lax.all_to_all` (Ulysses) inside the
+compiled program — the public algorithms (RingAttention/blockwise,
+DeepSpeed-Ulysses) re-derived on XLA collectives, not ported.
+
+Both run under ``jax.shard_map`` with q/k/v sharded on their sequence dim:
+
+- :func:`ring_attention` — K/V blocks rotate around the ring; softmax is
+  accumulated online (log-sum-exp merging, flash-attention style), so no
+  rank ever materializes the full [T, T] score matrix. Compute and the
+  ppermute overlap via XLA's latency-hiding scheduler.
+- :func:`ulysses_attention` — all-to-all swaps the sharding from sequence
+  to heads, runs exact local attention over the full sequence for this
+  rank's head group, and swaps back. Cheaper at moderate T with enough
+  heads; ring wins at extreme T.
+
+Shapes: ``[batch, seq_shard, heads, head_dim]`` (BTHD).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel import collectives
+
+
+def _merge(o, m, l, o_i, m_i, l_i):
+    """Online-softmax merge of a new block's (out, max, sum) into the
+    running accumulation."""
+    m_new = jnp.maximum(m, m_i)
+    a = jnp.exp(m - m_new)
+    b = jnp.exp(m_i - m_new)
+    return (o * a[..., None] + o_i * b[..., None],
+            m_new,
+            l * a + l_i * b)
+
+
+def _block(q, k, v, mask, sm_scale):
+    """One q-block x kv-block attention in fp32: returns unnormalized out,
+    row max, row sum. mask: [Tq, Tk] additive (-inf where masked), or
+    None."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        s = s + mask[None, None, :, :]
+    m = jnp.max(s, axis=-1)
+    # guard fully-masked rows (m = -inf): exp(-inf - -inf) would be NaN
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(m)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis: str = "seq", causal: bool = False,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over a sequence sharded across ``axis``.
+
+    Each of the n ring steps attends this rank's query shard to one K/V
+    shard, then rotates K/V to the next rank (ppermute); the online-softmax
+    accumulator makes the result exactly softmax(QK^T)V over the full
+    sequence. Peak memory is O(T_local^2) scores instead of O(T^2).
+
+    With ``causal=True``, global position = shard_rank * T_local + offset;
+    kv blocks entirely in the future contribute nothing (their rows mask
+    to -inf and the merge is a no-op) — simple, compiler-friendly control
+    flow rather than skipping steps.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    t_loc = q.shape[1]
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+
+    o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m = jnp.full(q.shape[:1] + (q.shape[2], t_loc), -jnp.inf, jnp.float32)
+    l = jnp.zeros_like(m)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def attend(s, o, m, l, k_cur, v_cur):
+        # after s rotations rank idx holds the kv shard of rank idx - s
+        src = (idx - s) % n
+        mask = None
+        if causal:
+            q_pos = idx * t_loc + jnp.arange(t_loc)[:, None]
+            k_pos = src * t_loc + jnp.arange(t_loc)[None, :]
+            mask = jnp.where(q_pos >= k_pos, 0.0, -jnp.inf)
+        o_i, m_i, l_i = _block(q, k_cur, v_cur, mask, scale)
+        o, m, l = _merge(o.transpose(0, 2, 1, 3), m, l,
+                         o_i.transpose(0, 2, 1, 3), m_i, l_i)
+        return o.transpose(0, 2, 1, 3), m, l
+
+    def step(s, carry):
+        # rotate-then-attend: the local (s=0) block is handled outside the
+        # loop, so no step ends with a discarded rotation
+        o, m, l, k_cur, v_cur = carry
+        k_cur = collectives.ppermute(k_cur, perm, axis)
+        v_cur = collectives.ppermute(v_cur, perm, axis)
+        o, m, l = attend(s, o, m, l, k_cur, v_cur)
+        return o, m, l, k_cur, v_cur
+
+    o, m, l = attend(0, o, m, l, k, v)
+    o, m, l, _, _ = lax.fori_loop(1, n, step, (o, m, l, k, v))
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (shouldn't occur) stay 0
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis: str = "seq", causal: bool = False,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """DeepSpeed-Ulysses-style SP: all-to-all from sequence-sharded to
+    head-sharded, exact local attention over the full sequence, all-to-all
+    back. Heads must divide the axis size."""
+    n = lax.axis_size(axis)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"heads ({h}) must be divisible by the '{axis}' "
+                         f"axis size ({n})")
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+
+    def to_heads(x):
+        # [B, T/n, H, D] -> gather seq, scatter heads -> [B, T, H/n, D]
+        return collectives.alltoall(x, axis, split_axis=2, concat_axis=1)
+
+    def to_seq(x):
+        return collectives.alltoall(x, axis, split_axis=1, concat_axis=2)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    t = qh.shape[1]
+    mask = None
+    if causal:
+        pos = jnp.arange(t)
+        mask = jnp.where(pos[:, None] >= pos[None, :], 0.0, -jnp.inf)
+    o, m, l = _block(qh, kh, vh, mask, scale)
+    l = jnp.maximum(l, 1e-30)
+    out = (o.transpose(0, 2, 1, 3) / l[..., None]).transpose(0, 2, 1, 3)
+    return to_seq(out.astype(q.dtype))
