@@ -1,0 +1,111 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+The hot op of the transformer path (BASELINE config 3): computes
+softmax(QK^T)V blockwise in VMEM with online log-sum-exp accumulation, so
+the [T, T] score matrix never exists in HBM — the kernel streams K/V blocks
+through the MXU and keeps the fp32 accumulators on chip. This is the
+single-device building block sequence parallelism composes with
+(parallel/sp.py shards the sequence across chips; this kernel is the
+within-shard engine).
+
+Layout: [batch, seq, heads, head_dim] in, same out. Internally each
+(batch, head) pair is one grid row — batch*heads independent programs —
+and the q dimension tiles over the grid's second axis.
+
+Pure-JAX reference semantics are tested against in interpret mode (CPU)
+and the kernel compile-checks on the real chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+            sm_scale: float, block_q: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    o = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kj, carry):
+        m, l, o = carry
+        k = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_i = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_i)
+        p = jnp.exp(s - m_new)  # rows fully at NEG_INF decay to ~0
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, o
+
+    num_k = seq_len // block_k
+    if causal:
+        # blocks entirely in this q-tile's future contribute nothing;
+        # bound the loop instead of masking them
+        num_k = jnp.minimum(num_k,
+                            (qi + 1) * block_q // block_k +
+                            (1 if block_q % block_k else 0))
+    m, l, o = jax.lax.fori_loop(0, num_k, body, (m, l, o))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """softmax(QK^T)V without materializing the score matrix.
+
+    q/k/v: [B, T, H, D]; T must divide by the block sizes (pad upstream —
+    static shapes are the XLA contract anyway)."""
+    b, t, h, d = q.shape
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} must divide block sizes "
+                         f"({block_q}, {block_k})")
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+
+    def bh_first(x):  # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])
+
+    qb, kb, vb = bh_first(q), bh_first(k), bh_first(v)
+    grid = (b * h, t // block_q)
+    kernel = functools.partial(_kernel, block_k=block_k, causal=causal,
+                               sm_scale=scale, block_q=block_q, seq_len=t)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t, v.shape[-1]), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, v.shape[-1]),
+                               lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, v.shape[-1]), q.dtype),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(b, h, t, v.shape[-1]).transpose(0, 2, 1, 3)

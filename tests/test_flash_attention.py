@@ -1,0 +1,41 @@
+"""Pallas flash attention vs dense reference (interpret mode on CPU)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu.ops.flash_attention import flash_attention
+
+B, T, H, D = 2, 256, 4, 64
+
+
+def dense(q, k, v, causal):
+    s = np.einsum("bqhd,bkhd->bhqk", q, k).astype(np.float64) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((T, T), bool))
+        s = np.where(mask[None, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v.astype(np.float64))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("blocks", [(128, 128), (64, 128), (128, 64)])
+def test_flash_matches_dense(causal, blocks):
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+               for _ in range(3))
+    got = np.asarray(flash_attention(q, k, v, causal=causal,
+                                     block_q=blocks[0], block_k=blocks[1],
+                                     interpret=True))
+    want = dense(np.asarray(q), np.asarray(k), np.asarray(v), causal)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_bf16_runs():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 256, 2, 64), jnp.bfloat16)
+    out = flash_attention(q, q, q, causal=True, interpret=True)
+    assert out.dtype == jnp.bfloat16 and out.shape == q.shape
+    assert np.isfinite(np.asarray(out, np.float32)).all()
