@@ -91,17 +91,25 @@ class _HorovodTpuContext:
                 if bad:
                     raise ValueError(
                         f"comm ranks {bad} outside the world of {world}")
+                # every rank counts every init(comm=...) round — members of
+                # different successive subsets would otherwise skew their
+                # counters and disagree on the round-scoped ports
+                global _subset_round
+                _subset_round += 1
                 if self.rank in members:
                     in_subset = True
                     subset_ports = _negotiate_subset_ports(
                         members, is_leader=self.rank == members[0])
                     if subset_ports is None:
                         # no rendezvous KV (hand-rolled env): arithmetic
-                        # offset — distinct per disjoint subset, though not
-                        # reserved against other services
+                        # offset — distinct per disjoint subset AND per
+                        # init round (all members init in lockstep, so
+                        # their round counters agree), though not reserved
+                        # against other services
                         base = _env_int("HOROVOD_CONTROLLER_PORT", 0)
                         if base:
-                            off = base + 2 * (1 + members[0])
+                            off = base + 2 * (1 + members[0] +
+                                              world * (_subset_round - 1))
                             subset_ports = (off, off + 1)
                     self.rank = members.index(self.rank)
                     self.size = len(members)
@@ -206,16 +214,14 @@ def _negotiate_subset_ports(members, is_leader: bool):
         return None
     from horovod_tpu.runner.http_kv import KVClient
     client = KVClient(addr, int(port))
-    # per-init round counter (all members call init in lockstep), so a
-    # second init(comm=...) in the same processes can't read the previous
-    # round's — now closed — ports
-    global _subset_round
-    _subset_round += 1
+    # per-init round counter (incremented by the caller; all members call
+    # init in lockstep), so a second init(comm=...) in the same processes
+    # can't read the previous round's — now closed — ports
     key = ("subset_ports/" + "-".join(str(m) for m in members) +
            f"/r{_subset_round}")
     if is_leader:
-        from horovod_tpu.runner.launch import free_port
-        ports = (free_port(), free_port())
+        from horovod_tpu.runner.launch import free_ports
+        ports = tuple(free_ports(2))
         client.put_json(key, {"port": ports[0], "data_port": ports[1]})
         return ports
     deadline = time.monotonic() + 60.0

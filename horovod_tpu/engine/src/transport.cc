@@ -211,6 +211,25 @@ Status WriteAll(int fd, const char* data, size_t len) {
   return Status::OK();
 }
 
+// Upper bound on a single framed payload. A corrupted or
+// protocol-mismatched 4-byte length header must produce a clean Status,
+// not a multi-GB allocation. Controller payloads are small; the ring data
+// plane chunks large tensors, so even a full fusion buffer stays far
+// below this. Overridable for tests via HOROVOD_MAX_FRAME_BYTES.
+int64_t MaxFrameBytes() {
+  static int64_t v = [] {
+    const char* e = std::getenv("HOROVOD_MAX_FRAME_BYTES");
+    int64_t def = int64_t{1} << 31;  // 2 GiB
+    if (e && *e) {
+      char* end = nullptr;
+      long long parsed = std::strtoll(e, &end, 10);
+      if (end && *end == '\0' && parsed > 0) return (int64_t)parsed;
+    }
+    return def;
+  }();
+  return v;
+}
+
 Status ReadAll(int fd, char* data, size_t len) {
   size_t got = 0;
   while (got < len) {
@@ -333,6 +352,11 @@ Status TcpTransport::RecvFrame(int fd, std::string* payload) {
   uint32_t len = 0;
   auto st = ReadAll(fd, reinterpret_cast<char*>(&len), sizeof(len));
   if (!st.ok()) return st;
+  if (static_cast<int64_t>(len) > MaxFrameBytes()) {
+    return Status::Unknown("frame header advertises " + std::to_string(len) +
+                           " bytes, above HOROVOD_MAX_FRAME_BYTES — "
+                           "corrupted or mismatched peer");
+  }
   payload->resize(len);
   if (len > 0) return ReadAll(fd, payload->data(), len);
   return Status::OK();
@@ -613,6 +637,12 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
                    sizeof(recv_len) - recv_hdr, MSG_DONTWAIT);
         if (r > 0) recv_hdr += static_cast<size_t>(r);
         if (recv_hdr == sizeof(recv_len)) {
+          if (static_cast<int64_t>(recv_len) > MaxFrameBytes()) {
+            return Status::Unknown(
+                "ring frame header advertises " + std::to_string(recv_len) +
+                " bytes, above HOROVOD_MAX_FRAME_BYTES — corrupted or "
+                "mismatched peer");
+          }
           recv_hdr_done = true;
           recv->resize(recv_len);
         }

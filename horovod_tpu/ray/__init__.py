@@ -28,8 +28,14 @@ class _Worker:
         return dict(self._env)
 
     def execute(self, fn: Callable, args: tuple = (),
-                kwargs: Optional[dict] = None) -> Any:
-        return task_body(self._env, fn, args, kwargs or {})
+                kwargs: Optional[dict] = None,
+                round_id: Optional[str] = None) -> Any:
+        env = dict(self._env)
+        if round_id is not None:
+            # per-run scope for dynamic endpoint negotiation (fresh ports
+            # each run; stale KV entries from earlier runs are ignored)
+            env["HOROVOD_CLUSTER_ROUND"] = round_id
+        return task_body(env, fn, args, kwargs or {})
 
 
 class RayExecutor:
@@ -56,6 +62,8 @@ class RayExecutor:
         self._ray = ray_module
         self._workers: List[Any] = []
         self._spec: Optional[ClusterJobSpec] = None
+        self._kv = None
+        self._round = 0
 
     def _ray_mod(self):
         if self._ray is None:
@@ -74,9 +82,19 @@ class RayExecutor:
             raise RuntimeError(
                 "executor already started; shutdown() first")
         ray = self._ray_mod()
-        self._spec = ClusterJobSpec(self.num_workers,
-                                    controller_addr=self._controller_addr,
-                                    extra_env=self._extra_env)
+        if self._controller_addr is None:
+            # dynamic endpoints via a driver-side KV: rank 0's actor
+            # allocates+publishes the controller ports on its own node
+            from horovod_tpu.runner.cluster_job import default_driver_addr
+            from horovod_tpu.runner.http_kv import KVServer
+            self._kv = KVServer().start()
+            self._spec = ClusterJobSpec(
+                self.num_workers, extra_env=self._extra_env,
+                rendezvous=(default_driver_addr(), self._kv.port))
+        else:
+            self._spec = ClusterJobSpec(self.num_workers,
+                                        controller_addr=self._controller_addr,
+                                        extra_env=self._extra_env)
         remote_cls = ray.remote(_Worker)
         if hasattr(remote_cls, "options"):
             remote_cls = remote_cls.options(num_cpus=self.cpus_per_worker)
@@ -91,7 +109,10 @@ class RayExecutor:
         if not self._workers:
             raise RuntimeError("call start() before run()")
         ray = self._ray_mod()
-        refs = [w.execute.remote(fn, args, kwargs) for w in self._workers]
+        self._round += 1
+        rnd = str(self._round)
+        refs = [w.execute.remote(fn, args, kwargs, rnd)
+                for w in self._workers]
         return list(ray.get(refs))
 
     # reference alias: execute a function on all workers
@@ -103,7 +124,10 @@ class RayExecutor:
         runner.py run_remote)."""
         if not self._workers:
             raise RuntimeError("call start() before run_remote()")
-        return [w.execute.remote(fn, args, kwargs) for w in self._workers]
+        self._round += 1
+        rnd = str(self._round)
+        return [w.execute.remote(fn, args, kwargs, rnd)
+                for w in self._workers]
 
     def shutdown(self):
         """Release the actors (reference: runner.py:230-235)."""
@@ -115,3 +139,6 @@ class RayExecutor:
                 except Exception:  # noqa: BLE001 — actor may be gone
                     pass
         self._workers = []
+        if self._kv is not None:
+            self._kv.stop()
+            self._kv = None

@@ -14,33 +14,83 @@ orchestration is testable with a local-process backend.
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, List, Optional
+import socket
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from horovod_tpu.runner.launch import free_port, launcher_addr
+from horovod_tpu.runner.launch import free_ports, launcher_addr
+
+
+def default_driver_addr() -> str:
+    """Address remote tasks can use to reach a KV server bound on this
+    (driver) host: the default-route interface's IP via the UDP-connect
+    trick (no traffic sent), falling back to loopback for hostless boxes.
+    Reference analog: the driver-service NIC probe picking a routable
+    interface (runner/driver/driver_service.py:162-258)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 9))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def _self_addr_toward(peer_addr: str) -> str:
+    """This host's address as seen on the route toward ``peer_addr``."""
+    if peer_addr in ("127.0.0.1", "localhost", "::1"):
+        return "127.0.0.1"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((peer_addr, 9))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.getfqdn()
+    finally:
+        s.close()
 
 
 class ClusterJobSpec:
-    """Endpoints + per-rank env for one executor-backed job."""
+    """Endpoints + per-rank env for one executor-backed job.
+
+    Two endpoint modes:
+    - ``rendezvous=(kv_addr, kv_port)``: dynamic — the rank-0 *task*
+      allocates the controller/data ports on its own host at startup and
+      publishes them (plus its routable address) through the driver's KV;
+      other tasks poll. This avoids the driver-side free_port() TOCTOU
+      (the driver may not even share a host with rank 0 under Spark/Ray)
+      and needs no placement knowledge up front.
+    - explicit ``controller_addr``: static — the driver allocates ports and
+      bakes them into the env (single-host or caller-managed placement).
+    """
 
     def __init__(self, num_proc: int,
                  controller_addr: Optional[str] = None,
-                 extra_env: Optional[Dict[str, str]] = None):
+                 extra_env: Optional[Dict[str, str]] = None,
+                 rendezvous: Optional[Tuple[str, int]] = None):
         if num_proc < 1:
             raise ValueError(f"num_proc must be >= 1, got {num_proc}")
         self.num_proc = num_proc
-        # Rank 0's engine binds the controller port on ITS host. 127.0.0.1
-        # is only correct when every task shares the driver's host — on a
-        # multi-node cluster the adapters must pass the rank-0 host, so
-        # fail loudly rather than let remote workers spin on loopback.
-        if controller_addr is None and num_proc > 1:
-            import warnings
-            warnings.warn(
-                "ClusterJobSpec without controller_addr assumes all tasks "
-                "run on the driver's host (127.0.0.1); pass the rank-0 "
-                "host's address for multi-node schedulers")
-        self.controller_addr = controller_addr or launcher_addr([])
-        self.controller_port = free_port()
-        self.data_port = free_port()
+        self.rendezvous = rendezvous
+        self.job_id = uuid.uuid4().hex[:12]
+        if rendezvous is not None and controller_addr is None:
+            self.controller_addr = None
+            self.controller_port = None
+            self.data_port = None
+        else:
+            # Rank 0's engine binds the controller port on ITS host.
+            # 127.0.0.1 is only correct when every task shares the driver's
+            # host — warn rather than let remote workers spin on loopback.
+            if controller_addr is None and num_proc > 1:
+                import warnings
+                warnings.warn(
+                    "ClusterJobSpec without controller_addr or rendezvous "
+                    "assumes all tasks run on the driver's host "
+                    "(127.0.0.1); pass rendezvous=(kv_addr, kv_port) for "
+                    "multi-node schedulers")
+            self.controller_addr = controller_addr or launcher_addr([])
+            self.controller_port, self.data_port = free_ports(2)
         self.extra_env = dict(extra_env or {})
 
     def worker_env(self, rank: int, local_rank: Optional[int] = None,
@@ -59,10 +109,19 @@ class ClusterJobSpec:
             "HOROVOD_SIZE": str(self.num_proc),
             "HOROVOD_LOCAL_RANK": str(local_rank),
             "HOROVOD_LOCAL_SIZE": str(local_size),
-            "HOROVOD_CONTROLLER_ADDR": self.controller_addr,
-            "HOROVOD_CONTROLLER_PORT": str(self.controller_port),
-            "HOROVOD_CONTROLLER_DATA_PORT": str(self.data_port),
         })
+        if self.controller_addr is not None:
+            env.update({
+                "HOROVOD_CONTROLLER_ADDR": self.controller_addr,
+                "HOROVOD_CONTROLLER_PORT": str(self.controller_port),
+                "HOROVOD_CONTROLLER_DATA_PORT": str(self.data_port),
+            })
+        if self.rendezvous is not None:
+            env.update({
+                "HOROVOD_RENDEZVOUS_ADDR": self.rendezvous[0],
+                "HOROVOD_RENDEZVOUS_PORT": str(self.rendezvous[1]),
+                "HOROVOD_CLUSTER_JOB": self.job_id,
+            })
         # Deliberately no JAX_PLATFORMS default: on a TPU pod the workers
         # must auto-detect their accelerator; only an explicit driver
         # setting (or extra_env) is forwarded.
@@ -71,10 +130,45 @@ class ClusterJobSpec:
         return env
 
 
+def _negotiate_controller(env: Dict[str, str]) -> Dict[str, str]:
+    """Task-side endpoint negotiation (dynamic mode): rank 0 allocates the
+    controller/data ports on its own host — where its engine will bind
+    moments later — and publishes them; everyone else polls. Returns the
+    controller env entries."""
+    from horovod_tpu.runner.http_kv import KVClient
+    kv_addr = env["HOROVOD_RENDEZVOUS_ADDR"]
+    client = KVClient(kv_addr, int(env["HOROVOD_RENDEZVOUS_PORT"]))
+    # the round scopes the key per execution: long-lived actor pools
+    # (RayExecutor) negotiate afresh on every run(), and ranks >0 must not
+    # read a previous run's — now closed — endpoint
+    rnd = env.get("HOROVOD_CLUSTER_ROUND", "0")
+    key = f"cluster/{env['HOROVOD_CLUSTER_JOB']}/r{rnd}/controller"
+    if int(env["HOROVOD_RANK"]) == 0:
+        port, data_port = free_ports(2)
+        info = {"addr": _self_addr_toward(kv_addr), "port": port,
+                "data_port": data_port}
+        client.put_json(key, info)
+    else:
+        info = client.get_json(key, timeout=120.0)
+        if info is None:
+            raise RuntimeError(
+                "rank 0 never published the controller endpoint "
+                f"(KV {kv_addr}, job {env['HOROVOD_CLUSTER_JOB']})")
+    return {
+        "HOROVOD_CONTROLLER_ADDR": str(info["addr"]),
+        "HOROVOD_CONTROLLER_PORT": str(info["port"]),
+        "HOROVOD_CONTROLLER_DATA_PORT": str(info["data_port"]),
+    }
+
+
 def task_body(spec_env: Dict[str, str], fn: Callable, args: tuple,
               kwargs: dict) -> Any:
     """Runs inside the remote task: apply the env contract, execute, and
     return the result (the scheduler ships it back)."""
+    spec_env = dict(spec_env)
+    if ("HOROVOD_CONTROLLER_PORT" not in spec_env and
+            "HOROVOD_CLUSTER_JOB" in spec_env):
+        spec_env.update(_negotiate_controller(spec_env))
     os.environ.update(spec_env)
     # executors recycle processes: a previous job's context must not leak
     from horovod_tpu.common import basics
@@ -105,7 +199,9 @@ def run_local_processes(spec: ClusterJobSpec, fn: Callable, args: tuple,
                 "from horovod_tpu.runner import cluster_job\n"
                 f"fn, args, kwargs = cloudpickle.load(open({payload!r}, 'rb'))\n"  # noqa: E501
                 "rank = int(sys.argv[1])\n"
-                "result = fn(*args, **kwargs)\n"
+                # route through task_body so dynamic-endpoint negotiation
+                # runs exactly as it would under a real scheduler
+                "result = cluster_job.task_body(dict(os.environ), fn, args, kwargs)\n"  # noqa: E501
                 f"cloudpickle.dump(result, open(os.path.join({td!r}, f'r{{rank}}.pkl'), 'wb'))\n")  # noqa: E501
         procs = []
         try:

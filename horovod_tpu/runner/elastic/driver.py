@@ -32,7 +32,7 @@ from horovod_tpu.runner.elastic.registration import (
 from horovod_tpu.runner.exec_utils import WorkerProcess
 from horovod_tpu.runner.http_kv import KVServer
 from horovod_tpu.runner.launch import (
-    free_port,
+    free_ports,
     launcher_addr,
     publish_assignments,
     worker_env,
@@ -203,8 +203,7 @@ class ElasticDriver:
             controller_host = slots[0].hostname
             controller_addr = "127.0.0.1" \
                 if controller_host == "localhost" else controller_host
-            controller_port = free_port()
-            data_port = free_port()
+            controller_port, data_port = free_ports(2)
             rdv_addr = launcher_addr([s.hostname for s in slots])
             publish_assignments(self._kv, slots, controller_addr,
                                 controller_port, data_port, generation=gen)

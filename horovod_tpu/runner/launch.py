@@ -30,6 +30,22 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def free_ports(n: int) -> List[int]:
+    """Allocate ``n`` distinct free ports, holding all the sockets bound
+    simultaneously — sequential free_port() calls can hand back the same
+    port twice once the first socket is closed."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("0.0.0.0", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdrun-tpu",
@@ -183,8 +199,7 @@ def run_static(args, liveness_check=None) -> int:
 
     controller_addr = slots[0].hostname if slots[0].hostname != "localhost" \
         else "127.0.0.1"
-    controller_port = free_port()
-    data_port = free_port()
+    controller_port, data_port = free_ports(2)
     kv = KVServer().start()
     try:
         publish_assignments(kv, slots, controller_addr, controller_port,

@@ -51,15 +51,32 @@ def run(fn: Callable,
     sc = spark_context if spark_context is not None \
         else _default_spark_context()
     num_proc = num_proc or sc.defaultParallelism
-    spec = ClusterJobSpec(num_proc, controller_addr=controller_addr,
-                          extra_env=extra_env)
-    envs = [spec.worker_env(r) for r in range(num_proc)]
+    kv = None
+    try:
+        if controller_addr is None:
+            # dynamic endpoints: rank 0's task allocates+publishes the
+            # controller ports on its own host via this driver-side KV —
+            # the driver can't pre-pick a free port on a host Spark hasn't
+            # even chosen yet
+            from horovod_tpu.runner.cluster_job import default_driver_addr
+            from horovod_tpu.runner.http_kv import KVServer
+            kv = KVServer().start()
+            spec = ClusterJobSpec(
+                num_proc, extra_env=extra_env,
+                rendezvous=(default_driver_addr(), kv.port))
+        else:
+            spec = ClusterJobSpec(num_proc, controller_addr=controller_addr,
+                                  extra_env=extra_env)
+        envs = [spec.worker_env(r) for r in range(num_proc)]
 
-    def _task(index, _iterator):
-        yield index, task_body(envs[index], fn, args, kwargs)
+        def _task(index, _iterator):
+            yield index, task_body(envs[index], fn, args, kwargs)
 
-    rdd = sc.parallelize(range(num_proc), num_proc)
-    pairs = rdd.barrier().mapPartitionsWithIndex(_task).collect()
+        rdd = sc.parallelize(range(num_proc), num_proc)
+        pairs = rdd.barrier().mapPartitionsWithIndex(_task).collect()
+    finally:
+        if kv is not None:
+            kv.stop()
     results = dict(pairs)
     missing = [r for r in range(num_proc) if r not in results]
     if missing:

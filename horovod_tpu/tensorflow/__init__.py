@@ -45,11 +45,30 @@ from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
 )
 
 
+def _allreduce_sparse(slices: tf.IndexedSlices, op, name=None):
+    """Sparse "allreduce": allgather every rank's (values, indices) slabs
+    (reference: tensorflow/__init__.py:92-108 — sparse gradients ride
+    allgather; Average divides the gathered values by the world size).
+    Duplicate indices are fine — downstream scatter-add semantics sum
+    them, exactly like a dense sum would."""
+    if op not in (Average, Sum):
+        raise NotImplementedError(
+            "sparse allreduce supports Sum/Average only")
+    values = allgather(slices.values, name=f"{name}.values" if name else None)
+    indices = allgather(slices.indices,
+                        name=f"{name}.indices" if name else None)
+    if op == Average:
+        values = values / tf.cast(size_op(), values.dtype)
+    return tf.IndexedSlices(values=values, indices=indices,
+                            dense_shape=slices.dense_shape)
+
+
 def _make_allreduce_grads_fn(compression, op, gradient_predivide_factor,
-                             num_groups):
+                             num_groups, sparse_as_dense=False):
     """Gradient-combining closure shared by the tape and optimizer wrappers
     (reference: tensorflow/__init__.py:334-418 _make_allreduce_grads_fn +
-    _make_cached_allreduce_grads_fn)."""
+    _make_cached_allreduce_grads_fn). ``tf.IndexedSlices`` gradients take
+    the allgather path (or densify with sparse_as_dense)."""
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError(
             "gradient_predivide_factor not supported with op != Average")
@@ -64,10 +83,22 @@ def _make_allreduce_grads_fn(compression, op, gradient_predivide_factor,
             prescale = 1.0 / gradient_predivide_factor
             postscale = gradient_predivide_factor / size()
             red_op = Sum
-        idx = [i for i, g in enumerate(grads) if g is not None]
+        grads = list(grads)
+        sparse_idx = []
+        for i, g in enumerate(grads):
+            if isinstance(g, tf.IndexedSlices):
+                if sparse_as_dense:
+                    grads[i] = tf.convert_to_tensor(g)
+                else:
+                    sparse_idx.append(i)
+        idx = [i for i, g in enumerate(grads)
+               if g is not None and i not in sparse_idx]
         dense = [tf.convert_to_tensor(grads[i]) for i in idx]
+        out = list(grads)
+        for i in sparse_idx:
+            out[i] = _allreduce_sparse(grads[i], op=op)
         if not dense:
-            return list(grads)
+            return out
         if num_groups > 0:
             reduced = []
             n = max(1, (len(dense) + num_groups - 1) // num_groups)
@@ -79,7 +110,6 @@ def _make_allreduce_grads_fn(compression, op, gradient_predivide_factor,
             reduced = grouped_allreduce(
                 dense, op=red_op, compression=compression,
                 prescale_factor=prescale, postscale_factor=postscale)
-        out = list(grads)
         for i, r in zip(idx, reduced):
             out[i] = r
         return out
@@ -98,36 +128,39 @@ class _DistributedOptimizer:
     """Methods grafted onto a dynamic subclass of the wrapped keras
     optimizer's class (reference: _keras/__init__.py:24-137 — the same
     type()-composition trick, so isinstance checks and get_config
-    round-trips keep working)."""
+    round-trips keep working). The parent class rides the state dict
+    rather than ``super(self.__class__, ...)`` — the latter recurses
+    forever if anything subclasses the dynamic class again."""
 
     _HVD_ATTR = "_hvd_state"
 
     def apply_gradients(self, grads_and_vars, *args, **kwargs):
         st = getattr(self, self._HVD_ATTR)
+        base = st["base_class"]
         pairs = [(g, v) for g, v in grads_and_vars]
         grads = [g for g, _ in pairs]
         varss = [v for _, v in pairs]
-        bpps = st["backward_passes_per_step"]
-        if bpps > 1:
-            # local aggregation: allreduce + apply every bpps-th call
-            # (reference: gradient_aggregation_eager.py
-            # LocalGradientAggregationHelperEager)
-            acc = st.setdefault("acc", [None] * len(grads))
-            for i, g in enumerate(grads):
-                if g is None:
-                    continue
-                acc[i] = g if acc[i] is None else acc[i] + g
-            st["count"] = st.get("count", 0) + 1
-            if st["count"] < bpps:
-                return None
-            grads = [None if a is None else
-                     (a / float(bpps) if st["average_aggregated_gradients"]
-                      else a) for a in acc]
-            st["acc"] = [None] * len(grads)
-            st["count"] = 0
+        helper = st["aggregation_helper"]
+        if helper is not None:
+            # graph-safe local aggregation: tf.Variable accumulators +
+            # tf.cond, usable inside tf.function (reference:
+            # gradient_aggregation.py LocalGradientAggregationHelper)
+            if hasattr(self, "built") and not self.built:
+                # slot variables must exist before the cond branches —
+                # creating them inside tf.cond is illegal under tf.function
+                self.build(varss)
+            # compute_gradients allreduces on boundary calls itself; the
+            # cond in helper.apply_gradients gates the real apply
+            grads = helper.compute_gradients(grads)
+
+            def _apply():
+                return base.apply_gradients(
+                    self, list(zip(grads, varss)), *args, **kwargs)
+
+            return helper.apply_gradients(_apply, self)
         reduced = st["allreduce_grads"](grads)
-        return super(self.__class__, self).apply_gradients(
-            [(g, v) for g, v in zip(reduced, varss)], *args, **kwargs)
+        return base.apply_gradients(self, list(zip(reduced, varss)),
+                                    *args, **kwargs)
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
@@ -141,28 +174,43 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          num_groups: int = 0):
     """Wrap a keras optimizer so apply_gradients combines gradients across
     ranks first (reference: tensorflow/__init__.py:568-670). device_dense /
-    device_sparse / use_locking / sparse_as_dense are accepted for API
-    parity; placement is the engine's concern here."""
+    device_sparse / use_locking are accepted for API parity; placement is
+    the engine's concern here. IndexedSlices gradients ride the sparse
+    allgather path unless ``sparse_as_dense`` densifies them."""
     if op == Adasum and average_aggregated_gradients:
         raise ValueError(
             "Adasum does not support average_aggregated_gradients")
-    _ = (name, use_locking, device_dense, device_sparse, sparse_as_dense)
+    if hasattr(optimizer, _DistributedOptimizer._HVD_ATTR):
+        raise ValueError(
+            "optimizer is already a DistributedOptimizer; wrapping it "
+            "twice would allreduce twice")
+    _ = (name, use_locking, device_dense, device_sparse)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                _class_body(_DistributedOptimizer))
     opt = cls.from_config(optimizer.get_config())
+    allreduce_grads = _make_allreduce_grads_fn(
+        compression, op, gradient_predivide_factor, num_groups,
+        sparse_as_dense=sparse_as_dense)
+    helper = None
+    if backward_passes_per_step > 1:
+        from horovod_tpu.tensorflow.gradient_aggregation import \
+            LocalGradientAggregationHelper
+        helper = LocalGradientAggregationHelper(
+            backward_passes_per_step, allreduce_grads,
+            sparse_as_dense=sparse_as_dense,
+            average_aggregated_gradients=average_aggregated_gradients)
     setattr(opt, _DistributedOptimizer._HVD_ATTR, {
-        "allreduce_grads": _make_allreduce_grads_fn(
-            compression, op, gradient_predivide_factor, num_groups),
-        "backward_passes_per_step": backward_passes_per_step,
-        "average_aggregated_gradients": average_aggregated_gradients,
+        "allreduce_grads": allreduce_grads,
+        "aggregation_helper": helper,
+        "base_class": optimizer.__class__,
     })
     return opt
 
 
 class _DistributedGradientTape:
     def gradient(self, target, sources, output_gradients=None):
-        grads = super(self.__class__, self).gradient(target, sources,
-                                                     output_gradients)
+        grads = self._hvd_base_class.gradient(self, target, sources,
+                                              output_gradients)
         one = not isinstance(grads, (list, tuple))
         reduced = self._hvd_allreduce_grads([grads] if one else list(grads))
         return reduced[0] if one else reduced
@@ -177,13 +225,19 @@ def DistributedGradientTape(gradtape: tf.GradientTape, device_dense: str = "",
     """Wrap a tf.GradientTape so .gradient() returns rank-combined gradients
     (reference: tensorflow/__init__.py:674-742, same dynamic-subclass
     shape)."""
-    _ = (device_dense, device_sparse, sparse_as_dense)
+    _ = (device_dense, device_sparse)
+    if hasattr(gradtape, "_hvd_base_class"):
+        raise ValueError(
+            "tape is already a DistributedGradientTape; wrapping it twice "
+            "would allreduce twice")
     cls = type(gradtape.__class__.__name__, (gradtape.__class__,),
                _class_body(_DistributedGradientTape))
     tape = cls.__new__(cls)
     tape.__dict__.update(gradtape.__dict__)
+    tape._hvd_base_class = gradtape.__class__
     tape._hvd_allreduce_grads = _make_allreduce_grads_fn(
-        compression, op, gradient_predivide_factor, num_groups)
+        compression, op, gradient_predivide_factor, num_groups,
+        sparse_as_dense=sparse_as_dense)
     return tape
 
 

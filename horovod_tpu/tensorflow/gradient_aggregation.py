@@ -1,0 +1,127 @@
+"""Graph-safe local gradient aggregation for TensorFlow.
+
+Reference analog: horovod/tensorflow/gradient_aggregation.py:1-268
+(LocalGradientAggregationHelper) — accumulate gradients into tf.Variables
+and gate the allreduce + optimizer apply on every
+``backward_passes_per_step``-th call with ``tf.cond``, so the entire
+training step (including the skipped calls) stays traceable inside one
+``tf.function``. Python-dict accumulation only works eagerly; variables +
+cond work in both modes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import tensorflow as tf
+
+
+class LocalGradientAggregationHelper:
+    """Accumulates gradients locally for ``backward_passes_per_step`` calls,
+    then allreduces and hands the combined gradients to the optimizer.
+
+    State lives in non-trainable tf.Variables created on the first
+    ``compute_gradients`` call (trace time under tf.function — exactly when
+    variable creation is permitted), so retraces reuse them.
+    """
+
+    def __init__(self, backward_passes_per_step: int,
+                 allreduce_func: Callable[[list], list],
+                 sparse_as_dense: bool = False,
+                 average_aggregated_gradients: bool = False):
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1, got "
+                             f"{backward_passes_per_step}")
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce_grads = allreduce_func
+        self._sparse_as_dense = sparse_as_dense
+        self._average = average_aggregated_gradients
+        self.counter: Optional[tf.Variable] = None
+        self._agg: dict = {}  # grad index -> accumulator Variable
+        self._none_idx: List[int] = []
+
+    def _init_vars(self, grads):
+        if self.counter is not None:
+            return
+        self.counter = tf.Variable(0, trainable=False, dtype=tf.int32,
+                                   name="hvd_aggregation_counter")
+        for i, g in enumerate(grads):
+            if g is None:
+                self._none_idx.append(i)
+                continue
+            self._agg[i] = tf.Variable(
+                tf.zeros_like(g), trainable=False,
+                name=f"hvd_locally_aggregated_grad_{i}")
+
+    def compute_gradients(self, grads: list) -> list:
+        """Accumulate this call's gradients; returns the allreduced
+        aggregate on boundary calls and zeros otherwise (the paired
+        ``apply_gradients`` cond skips the optimizer on the zeros)."""
+        grads = list(grads)
+        for i, g in enumerate(grads):
+            if isinstance(g, tf.IndexedSlices):
+                if not self._sparse_as_dense:
+                    raise ValueError(
+                        "IndexedSlices gradients cannot be locally "
+                        "aggregated with backward_passes_per_step > 1; "
+                        "pass sparse_as_dense=True (reference requires the "
+                        "same, gradient_aggregation.py)")
+                grads[i] = tf.convert_to_tensor(g)
+        self._init_vars(grads)
+        updates = [self._agg[i].assign_add(g) for i, g in enumerate(grads)
+                   if g is not None]
+        with tf.control_dependencies(updates):
+            counter = self.counter.assign_add(1)
+
+        def _boundary():
+            acc = [self._agg[i].read_value() if i in self._agg else None
+                   for i in range(len(grads))]
+            if self._average:
+                acc = [None if a is None else
+                       a / float(self.backward_passes_per_step) for a in acc]
+            reduced = self._allreduce_grads(acc)
+            dense = [r for r in reduced if r is not None]
+            # zero the accumulators only after the reduced values exist
+            with tf.control_dependencies(dense):
+                resets = [v.assign(tf.zeros_like(v))
+                          for v in self._agg.values()]
+                resets.append(self.counter.assign(0))
+            with tf.control_dependencies(resets):
+                return [None if r is None else tf.identity(r)
+                        for r in reduced]
+
+        def _skip():
+            return [None if g is None else tf.zeros_like(g) for g in grads]
+
+        # tf.cond branches must return matching tensor structures; None
+        # slots are identical in both, so carry only the tensors through
+        none_idx = set(self._none_idx)
+
+        def _strip(xs):
+            return [x for i, x in enumerate(xs) if i not in none_idx]
+
+        out_dense = tf.cond(
+            tf.equal(counter, self.backward_passes_per_step),
+            lambda: _strip(_boundary()), lambda: _strip(_skip()))
+        out = []
+        it = iter(out_dense)
+        for i in range(len(grads)):
+            out.append(None if i in none_idx else next(it))
+        return out
+
+    def apply_gradients(self, apply_closure: Callable, optimizer,
+                        *args, **kwargs):
+        """Run the optimizer's real apply on boundary calls; on skipped
+        calls advance ``optimizer.iterations`` instead, so iteration-keyed
+        LR schedules see every backward pass exactly like the reference's
+        helper does (gradient_aggregation.py:229-268)."""
+
+        def _apply():
+            apply_closure(*args, **kwargs)
+            return tf.identity(tf.convert_to_tensor(optimizer.iterations))
+
+        def _skip():
+            optimizer.iterations.assign_add(1)
+            return tf.identity(tf.convert_to_tensor(optimizer.iterations))
+
+        return tf.cond(tf.equal(self.counter, 0), _apply, _skip)

@@ -169,7 +169,11 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     alltoall back along the received splits)."""
     tensor = tf.convert_to_tensor(tensor)
     if splits is not None and isinstance(splits, tf.Tensor):
-        splits = splits.numpy().tolist()
+        # symbolic under tf.function — feed through the dynamic variant,
+        # which passes splits as a py_function input instead of
+        # materializing them at trace time
+        return _alltoall_dynamic(tensor, tf.cast(splits, tf.int64),
+                                 name=name)
 
     @tf.custom_gradient
     def _fn(t):
@@ -192,13 +196,14 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     return _fn(tensor)
 
 
-def _alltoall_dynamic(tensor, splits_t):
-    """alltoall whose splits arrive as a tensor (the backward path)."""
+def _alltoall_dynamic(tensor, splits_t, name: Optional[str] = None):
+    """alltoall whose splits arrive as a tensor (the symbolic-splits and
+    backward paths)."""
     @tf.custom_gradient
     def _fn(t, s):
         def _run(x, sp):
             h = _eager.alltoall_async(
-                _np(x), splits=[int(v) for v in np.asarray(sp)])
+                _np(x), splits=[int(v) for v in np.asarray(sp)], name=name)
             out = _eager.synchronize(h)
             recv = h.aux.get("recv_splits")
             if recv is None:

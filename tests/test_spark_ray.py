@@ -188,3 +188,28 @@ def test_local_process_backend():
     spec = ClusterJobSpec(2, controller_addr="127.0.0.1")
     results = run_local_processes(spec, _train_fn, (3.0,), {})
     assert results == [(r, 2, 9.0, {"seed": 7}) for r in range(2)], results
+
+
+def test_dynamic_endpoint_negotiation():
+    """Without controller_addr, rank 0's task allocates the controller
+    ports on its own host and publishes them via the driver KV — the
+    driver never free_port()s for a host it may not share (the Spark/Ray
+    multi-node TOCTOU)."""
+    from horovod_tpu.runner.cluster_job import (ClusterJobSpec,
+                                                run_local_processes)
+    from horovod_tpu.runner.http_kv import KVServer
+    kv = KVServer().start()
+    try:
+        spec = ClusterJobSpec(2, rendezvous=("127.0.0.1", kv.port))
+        assert spec.controller_port is None  # no driver-side allocation
+        env0 = spec.worker_env(0)
+        assert "HOROVOD_CONTROLLER_PORT" not in env0
+        assert env0["HOROVOD_CLUSTER_JOB"] == spec.job_id
+        results = run_local_processes(spec, _train_fn, (4.0,), {})
+        assert results == [(r, 2, 12.0, {"seed": 7}) for r in range(2)], \
+            results
+        # rank 0 published the endpoint under the job's (round-scoped) key
+        info = kv.get_json(f"cluster/{spec.job_id}/r0/controller")
+        assert info and info["port"] != info["data_port"]
+    finally:
+        kv.stop()

@@ -255,3 +255,64 @@ def test_tf_elastic_state(tmp_path):
         st.restore()
         assert np.allclose(model.variables[0].numpy(), 1.0)
     """, size=2)
+
+
+def test_tf_function_bpps_and_sparse(tmp_path):
+    _run_workers(tmp_path, """
+        # graph-safe gradient aggregation: bpps=2 inside tf.function
+        # (reference: tensorflow/gradient_aggregation.py — tf.Variable
+        # counters + tf.cond, not python state)
+        v = tf.Variable([1.0])
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                       backward_passes_per_step=2)
+
+        @tf.function
+        def train_step():
+            with tf.GradientTape() as t:
+                loss = tf.reduce_sum(v) * (rank + 1.0)
+            g = t.gradient(loss, [v])
+            opt.apply_gradients(zip(g, [v]))
+
+        for _ in range(4):
+            train_step()
+        # per boundary: sum over 2 passes of avg_r(rank+1) = 2 * 1.5 = 3
+        assert np.allclose(v.numpy(), [1.0 - 2 * 3.0]), v.numpy()
+        assert int(opt.iterations.numpy()) == 4, opt.iterations
+
+        # sparse gradients: IndexedSlices ride allgather, not densify
+        emb = tf.Variable(tf.ones([6, 2]))
+        tape = hvd.DistributedGradientTape(tf.GradientTape())
+        with tape:
+            rows = tf.gather(emb, [rank, rank])  # rank r touches row r
+            loss = tf.reduce_sum(rows) * (rank + 1.0)
+        g = tape.gradient(loss, [emb])[0]
+        assert isinstance(g, tf.IndexedSlices), type(g)
+        idx = np.asarray(g.indices.numpy())
+        vals = np.asarray(g.values.numpy())
+        assert sorted(idx.tolist()) == [0, 0, 1, 1], idx
+        # average divides gathered values by size
+        dense = np.zeros((6, 2), np.float32)
+        np.add.at(dense, idx, vals)
+        exp = np.zeros((6, 2), np.float32)
+        exp[0] = 2 * 1.0 / size
+        exp[1] = 2 * 2.0 / size
+        assert np.allclose(dense, exp), dense
+
+        # sparse_as_dense path densifies before the grouped allreduce
+        tape2 = hvd.DistributedGradientTape(tf.GradientTape(),
+                                            sparse_as_dense=True)
+        with tape2:
+            loss = tf.reduce_sum(tf.gather(emb, [0])) * (rank + 1.0)
+        g2 = tape2.gradient(loss, [emb])[0]
+        assert not isinstance(g2, tf.IndexedSlices), type(g2)
+
+        # symbolic alltoall splits inside tf.function
+        @tf.function
+        def a2a(x, sp):
+            return hvd.alltoall(x, splits=sp)
+
+        t = tf.fill([size], float(rank))
+        out = a2a(t, tf.ones([size], tf.int32))
+        assert np.allclose(out.numpy(), np.arange(size, dtype=np.float32)), \\
+            out.numpy()
+    """, size=2)
