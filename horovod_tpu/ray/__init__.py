@@ -142,3 +142,226 @@ class RayExecutor:
         if self._kv is not None:
             self._kv.stop()
             self._kv = None
+
+
+# -- elastic -----------------------------------------------------------------
+
+
+class RayHostDiscovery:
+    """Host discovery over Ray's cluster state (reference:
+    ray/elastic.py:36-65 RayHostDiscovery): alive nodes become
+    "host:slots" entries, slots = CPUs (or GPUs) per node divided by the
+    per-slot requirement."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1, ray_module=None):
+        self._use_gpu = use_gpu
+        self._cpus_per_slot = cpus_per_slot
+        self._gpus_per_slot = gpus_per_slot
+        self._ray = ray_module
+
+    def _nodes(self):
+        if self._ray is None:
+            import ray
+            self._ray = ray
+        return self._ray.nodes()
+
+    def find_available_hosts_and_slots(self) -> dict:
+        hosts = {}
+        for node in self._nodes():
+            if not node.get("Alive"):
+                continue
+            resources = node.get("Resources", {})
+            host = node.get("NodeManagerAddress") or \
+                node.get("NodeManagerHostname")
+            if self._use_gpu:
+                slots = int(resources.get("GPU", 0)) // self._gpus_per_slot
+            else:
+                slots = int(resources.get("CPU", 0)) // self._cpus_per_slot
+            if host and slots > 0:
+                hosts[host] = slots
+        return hosts
+
+
+def _exec_command(cmd, env_vars):
+    """Worker-command body of the elastic Ray tasks (module level so it is
+    registered with Ray once, not re-exported per spawn)."""
+    import os as _os
+    import subprocess as _sp
+    full = dict(_os.environ)
+    full.update(env_vars)
+    return _sp.run(cmd, env=full).returncode
+
+
+_REMOTE_EXEC_CACHE: dict = {}
+
+
+def _remote_exec(ray):
+    key = id(ray)
+    if key not in _REMOTE_EXEC_CACHE:
+        _REMOTE_EXEC_CACHE[key] = ray.remote(max_retries=0)(_exec_command)
+    return _REMOTE_EXEC_CACHE[key]
+
+
+class _RayTaskHandle:
+    """WorkerProcess-shaped handle over a Ray task running the worker
+    command on its assigned node — Ray does the placement the subprocess/
+    ssh spawner would otherwise need ssh for."""
+
+    def __init__(self, ray, hostname: str, rank: int, command, env):
+        self.hostname = hostname
+        self.rank = rank
+        self._ray = ray
+        self._result = None
+        _exec = _remote_exec(ray)
+
+        # soft node affinity: pin to the assigned host when the API exists
+        options = {}
+        strategy = getattr(
+            getattr(ray.util, "scheduling_strategies", None),
+            "NodeAffinitySchedulingStrategy", None) \
+            if hasattr(ray, "util") else None
+        if strategy is not None:
+            node_id = next(
+                (n["NodeID"] for n in ray.nodes()
+                 if n.get("Alive") and
+                 (n.get("NodeManagerAddress") == hostname or
+                  n.get("NodeManagerHostname") == hostname)), None)
+            if node_id is not None:
+                options["scheduling_strategy"] = strategy(
+                    node_id=node_id, soft=True)
+        self._ref = (_exec.options(**options) if options else
+                     _exec).remote(list(command), dict(env))
+
+    def poll(self):
+        if self._result is not None:
+            return self._result
+        ready, _ = self._ray.wait([self._ref], timeout=0)
+        if not ready:
+            return None
+        try:
+            self._result = int(self._ray.get(ready[0]))
+        except Exception:  # noqa: BLE001 — cancelled / actor died
+            self._result = 143
+        return self._result
+
+    def wait(self, timeout=None):
+        self._ray.wait([self._ref], timeout=timeout)
+        rc = self.poll()
+        if rc is None:
+            raise TimeoutError(f"worker {self.rank} still running")
+        return rc
+
+    def terminate(self):
+        if self.poll() is None:
+            self._ray.cancel(self._ref, force=False)
+
+    def kill(self):
+        if self.poll() is None:
+            self._ray.cancel(self._ref, force=True)
+
+
+class ElasticRayExecutor:
+    """Elastic training on an (autoscaling) Ray cluster (reference:
+    ray/elastic.py:68-310 ElasticRayExecutor): Ray's node set drives host
+    discovery, the elastic driver handles membership generations /
+    blacklists / rendezvous, and workers run as Ray tasks pinned to their
+    assigned nodes. Results ship back through the driver's rendezvous KV
+    (no shared filesystem needed)."""
+
+    @staticmethod
+    def create_settings(min_np: int = 1, max_np: Optional[int] = None,
+                        reset_limit: Optional[int] = None,
+                        elastic_timeout: float = 600.0,
+                        verbose: bool = False) -> dict:
+        """Reference: ray/elastic.py:104-158 (Settings factory)."""
+        return {"min_np": min_np, "max_np": max_np,
+                "reset_limit": reset_limit,
+                "elastic_timeout": elastic_timeout, "verbose": verbose}
+
+    def __init__(self, settings: dict, use_gpu: bool = False,
+                 cpus_per_slot: int = 1, gpus_per_slot: int = 1,
+                 env_vars: Optional[dict] = None,
+                 override_discovery=None, ray_module=None):
+        self.settings = dict(settings)
+        self._env_vars = dict(env_vars or {})
+        self._discovery = override_discovery or RayHostDiscovery(
+            use_gpu=use_gpu, cpus_per_slot=cpus_per_slot,
+            gpus_per_slot=gpus_per_slot, ray_module=ray_module)
+        self._ray = ray_module
+        self.driver = None
+
+    def _ray_mod(self):
+        if self._ray is None:
+            import ray
+            self._ray = ray
+        return self._ray
+
+    def start(self):
+        """Reference parity no-op (the reference boots driver services
+        here; ours start inside run())."""
+        return self
+
+    def run(self, worker_fn: Callable, args: tuple = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        """Run ``worker_fn`` elastically; returns the per-rank results of
+        the final generation (reference: ray/elastic.py:281-310)."""
+        import base64
+        import sys
+        import tempfile
+
+        import cloudpickle
+
+        from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+        kwargs = kwargs or {}
+
+        def wrapped():
+            return worker_fn(*args, **kwargs)
+
+        fn_blob = cloudpickle.dumps(wrapped)
+        ray = self._ray_mod()
+
+        def spawn(hostname, rank, command, env):
+            return _RayTaskHandle(ray, hostname, rank, command, env)
+
+        results: dict = {}
+
+        def collect(kv):
+            # only the final generation's results: under elastic resets a
+            # rank number is recycled across different world sizes
+            gen = self.driver.generation
+            cap = max(self.settings.get("max_np") or 0,
+                      self.settings["min_np"], 1)
+            for rank in range(cap):
+                blob = kv.get_json(f"task_result/g{gen}/{rank}")
+                if blob is not None:
+                    results[rank] = cloudpickle.loads(
+                        base64.b64decode(blob["data"]))
+
+        with tempfile.TemporaryDirectory(prefix="hvdtpu_rayel_") as td:
+            fn_path = f"{td}/func.pkl"
+            with open(fn_path, "wb") as f:
+                f.write(fn_blob)
+            command = [sys.executable, "-m",
+                       "horovod_tpu.runner.run_task", fn_path, td]
+            self.driver = ElasticDriver(
+                discovery=self._discovery,
+                min_np=self.settings["min_np"],
+                max_np=self.settings.get("max_np") or
+                self.settings["min_np"],
+                command=command,
+                extra_env=self._env_vars,
+                reset_limit=self.settings.get("reset_limit"),
+                verbose=self.settings.get("verbose", False),
+                spawn_worker=spawn)
+            self.driver.publish(
+                "task_fn",
+                {"data": base64.b64encode(fn_blob).decode()})
+            rc = self.driver.run(
+                start_timeout=self.settings.get("elastic_timeout", 600.0),
+                on_complete=collect)
+        if rc != 0:
+            raise RuntimeError(
+                f"elastic ray job failed with exit code {rc}")
+        return [results[r] for r in sorted(results)]

@@ -26,15 +26,18 @@ def run(func,
     and return the per-rank results in rank order (reference:
     runner/__init__.py run()).
 
-    ``hosts`` takes the launcher's "host:slots,..." syntax; results are
-    collected from a shared directory, so remote hosts need it on a shared
-    filesystem (the reference ships results over its task service —
-    localhost jobs, the interactive-run staple, need nothing).
+    ``hosts`` takes the launcher's "host:slots,..." syntax; workers ship
+    results (and fetch the function) through the launcher's rendezvous KV
+    over HTTP, so remote hosts need no shared filesystem (the role of the
+    reference's task service; a shared results directory is used as a
+    fast path when present).
     ``extra_args`` passes additional hvdrun-tpu flags (engine knobs).
     """
+    import base64
     import cloudpickle  # lazy: CLI launches must not require it
 
     from horovod_tpu.runner import launch as launch_lib
+    from horovod_tpu.runner.http_kv import KVServer
 
     kwargs = kwargs or {}
 
@@ -42,9 +45,10 @@ def run(func,
         return func(*args, **kwargs)
 
     with tempfile.TemporaryDirectory(prefix="hvdtpu_run_") as td:
+        fn_blob = cloudpickle.dumps(wrapped)
         fn_path = os.path.join(td, "func.pkl")
         with open(fn_path, "wb") as f:
-            cloudpickle.dump(wrapped, f)
+            f.write(fn_blob)
         command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
                    fn_path, td]
         argv = ["-np", str(np),
@@ -66,29 +70,40 @@ def run(func,
         import time
         deadline = time.monotonic() + start_timeout
         all_started = [False]
+        kv = KVServer().start()
+        kv.put_json("task_fn", {"data": base64.b64encode(fn_blob).decode()})
 
         def not_started_by_deadline():
             if all_started[0] or time.monotonic() < deadline:
                 return None
-            missing = [r for r in range(np) if not os.path.exists(
-                os.path.join(td, f"started.{r}"))]
+            missing = [r for r in range(np)
+                       if not os.path.exists(
+                           os.path.join(td, f"started.{r}"))
+                       and kv.get_json(f"task_started/{r}") is None]
             if missing:
                 return (f"ranks {missing} did not start within "
                         f"{start_timeout}s")
             all_started[0] = True
             return None
 
-        rc = launch_lib.run_static(parsed,
-                                   liveness_check=not_started_by_deadline)
-        if rc != 0:
-            raise RuntimeError(f"horovod_tpu.run failed with exit code {rc}")
-        results = []
-        for r in range(np):
-            path = os.path.join(td, f"result.{r}.pkl")
-            if not os.path.exists(path):
+        try:
+            rc = launch_lib.run_static(
+                parsed, liveness_check=not_started_by_deadline, kv=kv)
+            if rc != 0:
                 raise RuntimeError(
-                    f"no result from rank {r}: remote hosts need the "
-                    "results directory on a shared filesystem")
-            with open(path, "rb") as f:
-                results.append(cloudpickle.load(f))
-        return results
+                    f"horovod_tpu.run failed with exit code {rc}")
+            results = []
+            for r in range(np):
+                path = os.path.join(td, f"result.{r}.pkl")
+                if os.path.exists(path):
+                    with open(path, "rb") as f:
+                        results.append(cloudpickle.load(f))
+                    continue
+                blob = kv.get_json(f"task_result/g0/{r}")
+                if blob is None:
+                    raise RuntimeError(f"no result from rank {r}")
+                results.append(cloudpickle.loads(
+                    base64.b64decode(blob["data"])))
+            return results
+        finally:
+            kv.stop()

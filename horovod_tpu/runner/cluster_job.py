@@ -24,7 +24,8 @@ from horovod_tpu.runner.launch import free_ports, launcher_addr
 def default_driver_addr() -> str:
     """Address remote tasks can use to reach a KV server bound on this
     (driver) host: the default-route interface's IP via the UDP-connect
-    trick (no traffic sent), falling back to loopback for hostless boxes.
+    trick (no traffic sent); on air-gapped boxes with no default route,
+    the hostname's resolved address; loopback as the last resort.
     Reference analog: the driver-service NIC probe picking a routable
     interface (runner/driver/driver_service.py:162-258)."""
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -32,6 +33,12 @@ def default_driver_addr() -> str:
         s.connect(("8.8.8.8", 9))
         return s.getsockname()[0]
     except OSError:
+        try:
+            ip = socket.gethostbyname(socket.gethostname())
+            if not ip.startswith("127."):
+                return ip
+        except OSError:
+            pass
         return "127.0.0.1"
     finally:
         s.close()

@@ -50,7 +50,8 @@ class ElasticDriver:
     def __init__(self, discovery: HostDiscovery, min_np: int, max_np: int,
                  command: List[str], extra_env: Optional[dict] = None,
                  reset_limit: Optional[int] = None, verbose: bool = False,
-                 discover_interval: float = DISCOVER_INTERVAL_SECS):
+                 discover_interval: float = DISCOVER_INTERVAL_SECS,
+                 spawn_worker=None):
         self._hosts = HostManager(discovery)
         self._min_np = min_np
         self._max_np = max_np
@@ -59,6 +60,11 @@ class ElasticDriver:
         self._reset_limit = reset_limit
         self._verbose = verbose
         self._interval = discover_interval
+        # worker-spawn strategy: (hostname, rank, command, env) -> handle
+        # with the WorkerProcess poll/terminate/kill surface. Schedulers
+        # (Ray) inject their own placement this way; default is
+        # subprocess/ssh exec.
+        self._spawn_worker = spawn_worker or WorkerProcess
 
         self._kv = KVServer().start()
         self._registry = WorkerStateRegistry(self._kv)
@@ -75,9 +81,22 @@ class ElasticDriver:
         self._shutdown = threading.Event()
         self._result: Optional[int] = None
 
+    def publish(self, key: str, value):
+        """Seed the rendezvous KV before workers spawn (e.g. the pickled
+        task function for run_task workers on shared-nothing hosts)."""
+        self._kv.put_json(key, value)
+
+    @property
+    def generation(self) -> int:
+        """The current (on completion: final) topology generation."""
+        return self._generation
+
     # -- lifecycle -----------------------------------------------------------
 
-    def run(self, start_timeout: float = 120.0) -> int:
+    def run(self, start_timeout: float = 120.0, on_complete=None) -> int:
+        """``on_complete(kv)`` runs after the job finishes, while the
+        rendezvous KV is still alive — callers harvest worker-published
+        keys (task results) there."""
         self._wait_for_min_hosts(start_timeout)
         self._rebalance(first=True)
         poller = threading.Thread(target=self._discovery_loop, daemon=True)
@@ -92,7 +111,13 @@ class ElasticDriver:
             barrier.join(timeout=5)
             for w in self._workers.values():
                 w.terminate()
-            self._kv.stop()
+            if on_complete is not None:
+                try:
+                    on_complete(self._kv)
+                finally:
+                    self._kv.stop()
+            else:
+                self._kv.stop()
 
     def _wait_for_min_hosts(self, timeout: float):
         deadline = time.monotonic() + timeout
@@ -247,7 +272,7 @@ class ElasticDriver:
                                  elastic=True, generation=gen,
                                  rendezvous_addr=rdv_addr)
                 self._log(f"spawning worker {key} (generation {gen})")
-                self._workers[key] = WorkerProcess(
+                self._workers[key] = self._spawn_worker(
                     s.hostname, s.rank, self._command, env)
 
     def _reap_workers(self):

@@ -191,7 +191,10 @@ def worker_env(slot, controller_addr, controller_port, data_port,
     return env
 
 
-def run_static(args, liveness_check=None) -> int:
+def run_static(args, liveness_check=None, kv=None) -> int:
+    """``kv``: optionally a caller-owned (started) KVServer — the caller
+    reads worker-published keys (task results) after this returns, and
+    owns stop()."""
     host_string = args.hosts or f"localhost:{args.num_proc}"
     host_list = hosts_lib.parse_hosts(host_string)
     np_ = args.num_proc or sum(h.slots for h in host_list)
@@ -200,7 +203,9 @@ def run_static(args, liveness_check=None) -> int:
     controller_addr = slots[0].hostname if slots[0].hostname != "localhost" \
         else "127.0.0.1"
     controller_port, data_port = free_ports(2)
-    kv = KVServer().start()
+    own_kv = kv is None
+    if own_kv:
+        kv = KVServer().start()
     try:
         publish_assignments(kv, slots, controller_addr, controller_port,
                             data_port)
@@ -214,7 +219,8 @@ def run_static(args, liveness_check=None) -> int:
                                          env))
         return _wait_all(workers, liveness_check)
     finally:
-        kv.stop()
+        if own_kv:
+            kv.stop()
 
 
 def _terminate_all(workers):

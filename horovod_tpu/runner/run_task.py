@@ -4,32 +4,76 @@ Reference analog: horovod/runner/run_task.py + the SafeShell func wrapper
 (runner/__init__.py:206 run(func) → per-worker func execution with the
 return value shipped back to the launcher).
 
-Executes the cloudpickled function and drops its return value into the
-shared results directory as ``result.<rank>.pkl``.
+Executes the cloudpickled function and ships its return value back two
+ways: a ``result.<rank>.pkl`` file in the results directory (covers
+localhost and shared filesystems) and, when the launcher's rendezvous KV
+is in the env, an HTTP PUT of the pickled value (covers remote hosts with
+no shared filesystem — the role of the reference's task service,
+runner/common/service/task_service.py). Start markers ride both channels
+for the launcher's start_timeout.
 """
 
 from __future__ import annotations
 
+import base64
 import os
 import sys
 
 import cloudpickle
 
 
+def _kv_client():
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+    if not addr or not port:
+        return None
+    from horovod_tpu.runner.http_kv import KVClient
+    return KVClient(addr, int(port))
+
+
 def main():
     fn_path, out_dir = sys.argv[1], sys.argv[2]
-    rank0 = os.environ.get("HOROVOD_RANK", "0")
-    # start marker: the launcher's start_timeout watches for these
-    with open(os.path.join(out_dir, f"started.{rank0}"), "w"):
-        pass
-    with open(fn_path, "rb") as f:
-        fn = cloudpickle.load(f)
-    result = fn()
     rank = int(os.environ.get("HOROVOD_RANK", "0"))
-    tmp = os.path.join(out_dir, f".result.{rank}.tmp")
-    with open(tmp, "wb") as f:
-        cloudpickle.dump(result, f)
-    os.replace(tmp, os.path.join(out_dir, f"result.{rank}.pkl"))
+    kv = _kv_client()
+    try:
+        with open(os.path.join(out_dir, f"started.{rank}"), "w"):
+            pass
+    except OSError:
+        pass  # results dir not mounted here; the KV marker covers us
+    if kv is not None:
+        kv.put_json(f"task_started/{rank}", {"ok": True})
+    if os.path.exists(fn_path):
+        with open(fn_path, "rb") as f:
+            fn = cloudpickle.load(f)
+    elif kv is not None:
+        # no shared filesystem: the launcher publishes the pickled
+        # function under task_fn
+        blob = kv.get_json("task_fn", timeout=30.0)
+        if blob is None:
+            raise RuntimeError(f"{fn_path} absent and no task_fn in the "
+                               "rendezvous KV")
+        fn = cloudpickle.loads(base64.b64decode(blob["data"]))
+    else:
+        raise RuntimeError(f"function payload {fn_path} not found")
+    result = fn()
+    payload = cloudpickle.dumps(result)
+    try:
+        tmp = os.path.join(out_dir, f".result.{rank}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, os.path.join(out_dir, f"result.{rank}.pkl"))
+    except OSError:
+        if kv is None:
+            raise
+    if kv is not None:
+        # generation-scoped: under elastic resets a rank's number is
+        # recycled across world sizes — only the final generation's
+        # results may be collected together. The env var tracks re-inits
+        # (elastic/worker.py rewrites it at each rendezvous); static jobs
+        # stay at generation 0.
+        gen = os.environ.get("HOROVOD_ELASTIC_GENERATION", "0")
+        kv.put_json(f"task_result/g{gen}/{rank}",
+                    {"data": base64.b64encode(payload).decode()})
 
 
 if __name__ == "__main__":

@@ -1,11 +1,14 @@
-"""Spark integration: run a horovod_tpu job on Spark executors.
+"""Spark integration: run a horovod_tpu job on Spark executors, or fit a
+model on a DataFrame through the estimator stack.
 
 Reference analog: horovod/spark/runner.py:195-302 — ``horovod.spark.run(fn,
 num_proc=N)`` schedules N simultaneous tasks (a barrier stage), wires the
 coordination env into each, executes ``fn`` and returns the per-rank
-results. The estimator stack (spark/common/store.py) is out of scope for a
-TPU framework — Spark here is a scheduler, not a data plane; Petastorm-style
-ingestion belongs to the input pipeline.
+results — plus the estimator surface (spark/common/estimator.py,
+spark/keras/, spark/torch/): ``KerasEstimator(...).fit(df)`` returns a
+model transformer. The data plane is pyarrow Parquet + numpy (Petastorm
+de-scoped); estimators accept pandas DataFrames too, so they run without
+a Spark session.
 
 pyspark is imported lazily: the module is importable (and the orchestration
 testable via the local-process backend) without it.
@@ -16,6 +19,9 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional
 
 from horovod_tpu.runner.cluster_job import ClusterJobSpec, task_body
+from horovod_tpu.spark.common import (  # noqa: F401
+    Backend, LocalBackend, SparkBackend, Store, LocalStore,
+)
 
 
 def _default_spark_context():

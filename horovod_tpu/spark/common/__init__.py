@@ -1,0 +1,11 @@
+"""Shared estimator infrastructure (reference: horovod/spark/common/)."""
+
+from horovod_tpu.spark.common.backend import (  # noqa: F401
+    Backend, LocalBackend, SparkBackend,
+)
+from horovod_tpu.spark.common.estimator import (  # noqa: F401
+    HorovodEstimator, HorovodModel,
+)
+from horovod_tpu.spark.common.store import (  # noqa: F401
+    FilesystemStore, LocalStore, Store,
+)

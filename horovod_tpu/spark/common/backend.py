@@ -1,0 +1,64 @@
+"""Training-job backends for the estimators.
+
+Reference analog: horovod/spark/common/backend.py (Backend / SparkBackend
+— "run this fn on num_proc coordinated processes"). The TPU build adds a
+LocalBackend over the launcher's local-process core, so estimators train
+without any cluster scheduler (single TPU host, notebooks, CI).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+
+class Backend:
+    def num_processes(self) -> int:
+        raise NotImplementedError()
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        raise NotImplementedError()
+
+
+class SparkBackend(Backend):
+    """Barrier-stage executor backend (reference: backend.py SparkBackend),
+    delegating to horovod_tpu.spark.run."""
+
+    def __init__(self, num_proc: Optional[int] = None, spark_context=None,
+                 verbose: int = 0):
+        self._num_proc = num_proc
+        self._sc = spark_context
+        self._verbose = verbose
+
+    def _context(self):
+        if self._sc is None:
+            from horovod_tpu.spark import _default_spark_context
+            self._sc = _default_spark_context()
+        return self._sc
+
+    def num_processes(self) -> int:
+        return self._num_proc or self._context().defaultParallelism
+
+    def run(self, fn, args=(), kwargs=None):
+        from horovod_tpu import spark as hvd_spark
+        return hvd_spark.run(fn, args=args, kwargs=kwargs,
+                             num_proc=self.num_processes(),
+                             spark_context=self._context(),
+                             verbose=bool(self._verbose))
+
+
+class LocalBackend(Backend):
+    """Local-process backend: the estimator's scheduler-free fallback."""
+
+    def __init__(self, num_proc: int = 1, verbose: int = 0):
+        self._num_proc = num_proc
+        self._verbose = verbose
+
+    def num_processes(self) -> int:
+        return self._num_proc
+
+    def run(self, fn, args=(), kwargs=None):
+        from horovod_tpu.runner.cluster_job import (ClusterJobSpec,
+                                                    run_local_processes)
+        spec = ClusterJobSpec(self._num_proc, controller_addr="127.0.0.1")
+        return run_local_processes(spec, fn, args, kwargs or {})

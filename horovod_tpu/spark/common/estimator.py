@@ -1,0 +1,147 @@
+"""HorovodEstimator / HorovodModel base classes.
+
+Reference analog: horovod/spark/common/estimator.py:25-133 — the
+``fit(df) -> model transformer`` shape of the Spark estimator stack:
+stage the DataFrame into the store as Parquet, run distributed training
+on the backend's processes (each reading its shard), checkpoint rank 0's
+result into the store, and wrap it in a Model whose ``transform`` adds
+prediction columns.
+
+Works on pandas DataFrames without pyspark; with a Spark session, input
+and output are real Spark DataFrames.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.spark.common import util
+from horovod_tpu.spark.common.params import EstimatorParams, ModelParams
+
+
+class HorovodEstimator(EstimatorParams):
+    def fit(self, df, params: Optional[dict] = None):
+        """Fit on a DataFrame (pandas or pyspark); returns the fitted
+        HorovodModel transformer (reference: estimator.py:26-35)."""
+        if params:
+            return self.copy(params).fit(df)
+        backend = self._get_or_create_backend()
+        store = self._require_store()
+        with util.prepare_data(
+                backend.num_processes(), store, df,
+                label_columns=self.getLabelCols(),
+                feature_columns=self.getFeatureCols(),
+                validation=self.getValidation(),
+                sample_weight_col=self.getSampleWeightCol(),
+                compress_sparse=self.getCompressSparseCols(),
+                partitions_per_process=self.getPartitionsPerProcess(),
+                verbose=self.getVerbose()) as idx:
+            train_rows, val_rows, metadata, avg_row_size = \
+                util.get_dataset_properties(store, idx)
+            return self._fit_on_prepared_data(
+                backend, train_rows, val_rows, metadata, avg_row_size, idx)
+
+    def fit_on_parquet(self, params: Optional[dict] = None):
+        """Train on Parquet already staged at the store's train path
+        (reference: estimator.py:37-49)."""
+        if params:
+            return self.copy(params).fit_on_parquet()
+        backend = self._get_or_create_backend()
+        store = self._require_store()
+        train_rows, val_rows, metadata, avg_row_size = \
+            util.get_simple_meta_from_parquet(
+                store, label_columns=self.getLabelCols(),
+                feature_columns=self.getFeatureCols(),
+                sample_weight_col=self.getSampleWeightCol())
+        return self._fit_on_prepared_data(
+            backend, train_rows, val_rows, metadata, avg_row_size, 0)
+
+    # -- shared plumbing -----------------------------------------------------
+
+    def _require_store(self):
+        store = self.getStore()
+        if store is None:
+            raise ValueError("estimator needs a store "
+                             "(Store.create(prefix_path))")
+        return store
+
+    def _get_or_create_backend(self):
+        backend = self.getBackend()
+        if backend is None:
+            from horovod_tpu.spark.common.backend import SparkBackend
+            backend = SparkBackend(self.getNumProc(),
+                                   verbose=self.getVerbose())
+        elif self.getNumProc() is not None:
+            raise ValueError('at most one of "backend" and "num_proc" '
+                             'may be specified')
+        return backend
+
+    def _run_id(self) -> str:
+        run_id = self.getRunId()
+        if run_id is None:
+            run_id = "run_" + uuid.uuid4().hex[:10]
+            self.setRunId(run_id)
+        return run_id
+
+    def _has_checkpoint(self, run_id: str) -> bool:
+        store = self.getStore()
+        path = store.get_checkpoint_path(run_id)
+        return path is not None and store.exists(path)
+
+    def _fit_on_prepared_data(self, backend, train_rows, val_rows, metadata,
+                              avg_row_size, dataset_idx):
+        raise NotImplementedError()
+
+
+class HorovodModel(ModelParams):
+    def transform(self, df, params: Optional[dict] = None):
+        """Add prediction columns (``<label>__output`` by default) to a
+        pandas or pyspark DataFrame (reference: estimator.py:97-117)."""
+        if params:
+            return self.copy(params).transform(df)
+        if util._is_spark_df(df):
+            return self._transform_spark(df)
+        return self._transform_pandas(df.copy())
+
+    # -- frameworks implement: batch predictions for a feature matrix -------
+
+    def _predict_batch(self, features: np.ndarray) -> np.ndarray:
+        """(rows, features) float32 -> (rows, output_dim) predictions."""
+        raise NotImplementedError()
+
+    def _transform_pandas(self, pdf):
+        feats = util.assemble_features(pdf, self._get("feature_cols"))
+        preds = np.asarray(self._predict_batch(feats))
+        out_cols = self.getOutputCols()
+        preds = preds.reshape(len(pdf), len(out_cols), -1)
+        for j, col in enumerate(out_cols):
+            block = preds[:, j]
+            pdf[col] = list(block) if block.shape[-1] > 1 \
+                else block.ravel()
+        return pdf
+
+    def _transform_spark(self, df):
+        import pandas as pd  # noqa: F401 — mapInPandas contract
+
+        model = self
+
+        def _predict(iterator):
+            for pdf in iterator:
+                yield model._transform_pandas(pdf)
+
+        # probe one row on the driver to learn each output column's shape
+        # — multi-output models yield array columns, not doubles
+        probe = self._transform_pandas(df.limit(1).toPandas())
+
+        def _field(col):
+            first = probe[col].iloc[0]
+            kind = "array<double>" if isinstance(
+                first, (list, tuple, np.ndarray)) else "double"
+            return f"`{col}` {kind}"
+
+        out_fields = ", ".join(_field(c) for c in self.getOutputCols())
+        schema = f"{df.schema.simpleString()[7:-1]}, {out_fields}"
+        return df.mapInPandas(_predict, schema=schema)

@@ -1,0 +1,126 @@
+"""Estimator parameter surface.
+
+Reference analog: horovod/spark/common/params.py:24-300 (EstimatorParams /
+ModelParams — pyspark.ml Param machinery with setX/getX accessors). The
+TPU build keeps the accessor surface (the part user code touches) over
+plain attributes, so the estimators import and run without pyspark; when a
+Spark session is around they still consume/produce real Spark DataFrames.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def _accessor_name(param: str) -> str:
+    return "".join(p.capitalize() for p in param.split("_"))
+
+
+class _ParamsBase:
+    """Plain-attribute param store with generated reference-style
+    ``setFooBar``/``getFooBar`` accessors and keyword construction."""
+
+    _params: Dict[str, Any] = {}
+
+    def __init__(self, **kwargs):
+        defaults = {}
+        for klass in reversed(type(self).__mro__):
+            defaults.update(getattr(klass, "_params", {}))
+        self._values = dict(defaults)
+        self.setParams(**kwargs)
+
+    def setParams(self, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self._values:
+                raise TypeError(f"unknown parameter {k!r} for "
+                                f"{type(self).__name__}")
+            self._values[k] = v
+        return self
+
+    def _get(self, param: str):
+        return self._values[param]
+
+    def _set_value(self, param: str, value):
+        self._values[param] = value
+        return self
+
+    def copy(self, extra: Dict[str, Any] = None):
+        import copy as _copy
+        dup = _copy.copy(self)
+        dup._values = dict(self._values)
+        if extra:
+            dup.setParams(**extra)
+        return dup
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        for param in cls.__dict__.get("_params", {}):
+            acc = _accessor_name(param)
+            # default-arg binding: each accessor closes over its own param;
+            # hasattr (not cls.__dict__) so a subclass re-declaring a param
+            # never shadows a hand-written inherited accessor
+            if not hasattr(cls, f"get{acc}"):
+                setattr(cls, f"get{acc}",
+                        lambda self, _p=param: self._get(_p))
+            if not hasattr(cls, f"set{acc}"):
+                setattr(cls, f"set{acc}",
+                        lambda self, value, _p=param:
+                        self._set_value(_p, value))
+
+
+class EstimatorParams(_ParamsBase):
+    """Reference: params.py EstimatorParams (field-for-field; Petastorm
+    reader-pool knobs dropped with the Petastorm de-scope)."""
+
+    _params = {
+        "num_proc": None,
+        "backend": None,
+        "store": None,
+        "model": None,
+        "optimizer": None,
+        "loss": None,
+        "loss_weights": None,
+        "metrics": [],
+        "feature_cols": None,
+        "label_cols": None,
+        "sample_weight_col": None,
+        "validation": None,
+        "callbacks": [],
+        "batch_size": 32,
+        "val_batch_size": None,
+        "epochs": 1,
+        "train_steps_per_epoch": None,
+        "validation_steps_per_epoch": None,
+        "shuffle_buffer_size": None,
+        "verbose": 1,
+        "partitions_per_process": None,
+        "run_id": None,
+        "transformation_fn": None,
+        "label_shapes": None,
+        "gradient_compression": None,
+        "compress_sparse_cols": False,
+        "backward_passes_per_step": 1,
+    }
+
+
+class ModelParams(_ParamsBase):
+    """Reference: params.py ModelParams."""
+
+    _params = {
+        "history": None,
+        "model": None,
+        "feature_cols": None,
+        "label_cols": None,
+        "output_cols": None,
+        "run_id": None,
+        "metadata": None,
+    }
+
+    def setOutputCols(self, value):
+        return self._set_value("output_cols", value)
+
+    def getOutputCols(self):
+        out = self._get("output_cols")
+        if out is None:
+            out = [f"{c}__output" for c in (self._get("label_cols") or [])]
+        return out

@@ -1,0 +1,191 @@
+"""Storage abstraction for estimator data, checkpoints, and logs.
+
+Reference analog: horovod/spark/common/store.py:32-456 (Store /
+FilesystemStore / LocalStore) — the surface the estimators program
+against: where prepared Parquet shards live, where each run's checkpoint
+and logs go, and how a training process syncs its local outputs back.
+
+TPU-native scope: the data plane is pyarrow on a filesystem path. A
+local/NFS path covers single-host and shared-filesystem clusters (the
+common TPU-pod shape — pods mount shared storage); HDFS/S3/DBFS drivers
+are out of scope and `Store.create` says so loudly rather than silently
+degrading.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Optional
+
+
+class Store:
+    """Abstract run/data layout (reference: store.py:32-150)."""
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError()
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError()
+
+    def get_test_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError()
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        raise NotImplementedError()
+
+    def saving_runs(self) -> bool:
+        raise NotImplementedError()
+
+    def get_runs_path(self) -> str:
+        raise NotImplementedError()
+
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError()
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError()
+
+    def write(self, path: str, data: bytes):
+        raise NotImplementedError()
+
+    def get_local_output_dir_fn(self, run_id: str):
+        """Context manager factory: a scratch dir the training process can
+        write into; sync_fn ships it to the run path."""
+        raise NotImplementedError()
+
+    def sync_fn(self, run_id: str):
+        """Returns fn(local_run_path) that syncs local outputs to the
+        store's run path."""
+        raise NotImplementedError()
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Pick a store for the path (reference: store.py:143-150). Only
+        filesystem paths are supported; remote schemes raise."""
+        for scheme in ("hdfs://", "s3://", "s3a://", "dbfs:/", "gs://"):
+            if prefix_path.startswith(scheme):
+                raise ValueError(
+                    f"unsupported store scheme {scheme!r}: horovod_tpu "
+                    "estimators use filesystem stores (local or "
+                    "cluster-shared mounts); stage remote data to a "
+                    "mounted path first")
+        return LocalStore(prefix_path, *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Path layout shared by all filesystem stores (reference:
+    store.py:153-273)."""
+
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 test_path: Optional[str] = None,
+                 runs_path: Optional[str] = None,
+                 save_runs: bool = True):
+        self.prefix_path = prefix_path.rstrip("/")
+        self._train_path = train_path or os.path.join(
+            self.prefix_path, "intermediate_train_data")
+        self._val_path = val_path or os.path.join(
+            self.prefix_path, "intermediate_val_data")
+        self._test_path = test_path or os.path.join(
+            self.prefix_path, "intermediate_test_data")
+        self._runs_path = runs_path or os.path.join(
+            self.prefix_path, "runs")
+        self._save_runs = save_runs
+
+    def _indexed(self, path: str, idx: Optional[int]) -> str:
+        return path if idx is None else f"{path}.{idx}"
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        return self._indexed(self._train_path, idx)
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        return self._indexed(self._val_path, idx)
+
+    def get_test_data_path(self, idx: Optional[int] = None) -> str:
+        return self._indexed(self._test_path, idx)
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        try:
+            import pyarrow.parquet as pq
+            pq.ParquetDataset(path)
+            return True
+        except Exception:  # noqa: BLE001 — absent/corrupt = not a dataset
+            return False
+
+    def saving_runs(self) -> bool:
+        return self._save_runs
+
+    def get_runs_path(self) -> str:
+        return self._runs_path
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self._runs_path, run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> Optional[str]:
+        return os.path.join(self.get_run_path(run_id),
+                            self.get_checkpoint_filename()) \
+            if self._save_runs else None
+
+    def get_logs_path(self, run_id: str) -> Optional[str]:
+        return os.path.join(self.get_run_path(run_id),
+                            self.get_logs_subdir()) \
+            if self._save_runs else None
+
+    def get_checkpoint_filename(self) -> str:
+        return "checkpoint.pkl"
+
+    def get_logs_subdir(self) -> str:
+        return "logs"
+
+
+class LocalStore(FilesystemStore):
+    """Local (or cluster-shared mount) filesystem store (reference:
+    store.py:276-318)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: readers never see partial bytes
+
+    def get_local_output_dir_fn(self, run_id: str):
+        import contextlib
+
+        @contextlib.contextmanager
+        def local_run_path():
+            d = tempfile.mkdtemp(prefix=f"hvdtpu_run_{run_id}_")
+            try:
+                yield d
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+
+        return local_run_path
+
+    def sync_fn(self, run_id: str):
+        run_path = self.get_run_path(run_id)
+
+        def fn(local_run_path: str):
+            os.makedirs(run_path, exist_ok=True)
+            shutil.copytree(local_run_path, run_path, dirs_exist_ok=True)
+
+        return fn

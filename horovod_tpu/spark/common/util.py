@@ -1,0 +1,236 @@
+"""Estimator data plane: DataFrame -> sharded Parquet -> worker arrays.
+
+Reference analog: horovod/spark/common/util.py (prepare_data /
+get_simple_meta_from_parquet / dataset metadata, :362-700). The reference
+stages through Petastorm; here the data plane is pyarrow Parquet + numpy —
+the form a TPU input pipeline wants (dense host arrays it can stack into
+device batches) — and both pandas and pyspark DataFrames are accepted,
+so the estimators work with or without a Spark session.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _is_spark_df(df) -> bool:
+    return hasattr(df, "toPandas") and hasattr(df, "rdd")
+
+
+def _meta_path(data_path: str) -> str:
+    return os.path.join(data_path, "_hvdtpu_metadata.json")
+
+
+def _column_metadata(pdf) -> Dict[str, dict]:
+    """Per-column dtype + per-row shape ([] scalar, [n] fixed list)."""
+    meta = {}
+    for col in pdf.columns:
+        first = pdf[col].iloc[0] if len(pdf) else 0.0
+        if isinstance(first, (list, tuple, np.ndarray)):
+            arr = np.asarray(first)
+            meta[col] = {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+        else:
+            meta[col] = {"dtype": str(np.asarray(first).dtype), "shape": []}
+    return meta
+
+
+def _write_pandas_shards(pdf, path: str, num_shards: int):
+    """Write a pandas frame as ``num_shards`` Parquet files (one per
+    training process; round-robin rows so every shard is non-empty when
+    rows >= shards)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    shutil.rmtree(path, ignore_errors=True)
+    os.makedirs(path, exist_ok=True)
+    for i in range(num_shards):
+        shard = pdf.iloc[i::num_shards]
+        table = pa.Table.from_pandas(shard.reset_index(drop=True),
+                                     preserve_index=False)
+        pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+
+def _row_count_and_size(path: str) -> Tuple[int, int]:
+    import pyarrow.parquet as pq
+    rows, bytes_ = 0, 0
+    for f in sorted(os.listdir(path)):
+        if not f.endswith(".parquet"):
+            continue
+        fp = os.path.join(path, f)
+        rows += pq.ParquetFile(fp).metadata.num_rows
+        bytes_ += os.path.getsize(fp)
+    return rows, (bytes_ // max(rows, 1))
+
+
+@contextlib.contextmanager
+def prepare_data(num_processes: int, store, df,
+                 label_columns: Sequence[str],
+                 feature_columns: Sequence[str],
+                 validation=None,
+                 sample_weight_col: Optional[str] = None,
+                 compress_sparse: bool = False,
+                 partitions_per_process: Optional[int] = None,
+                 verbose: int = 0):
+    """Stage ``df`` into the store as train/val Parquet shards; yields the
+    dataset index (reference: util.py prepare_data). ``validation`` is a
+    float fraction, a boolean column name, or None.
+
+    Unlike the reference there is no content-hash cache: each fit stages
+    afresh (the Parquet write is the cheap part of a training run, and a
+    stale-cache surprise is worse than a rewrite).
+    """
+    _ = (compress_sparse, partitions_per_process)
+    idx = 0
+    cols = list(dict.fromkeys(
+        list(feature_columns) + list(label_columns) +
+        ([sample_weight_col] if sample_weight_col else []) +
+        ([validation] if isinstance(validation, str) else [])))
+    if _is_spark_df(df):
+        df = df.select(*cols).toPandas()
+    else:
+        missing = [c for c in cols if c not in df.columns]
+        if missing:
+            raise ValueError(f"columns {missing} not in DataFrame")
+        df = df[cols]
+
+    if validation is None:
+        train_pdf, val_pdf = df, None
+    elif isinstance(validation, float):
+        if not 0.0 < validation < 1.0:
+            raise ValueError(f"validation fraction must be in (0, 1), "
+                             f"got {validation}")
+        n_val = max(1, int(round(len(df) * validation)))
+        rs = np.random.RandomState(0)
+        perm = rs.permutation(len(df))
+        val_pdf = df.iloc[perm[:n_val]]
+        train_pdf = df.iloc[perm[n_val:]]
+    elif isinstance(validation, str):
+        mask = df[validation].astype(bool)
+        val_pdf = df[mask].drop(columns=[validation])
+        train_pdf = df[~mask].drop(columns=[validation])
+    else:
+        raise ValueError(f"validation must be None, float, or column name; "
+                         f"got {type(validation)}")
+
+    train_path = store.get_train_data_path(idx)
+    _write_pandas_shards(train_pdf, train_path, num_processes)
+    meta = {
+        "columns": _column_metadata(train_pdf),
+        "label_columns": list(label_columns),
+        "feature_columns": list(feature_columns),
+        "sample_weight_col": sample_weight_col,
+    }
+    with open(_meta_path(train_path), "w") as f:
+        json.dump(meta, f)
+    val_path = store.get_val_data_path(idx)
+    if val_pdf is not None and len(val_pdf):
+        _write_pandas_shards(val_pdf, val_path, num_processes)
+    else:
+        # a previous fit's staged validation shards must not leak into
+        # this run (workers gate on the path's existence)
+        shutil.rmtree(val_path, ignore_errors=True)
+    if verbose:
+        print(f"[horovod_tpu.spark] staged {len(train_pdf)} train / "
+              f"{0 if val_pdf is None else len(val_pdf)} val rows "
+              f"to {train_path}")
+    yield idx
+
+
+def get_dataset_properties(store, idx: int = 0):
+    """(train_rows, val_rows, metadata, avg_row_size) of a staged dataset
+    (reference: util.py get_dataset_properties)."""
+    train_path = store.get_train_data_path(idx)
+    train_rows, avg_row_size = _row_count_and_size(train_path)
+    val_path = store.get_val_data_path(idx)
+    val_rows = _row_count_and_size(val_path)[0] if store.exists(val_path) \
+        else 0
+    with open(_meta_path(train_path)) as f:
+        metadata = json.load(f)
+    return train_rows, val_rows, metadata, avg_row_size
+
+
+def get_simple_meta_from_parquet(store, label_columns, feature_columns,
+                                 sample_weight_col=None, idx: int = 0):
+    """Metadata for an externally staged Parquet dataset at the store's
+    train path (reference: util.py get_simple_meta_from_parquet). Writes
+    the metadata sidecar if absent so fit_on_parquet works on data the
+    estimator didn't stage itself."""
+    import pyarrow.parquet as pq
+
+    train_path = store.get_train_data_path(idx)
+    if not os.path.exists(_meta_path(train_path)):
+        files = [f for f in sorted(os.listdir(train_path))
+                 if f.endswith(".parquet")]
+        if not files:
+            raise ValueError(f"no parquet files at {train_path}")
+        pdf = pq.ParquetFile(
+            os.path.join(train_path, files[0])).read().to_pandas()
+        meta = {
+            "columns": _column_metadata(pdf),
+            "label_columns": list(label_columns),
+            "feature_columns": list(feature_columns),
+            "sample_weight_col": sample_weight_col,
+        }
+        with open(_meta_path(train_path), "w") as f:
+            json.dump(meta, f)
+    return get_dataset_properties(store, idx)
+
+
+def read_shard(data_path: str, rank: int, size: int,
+               columns: Optional[List[str]] = None):
+    """This rank's rows of a staged dataset as a pandas DataFrame.
+
+    Sharding is file-granular when the writer produced >= size files (the
+    prepare_data layout); otherwise row-granular (rank strides rows) so
+    externally staged datasets with few files still split correctly.
+    """
+    import pandas as pd
+    import pyarrow.parquet as pq
+
+    files = [os.path.join(data_path, f)
+             for f in sorted(os.listdir(data_path))
+             if f.endswith(".parquet")]
+    if not files:
+        raise ValueError(f"no parquet files at {data_path}")
+    if len(files) >= size:
+        mine = files[rank::size]
+        parts = [pq.read_table(f, columns=columns).to_pandas()
+                 for f in mine]
+        return pd.concat(parts, ignore_index=True) if parts else \
+            pq.read_table(files[0], columns=columns).to_pandas().iloc[:0]
+    full = pd.concat([pq.read_table(f, columns=columns).to_pandas()
+                      for f in files], ignore_index=True)
+    return full.iloc[rank::size].reset_index(drop=True)
+
+
+def assemble_features(pdf, feature_columns: Sequence[str]) -> np.ndarray:
+    """Stack feature columns into one dense (rows, features) float32 array
+    — scalars contribute one column, fixed-size list/array columns expand
+    (the role of the reference's vector assembly in util.py:
+    dense features ride a single MXU-friendly matrix)."""
+    blocks = []
+    for col in feature_columns:
+        vals = pdf[col].to_numpy()
+        if len(vals) and isinstance(vals[0], (list, tuple, np.ndarray)):
+            block = np.stack([np.asarray(v, np.float32).ravel()
+                              for v in vals])
+        else:
+            block = np.asarray(vals, np.float32).reshape(len(vals), 1)
+        blocks.append(block)
+    if not blocks:
+        raise ValueError("no feature columns")
+    return np.concatenate(blocks, axis=1).astype(np.float32)
+
+
+def assemble_labels(pdf, label_columns: Sequence[str]) -> np.ndarray:
+    """(rows, len(label_columns)) float32 label matrix; single-column
+    labels stay 2D for a uniform loss interface."""
+    cols = [np.asarray(pdf[c].to_numpy(), np.float32).reshape(len(pdf), -1)
+            for c in label_columns]
+    return np.concatenate(cols, axis=1)

@@ -1,0 +1,161 @@
+"""KerasEstimator: fit a keras model on a DataFrame, get a transformer.
+
+Reference analog: horovod/spark/keras/estimator.py:106-520 (KerasEstimator
+/ KerasModel). Each training process reads its Parquet shard from the
+store, wraps the user optimizer in DistributedOptimizer, trains with the
+broadcast + metric-average callbacks, and rank 0 checkpoints weights into
+the store; the driver rebuilds the model from that checkpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.spark.common import util
+from horovod_tpu.spark.common.estimator import HorovodEstimator, HorovodModel
+
+
+def _resolve_compression(name):
+    from horovod_tpu.tensorflow.compression import Compression
+    if name is None or name == "none":
+        return Compression.none
+    return getattr(Compression, name)
+
+
+def _keras_train_fn(payload: dict):
+    """Runs on every backend process (top-level so schedulers pickle it by
+    reference)."""
+    import cloudpickle
+    import tensorflow as tf  # noqa: F401 — keras backend
+    import horovod_tpu.tensorflow.keras as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    store = payload["store"]
+    run_id = payload["run_id"]
+
+    model = cloudpickle.loads(payload["model"])
+    optimizer = cloudpickle.loads(payload["optimizer"])
+    loss = cloudpickle.loads(payload["loss"])
+    metrics = cloudpickle.loads(payload["metrics"]) or []
+    user_callbacks = cloudpickle.loads(payload["callbacks"]) or []
+
+    dist_opt = hvd.DistributedOptimizer(
+        optimizer,
+        compression=_resolve_compression(payload["compression"]),
+        backward_passes_per_step=payload["backward_passes_per_step"])
+    model.compile(optimizer=dist_opt, loss=loss,
+                  loss_weights=payload["loss_weights"], metrics=metrics)
+
+    pdf = util.read_shard(payload["train_path"], rank, size)
+    x = util.assemble_features(pdf, payload["feature_columns"])
+    y = util.assemble_labels(pdf, payload["label_columns"])
+    sample_weight = None
+    if payload["sample_weight_col"]:
+        sample_weight = np.asarray(
+            pdf[payload["sample_weight_col"]].to_numpy(), np.float32)
+    val_data = None
+    if payload["val_path"] is not None:
+        vdf = util.read_shard(payload["val_path"], rank, size)
+        if len(vdf):
+            val_data = (util.assemble_features(vdf,
+                                               payload["feature_columns"]),
+                        util.assemble_labels(vdf,
+                                             payload["label_columns"]))
+
+    callbacks = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                 hvd.callbacks.MetricAverageCallback()] + user_callbacks
+    history = model.fit(
+        x, y, sample_weight=sample_weight,
+        batch_size=payload["batch_size"],
+        epochs=payload["epochs"],
+        steps_per_epoch=payload["train_steps_per_epoch"],
+        validation_data=val_data,
+        validation_steps=payload["validation_steps_per_epoch"],
+        validation_batch_size=payload["val_batch_size"],
+        callbacks=callbacks,
+        shuffle=True,
+        verbose=payload["verbose"] if rank == 0 else 0)
+
+    if rank == 0:
+        ckpt = store.get_checkpoint_path(run_id)
+        if ckpt is not None:
+            store.write(ckpt, cloudpickle.dumps(model.get_weights()))
+    hvd.shutdown()
+    return {k: [float(v) for v in vs] for k, vs in history.history.items()}
+
+
+class KerasEstimator(HorovodEstimator):
+    """Reference: spark/keras/estimator.py:106-390. Construct with the
+    same keywords (model=, optimizer=, loss=, store=, feature_cols=,
+    label_cols=, batch_size=, epochs=, ...)."""
+
+    def _fit_on_prepared_data(self, backend, train_rows, val_rows, metadata,
+                              avg_row_size, dataset_idx):
+        import cloudpickle
+
+        _ = (train_rows, val_rows, avg_row_size)
+        store = self._require_store()
+        run_id = self._run_id()
+        model = self.getModel()
+        if model is None or self.getOptimizer() is None or \
+                self.getLoss() is None:
+            raise ValueError("KerasEstimator needs model=, optimizer=, "
+                             "and loss=")
+        val_path = store.get_val_data_path(dataset_idx)
+        payload = {
+            "store": store,
+            "run_id": run_id,
+            "train_path": store.get_train_data_path(dataset_idx),
+            "val_path": val_path if store.exists(val_path) else None,
+            "feature_columns": self.getFeatureCols(),
+            "label_columns": self.getLabelCols(),
+            "sample_weight_col": self.getSampleWeightCol(),
+            "model": cloudpickle.dumps(model),
+            "optimizer": cloudpickle.dumps(self.getOptimizer()),
+            "loss": cloudpickle.dumps(self.getLoss()),
+            "loss_weights": self.getLossWeights(),
+            "metrics": cloudpickle.dumps(self.getMetrics()),
+            "callbacks": cloudpickle.dumps(self.getCallbacks()),
+            "batch_size": self.getBatchSize(),
+            "val_batch_size": self.getValBatchSize(),
+            "epochs": self.getEpochs(),
+            "train_steps_per_epoch": self.getTrainStepsPerEpoch(),
+            "validation_steps_per_epoch": self.getValidationStepsPerEpoch(),
+            "compression": self.getGradientCompression(),
+            "backward_passes_per_step": self.getBackwardPassesPerStep(),
+            "verbose": self.getVerbose(),
+        }
+        results = backend.run(_keras_train_fn, args=(payload,))
+        history = results[0]
+        return self._create_model(history, run_id, metadata)
+
+    def _create_model(self, history, run_id, metadata):
+        import cloudpickle
+
+        store = self._require_store()
+        ckpt = store.get_checkpoint_path(run_id)
+        trained = cloudpickle.loads(cloudpickle.dumps(self.getModel()))
+        if ckpt is not None and store.exists(ckpt):
+            trained.set_weights(cloudpickle.loads(store.read(ckpt)))
+        return KerasModel(model=trained, history=history,
+                          feature_cols=self.getFeatureCols(),
+                          label_cols=self.getLabelCols(),
+                          run_id=run_id, metadata=metadata)
+
+
+class KerasModel(HorovodModel):
+    """Transformer over a trained keras model (reference:
+    spark/keras/estimator.py:392-520)."""
+
+    def _predict_batch(self, features: np.ndarray) -> np.ndarray:
+        model = self._get("model")
+        preds = model.predict(features, verbose=0)
+        return np.asarray(preds)
+
+    def keras(self):
+        """The underlying trained keras model (reference parity:
+        KerasModel.getModel())."""
+        return self._get("model")

@@ -1,0 +1,210 @@
+"""TorchEstimator: fit a torch model on a DataFrame, get a transformer.
+
+Reference analog: horovod/spark/torch/estimator.py:91-434 (TorchEstimator
+/ TorchModel). The model and its bound optimizer serialize together (one
+cloudpickle payload, so parameter identity survives); each process trains
+its Parquet shard with the torch DistributedOptimizer and broadcast-
+synchronized initial state; rank 0 checkpoints ``model.state_dict()``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.spark.common import util
+from horovod_tpu.spark.common.estimator import HorovodEstimator, HorovodModel
+from horovod_tpu.spark.common.params import EstimatorParams, ModelParams
+
+
+def _resolve_compression(name):
+    from horovod_tpu.torch.compression import Compression
+    if name is None or name == "none":
+        return Compression.none
+    return getattr(Compression, name)
+
+
+def _reshape_inputs(x: np.ndarray, input_shapes):
+    import torch
+    t = torch.as_tensor(x)
+    if input_shapes:
+        if len(input_shapes) == 1:
+            return [t.reshape(input_shapes[0])]
+        # multiple inputs: split the flat feature axis by shape sizes
+        outs, off = [], 0
+        for shape in input_shapes:
+            n = int(np.prod([d for d in shape if d != -1]))
+            outs.append(t[:, off:off + n].reshape(shape))
+            off += n
+        return outs
+    return [t]
+
+
+def _torch_train_fn(payload: dict):
+    """Runs on every backend process."""
+    import cloudpickle
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    store = payload["store"]
+    run_id = payload["run_id"]
+
+    model, optimizer = cloudpickle.loads(payload["model_opt"])
+    loss_fns = cloudpickle.loads(payload["loss"])
+    if not isinstance(loss_fns, (list, tuple)):
+        loss_fns = [loss_fns]
+    loss_weights = payload["loss_weights"] or [1.0] * len(loss_fns)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    dist_opt = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=_resolve_compression(payload["compression"]),
+        backward_passes_per_step=payload["backward_passes_per_step"])
+
+    pdf = util.read_shard(payload["train_path"], rank, size)
+    x = util.assemble_features(pdf, payload["feature_columns"])
+    y = util.assemble_labels(pdf, payload["label_columns"])
+    sw = None
+    if payload["sample_weight_col"]:
+        sw = np.asarray(pdf[payload["sample_weight_col"]].to_numpy(),
+                        np.float32)
+
+    batch = payload["batch_size"]
+    label_shapes = payload["label_shapes"]
+    history = {"loss": []}
+    steps_cap = payload["train_steps_per_epoch"]
+    model.train()
+    for _epoch in range(payload["epochs"]):
+        perm = np.random.RandomState(_epoch).permutation(len(x))
+        epoch_loss, steps = 0.0, 0
+        for s in range(0, len(x), batch):
+            if steps_cap is not None and steps >= steps_cap:
+                break
+            idx = perm[s:s + batch]
+            inputs = _reshape_inputs(x[idx], payload["input_shapes"])
+            target = torch.as_tensor(y[idx])
+            if label_shapes:
+                target = target.reshape(label_shapes[0])
+            dist_opt.zero_grad()
+            out = model(*inputs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            loss = sum(w * fn(o, target)
+                       for w, fn, o in zip(loss_weights, loss_fns, outs))
+            if sw is not None:
+                loss = loss * float(np.mean(sw[idx]))
+            loss.backward()
+            dist_opt.step()
+            epoch_loss += float(loss.detach())
+            steps += 1
+        avg = epoch_loss / max(steps, 1)
+        history["loss"].append(float(hvd.allreduce(
+            torch.tensor(avg), name=f"epoch_loss_{_epoch}")))
+
+    if rank == 0:
+        ckpt = store.get_checkpoint_path(run_id)
+        if ckpt is not None:
+            store.write(ckpt, cloudpickle.dumps(
+                {k: v.cpu().numpy() for k, v in model.state_dict().items()}))
+    hvd.shutdown()
+    return history
+
+
+class TorchEstimator(HorovodEstimator):
+    """Reference: spark/torch/estimator.py:91-325. Extra params over the
+    common surface: input_shapes (reshape the assembled feature matrix
+    into the model's input tensors), loss_constructors."""
+
+    _params = dict(EstimatorParams._params,
+                   input_shapes=None, loss_constructors=None,
+                   train_minibatch_fn=None, in_memory_cache_all=False)
+
+    def _get_loss_fns(self):
+        loss = self.getLoss()
+        if loss is None and self.getLossConstructors():
+            ctors = self.getLossConstructors()
+            ctors = ctors if isinstance(ctors, (list, tuple)) else [ctors]
+            loss = [c() for c in ctors]
+        return loss
+
+    def _fit_on_prepared_data(self, backend, train_rows, val_rows, metadata,
+                              avg_row_size, dataset_idx):
+        import cloudpickle
+
+        _ = (train_rows, val_rows, avg_row_size)
+        store = self._require_store()
+        run_id = self._run_id()
+        model = self.getModel()
+        loss = self._get_loss_fns()
+        if model is None or self.getOptimizer() is None or loss is None:
+            raise ValueError("TorchEstimator needs model=, optimizer=, and "
+                             "loss= (or loss_constructors=)")
+        val_path = store.get_val_data_path(dataset_idx)
+        payload = {
+            "store": store,
+            "run_id": run_id,
+            "train_path": store.get_train_data_path(dataset_idx),
+            "val_path": val_path if store.exists(val_path) else None,
+            "feature_columns": self.getFeatureCols(),
+            "label_columns": self.getLabelCols(),
+            "sample_weight_col": self.getSampleWeightCol(),
+            # model+optimizer in ONE payload: the optimizer's parameter
+            # references must deserialize to the same tensors
+            "model_opt": cloudpickle.dumps((model, self.getOptimizer())),
+            "loss": cloudpickle.dumps(loss),
+            "loss_weights": self.getLossWeights(),
+            "batch_size": self.getBatchSize(),
+            "epochs": self.getEpochs(),
+            "train_steps_per_epoch": self.getTrainStepsPerEpoch(),
+            "input_shapes": self.getInputShapes(),
+            "label_shapes": self.getLabelShapes(),
+            "compression": self.getGradientCompression(),
+            "backward_passes_per_step": self.getBackwardPassesPerStep(),
+            "verbose": self.getVerbose(),
+        }
+        results = backend.run(_torch_train_fn, args=(payload,))
+        history = results[0]
+        return self._create_model(history, run_id, metadata)
+
+    def _create_model(self, history, run_id, metadata):
+        import cloudpickle
+        import torch
+
+        store = self._require_store()
+        ckpt = store.get_checkpoint_path(run_id)
+        trained, _opt = cloudpickle.loads(
+            cloudpickle.dumps((self.getModel(), None)))
+        if ckpt is not None and store.exists(ckpt):
+            state = {k: torch.as_tensor(v) for k, v in
+                     cloudpickle.loads(store.read(ckpt)).items()}
+            trained.load_state_dict(state)
+        return TorchModel(model=trained, history=history,
+                          feature_cols=self.getFeatureCols(),
+                          label_cols=self.getLabelCols(),
+                          run_id=run_id, metadata=metadata,
+                          input_shapes=self.getInputShapes())
+
+
+class TorchModel(HorovodModel):
+    """Transformer over a trained torch model (reference:
+    spark/torch/estimator.py:326-434)."""
+
+    _params = dict(ModelParams._params, input_shapes=None)
+
+    def _predict_batch(self, features: np.ndarray) -> np.ndarray:
+        import torch
+
+        model = self._get("model")
+        model.eval()
+        with torch.no_grad():
+            inputs = _reshape_inputs(features, self._get("input_shapes"))
+            out = model(*inputs)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        return np.asarray(out.cpu().numpy())
+
+    def torch(self):
+        """The underlying trained torch module."""
+        return self._get("model")

@@ -1,0 +1,144 @@
+"""Spark estimator stack: Store layout, DataFrame -> Parquet staging,
+KerasEstimator / TorchEstimator fit + transform round-trips over real
+multi-process training (LocalBackend), and fit_on_parquet.
+
+The analog of the reference's test/integration/test_spark_keras.py +
+test_spark_torch.py with the scheduler swapped for the local-process
+backend; a real local-mode pyspark run is exercised in test_spark_ray.py
+when pyspark is importable.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.spark.common import LocalBackend, LocalStore, Store
+from horovod_tpu.spark.common import util
+
+
+def _regression_df(n=256, seed=0):
+    rs = np.random.RandomState(seed)
+    x0 = rs.rand(n).astype(np.float32)
+    x1 = rs.rand(n).astype(np.float32)
+    y = 2.0 * x0 - 3.0 * x1 + 1.0 + rs.randn(n).astype(np.float32) * 0.01
+    return pd.DataFrame({"x0": x0, "x1": x1, "y": y})
+
+
+def test_store_layout_and_create(tmp_path):
+    store = Store.create(str(tmp_path / "store"))
+    assert isinstance(store, LocalStore)
+    assert store.get_train_data_path(0).endswith("intermediate_train_data.0")
+    assert store.get_checkpoint_path("r1").endswith("r1/checkpoint.pkl")
+    store.write(store.get_checkpoint_path("r1"), b"abc")
+    assert store.exists(store.get_checkpoint_path("r1"))
+    assert store.read(store.get_checkpoint_path("r1")) == b"abc"
+    with pytest.raises(ValueError, match="hdfs"):
+        Store.create("hdfs://namenode/path")
+
+
+def test_prepare_data_and_shards(tmp_path):
+    store = Store.create(str(tmp_path))
+    df = _regression_df(100)
+    with util.prepare_data(4, store, df, label_columns=["y"],
+                           feature_columns=["x0", "x1"],
+                           validation=0.2) as idx:
+        train_rows, val_rows, meta, avg_row = \
+            util.get_dataset_properties(store, idx)
+    assert train_rows == 80 and val_rows == 20
+    assert meta["columns"]["x0"]["shape"] == []
+    assert avg_row > 0
+    # every rank's shard is disjoint and they cover the dataset
+    shards = [util.read_shard(store.get_train_data_path(idx), r, 4)
+              for r in range(4)]
+    assert sum(len(s) for s in shards) == 80
+    feats = util.assemble_features(shards[0], ["x0", "x1"])
+    assert feats.shape == (len(shards[0]), 2)
+
+
+def test_validation_column_split(tmp_path):
+    store = Store.create(str(tmp_path))
+    df = _regression_df(50)
+    df["is_val"] = [i % 5 == 0 for i in range(50)]
+    with util.prepare_data(2, store, df, label_columns=["y"],
+                          feature_columns=["x0", "x1"],
+                          validation="is_val") as idx:
+        train_rows, val_rows, _, _ = util.get_dataset_properties(store, idx)
+    assert train_rows == 40 and val_rows == 10
+
+
+def test_keras_estimator_fit_transform(tmp_path):
+    import tensorflow as tf
+    from horovod_tpu.spark.keras import KerasEstimator, KerasModel
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Input(shape=(2,)), tf.keras.layers.Dense(1)])
+    est = KerasEstimator(
+        model=model,
+        optimizer=tf.keras.optimizers.SGD(0.5),
+        loss="mse",
+        store=Store.create(str(tmp_path)),
+        backend=LocalBackend(num_proc=2),
+        feature_cols=["x0", "x1"],
+        label_cols=["y"],
+        batch_size=32,
+        epochs=8,
+        validation=0.1,
+        verbose=0)
+    df = _regression_df()
+    trained = est.fit(df)
+    assert isinstance(trained, KerasModel)
+    assert len(trained._get("history")["loss"]) == 8
+    out = trained.transform(df)
+    assert "y__output" in out.columns
+    mse = float(np.mean((out["y__output"] - df["y"]) ** 2))
+    assert mse < 0.05, mse
+    # the trained weights should approximate the generating line
+    w, b = trained.keras().get_weights()
+    assert np.allclose(w.ravel(), [2.0, -3.0], atol=0.5), w
+    assert np.allclose(b, [1.0], atol=0.5), b
+
+
+def test_torch_estimator_fit_transform_and_parquet(tmp_path):
+    import torch
+    from horovod_tpu.spark.torch import TorchEstimator, TorchModel
+
+    model = torch.nn.Linear(2, 1)
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.5),
+        loss=torch.nn.functional.mse_loss,
+        store=Store.create(str(tmp_path)),
+        backend=LocalBackend(num_proc=2),
+        feature_cols=["x0", "x1"],
+        label_cols=["y"],
+        input_shapes=[[-1, 2]],
+        batch_size=32,
+        epochs=8,
+        verbose=0)
+    df = _regression_df()
+    trained = est.fit(df)
+    assert isinstance(trained, TorchModel)
+    hist = trained._get("history")["loss"]
+    assert len(hist) == 8 and hist[-1] < hist[0]
+    out = trained.transform(df)
+    mse = float(np.mean((out["y__output"] - df["y"]) ** 2))
+    assert mse < 0.05, mse
+
+    # fit_on_parquet reuses the staged dataset without a DataFrame
+    est2 = TorchEstimator(
+        model=torch.nn.Linear(2, 1),
+        optimizer=None,  # filled below to bind the new model's params
+        loss=torch.nn.functional.mse_loss,
+        store=est.getStore(),
+        backend=LocalBackend(num_proc=2),
+        feature_cols=["x0", "x1"],
+        label_cols=["y"],
+        input_shapes=[[-1, 2]],
+        batch_size=32,
+        epochs=4,
+        verbose=0)
+    est2.setOptimizer(
+        __import__("torch").optim.SGD(est2.getModel().parameters(), lr=0.5))
+    trained2 = est2.fit_on_parquet()
+    out2 = trained2.transform(df)
+    assert float(np.mean((out2["y__output"] - df["y"]) ** 2)) < 0.2

@@ -213,3 +213,121 @@ def test_dynamic_endpoint_negotiation():
         assert info and info["port"] != info["data_port"]
     finally:
         kv.stop()
+
+
+# -- fake elastic Ray --------------------------------------------------------
+
+
+class _FakeElasticRef:
+    def __init__(self, cmd, env):
+        full = dict(os.environ)
+        full.update(env)
+        full.pop("PALLAS_AXON_POOL_IPS", None)
+        self._proc = subprocess.Popen(cmd, env=full,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT)
+
+    def done(self):
+        return self._proc.poll() is not None
+
+
+class _FakeRemoteFn:
+    """Emulates @ray.remote(max_retries=0) def _exec(cmd, env)."""
+
+    def options(self, **_kw):
+        return self
+
+    def remote(self, cmd, env):
+        return _FakeElasticRef(cmd, env)
+
+
+class FakeElasticRay:
+    """The slice of the ray API ElasticRayExecutor consumes, with tasks as
+    real local subprocesses — the driver, generations, KV results, and
+    run_task all execute for real."""
+
+    util = None  # no NodeAffinitySchedulingStrategy: soft pinning skipped
+
+    @staticmethod
+    def remote(*_a, **_kw):
+        # @ray.remote(max_retries=0) form: returns a decorator
+        return lambda _fn: _FakeRemoteFn()
+
+    @staticmethod
+    def nodes():
+        return [{"Alive": True, "NodeManagerAddress": "localhost",
+                 "Resources": {"CPU": 2.0}, "NodeID": "fake-node"}]
+
+    @staticmethod
+    def wait(refs, timeout=0):
+        import time
+        deadline = time.monotonic() + (timeout or 0)
+        while True:
+            ready = [r for r in refs if r.done()]
+            if ready or time.monotonic() >= deadline:
+                return ready, [r for r in refs if not r.done()]
+            time.sleep(0.05)
+
+    @staticmethod
+    def get(ref):
+        return ref._proc.wait()
+
+    @staticmethod
+    def cancel(ref, force=False):
+        if ref._proc.poll() is None:
+            (ref._proc.kill if force else ref._proc.terminate)()
+
+
+def _elastic_train_fn():
+    import numpy as np
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+    hvd.init()
+    total = float(np.asarray(hvd_jax.allreduce(
+        np.asarray([1.0], np.float32), op=hvd_jax.Sum))[0])
+    out = (hvd.rank(), hvd.size(), total)
+    hvd.shutdown()
+    return out
+
+
+def test_elastic_ray_executor():
+    from horovod_tpu.ray import ElasticRayExecutor, RayHostDiscovery
+
+    discovery = RayHostDiscovery(cpus_per_slot=1, ray_module=FakeElasticRay)
+    assert discovery.find_available_hosts_and_slots() == {"localhost": 2}
+
+    settings = ElasticRayExecutor.create_settings(min_np=2, max_np=2)
+    ex = ElasticRayExecutor(settings, override_discovery=discovery,
+                            ray_module=FakeElasticRay).start()
+    results = ex.run(_elastic_train_fn)
+    assert results == [(0, 2, 2.0), (1, 2, 2.0)], results
+
+
+# -- real schedulers (run when installed) ------------------------------------
+
+
+def test_real_pyspark_barrier_run(tmp_path):
+    pyspark = pytest.importorskip("pyspark")
+    import horovod_tpu.spark as hvd_spark
+    spark = pyspark.sql.SparkSession.builder \
+        .master("local[2]").appName("hvdtpu-test").getOrCreate()
+    try:
+        results = hvd_spark.run(_train_fn, args=(10.0,), num_proc=2,
+                                spark_context=spark.sparkContext)
+        assert results == [(r, 2, 30.0, {"seed": 7}) for r in range(2)]
+    finally:
+        spark.stop()
+
+
+def test_real_ray_executor():
+    ray = pytest.importorskip("ray")
+    from horovod_tpu.ray import RayExecutor
+    ray.init(num_cpus=2, include_dashboard=False,
+             ignore_reinit_error=True)
+    try:
+        ex = RayExecutor(num_workers=2, ray_module=ray).start()
+        results = ex.run(_train_fn, args=(2.0,))
+        assert results == [(r, 2, 6.0, {"seed": 7}) for r in range(2)]
+        ex.shutdown()
+    finally:
+        ray.shutdown()
