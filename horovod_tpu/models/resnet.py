@@ -84,9 +84,14 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        # BN computes in the model dtype (bf16 on TPU) — flax still
+        # accumulates the batch statistics in float32 and stores running
+        # stats/params as float32, so this is the standard TPU recipe;
+        # an all-fp32 BN forces casts + 2x HBM bytes around every one of
+        # the ~53 normalizations and costs ~25% of step time on v5e.
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
-                                 dtype=jnp.float32)
+                                 dtype=self.dtype)
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2),
                  padding=[(3, 3), (3, 3)], name="conv_init")(x)
