@@ -2,7 +2,7 @@
 
 The reference implements Adasum as a CPU recursive vector-halving
 distance-doubling (VHDD) exchange with AVX dot-product kernels
-(reference: horovod/common/ops/adasum/adasum.h:160-260, adasum_mpi.cc) and a
+(reference: horovod/common/ops/adasum/adasum.h:160-330, adasum_mpi.cc) and a
 GPU variant that reduce-scatters with NCCL then runs VHDD across nodes
 (adasum_gpu_operations.cc). The math per pair of gradient vectors (a, b):
 
@@ -10,8 +10,25 @@ GPU variant that reduce-scatters with NCCL then runs VHDD across nodes
 
 applied recursively over log2(n) levels with partner = rank XOR 2^level.
 
-On TPU the exchange maps to ``lax.ppermute`` over the ICI mesh; dot products
-are local VPU reductions, so each level costs exactly one neighbor exchange.
+This module uses the same VHDD structure the reference does, mapped to TPU:
+
+- reduce-scatter phase: at level L each rank keeps half of its working
+  segment and trades the other half with partner ``rank ^ L`` via one
+  ``lax.ppermute`` (ICI neighbor traffic). Total exchanged bytes are
+  n/2 + n/4 + ... = O(n) per phase — NOT O(n*log2(world)) as a full-vector
+  distance-doubling would move.
+- the Adasum coefficients need *global* dot products although each rank now
+  holds only a slice. Like the reference's ``reduction_comms`` (adasum.h:
+  FusedPairwiseReduceWithComm summing normAndDots over the level's
+  communicator), each rank computes partial dot/||a||^2/||b||^2 on its slice
+  and the partials are summed over the aligned rank block of size 2L — the
+  slices partition the full vectors exactly, so the sum is the exact global
+  value. The partial matrix is (num_tensors+1, 3) float32 (the extra row is
+  the pad bucket; the reference accumulates in double, which TPUs lack
+  natively), so this rides log2(2L) tiny ppermutes.
+- allgather phase: the halving is unwound with one ppermute per level,
+  reconstructing the identical full result on every rank.
+
 Like the reference, power-of-two world sizes are required
 (reference: horovod/tensorflow/__init__.py:131-133 Adasum size checks).
 """
@@ -23,26 +40,89 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _adasum_combine(a: jax.Array, b: jax.Array) -> jax.Array:
-    """One Adasum pairwise combination in fp32 accumulation.
+def _subgroup_sum(partials: jax.Array, axis: str, level: int,
+                  n: int) -> jax.Array:
+    """Sum ``partials`` over aligned rank blocks of size ``2*level`` via
+    recursive doubling (the TPU analog of the reference's
+    ``reduction_comms[comm_index]`` allreduce, adasum.h:302-305)."""
+    step = 1
+    while step <= level:
+        perm = [(i, i ^ step) for i in range(n)]
+        partials = partials + lax.ppermute(partials, axis, perm)
+        step <<= 1
+    return partials
 
-    Guard: a zero-norm operand contributes coefficient 1.0 (take the other
-    side unchanged), matching reference adasum.h ComputeDotAndNormSqrds
-    consumers."""
-    af = a.astype(jnp.float32).ravel()
-    bf = b.astype(jnp.float32).ravel()
-    dot = jnp.dot(af, bf)
-    anormsq = jnp.dot(af, af)
-    bnormsq = jnp.dot(bf, bf)
-    acoeff = jnp.where(anormsq == 0, 1.0, 1.0 - dot / (2.0 * anormsq))
-    bcoeff = jnp.where(bnormsq == 0, 1.0, 1.0 - dot / (2.0 * bnormsq))
-    out = acoeff * a.astype(jnp.float32) + bcoeff * b.astype(jnp.float32)
-    return out.astype(a.dtype)
+
+def _vhdd_fused(fused: jax.Array, tids: jax.Array, num_tensors: int,
+                axis: str) -> jax.Array:
+    """VHDD Adasum of a fused fp32 vector whose length is a multiple of the
+    axis size. ``tids`` labels each element with its tensor index (the pad
+    bucket is ``num_tensors``) so coefficients stay per-tensor, matching the
+    reference's per-tensor offsets/counts inside the fused buffer
+    (adasum.h DispatchComputeDotAndNormSqrds)."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    seg = fused
+
+    # --- reduce-scatter phase: halve the segment, double the distance.
+    level = 1
+    while level < n:
+        half = seg.shape[0] // 2
+        first, second = seg[:half], seg[half:]
+        t_first, t_second = tids[:half], tids[half:]
+        is_upper = (idx & level) != 0
+        # Lower rank keeps the first half and sends the second; upper keeps
+        # the second and sends the first (adasum.h:242-290). Kept and
+        # received halves cover the same global offsets.
+        send = jnp.where(is_upper, first, second)
+        keep = jnp.where(is_upper, second, first)
+        tids = jnp.where(is_upper, t_second, t_first)
+        perm = [(i, i ^ level) for i in range(n)]
+        recv = lax.ppermute(send, axis, perm)
+        # 'a' is the lower block's vector slice: my own data if I'm in the
+        # lower block at this level, the partner's otherwise.
+        a_h = jnp.where(is_upper, recv, keep)
+        b_h = jnp.where(is_upper, keep, recv)
+        # Partial (dot, ||a||^2, ||b||^2) per tensor on my slice; the block
+        # of 2*level ranks holds a partition of the full vectors, so the
+        # block sum is the exact global value.
+        prods = jnp.stack([a_h * b_h, a_h * a_h, b_h * b_h], axis=-1)
+        part = jax.ops.segment_sum(prods, tids,
+                                   num_segments=num_tensors + 1)
+        tot = _subgroup_sum(part, axis, level, n)
+        d, na, nb = tot[:, 0], tot[:, 1], tot[:, 2]
+        # Zero-norm operand contributes coefficient 1.0 (take the other side
+        # unchanged); also covers the pad bucket, whose values are zero.
+        ac = jnp.where(na == 0, 1.0, 1.0 - d / (2.0 * na))
+        bc = jnp.where(nb == 0, 1.0, 1.0 - d / (2.0 * nb))
+        seg = ac[tids] * a_h + bc[tids] * b_h
+        level <<= 1
+
+    # --- allgather phase: unwind the halving (adasum.h:308-330).
+    level = n >> 1
+    while level >= 1:
+        perm = [(i, i ^ level) for i in range(n)]
+        recv = lax.ppermute(seg, axis, perm)
+        is_upper = (idx & level) != 0
+        lower_half = jnp.where(is_upper, recv, seg)
+        upper_half = jnp.where(is_upper, seg, recv)
+        seg = jnp.concatenate([lower_half, upper_half])
+        level >>= 1
+    return seg
+
+
+def _check_axis(axis: str) -> int:
+    n = lax.axis_size(axis)
+    if n & (n - 1):
+        raise ValueError(
+            f"Adasum requires a power-of-two axis size, got {n} "
+            "(same restriction as the reference)")
+    return n
 
 
 def adasum_allreduce_group(xs, axis: str = "data"):
-    """Adasum a list of tensors with ONE ppermute exchange per level but
-    per-tensor combination coefficients.
+    """Adasum a list of tensors in one fused VHDD pass with per-tensor
+    combination coefficients.
 
     This matches the reference's fused Adasum: the exchange buffer is packed,
     but dot products and norms are computed per tensor so each gradient keeps
@@ -54,65 +134,32 @@ def adasum_allreduce_group(xs, axis: str = "data"):
     xs = list(xs)
     if not xs:
         return []
-    n = lax.axis_size(axis)
-    if n & (n - 1):
-        raise ValueError(
-            f"Adasum requires a power-of-two axis size, got {n} "
-            "(same restriction as the reference)")
-    idx = lax.axis_index(axis)
+    n = _check_axis(axis)
     shapes = [x.shape for x in xs]
     dtypes = [x.dtype for x in xs]
+    if n == 1:
+        return xs
     sizes = [int(jnp.size(x)) for x in xs]
     offsets = [0]
     for s in sizes:
         offsets.append(offsets[-1] + s)
-    fused = jnp.concatenate([x.astype(jnp.float32).ravel() for x in xs])
-
-    level = 1
-    while level < n:
-        perm = [(i, i ^ level) for i in range(n)]
-        other = lax.ppermute(fused, axis, perm)
-        is_lower = (idx & level) == 0
-        a = jnp.where(is_lower, fused, other)
-        b = jnp.where(is_lower, other, fused)
-        pieces = []
-        for t in range(len(xs)):
-            at = a[offsets[t]:offsets[t + 1]]
-            bt = b[offsets[t]:offsets[t + 1]]
-            dot = jnp.dot(at, bt)
-            na = jnp.dot(at, at)
-            nb = jnp.dot(bt, bt)
-            ac = jnp.where(na == 0, 1.0, 1.0 - dot / (2.0 * na))
-            bc = jnp.where(nb == 0, 1.0, 1.0 - dot / (2.0 * nb))
-            pieces.append(ac * at + bc * bt)
-        fused = jnp.concatenate(pieces)
-        level <<= 1
-    return [fused[offsets[t]:offsets[t + 1]].reshape(shapes[t])
+    total = offsets[-1]
+    padded = -(-total // n) * n
+    fused = jnp.concatenate(
+        [x.astype(jnp.float32).ravel() for x in xs]
+        + ([jnp.zeros((padded - total,), jnp.float32)]
+           if padded > total else []))
+    tids = jnp.concatenate(
+        [jnp.full((s,), t, jnp.int32) for t, s in enumerate(sizes)]
+        + ([jnp.full((padded - total,), len(xs), jnp.int32)]
+           if padded > total else []))
+    out = _vhdd_fused(fused, tids, len(xs), axis)
+    return [out[offsets[t]:offsets[t + 1]].reshape(shapes[t])
             .astype(dtypes[t]) for t in range(len(xs))]
 
 
 def adasum_allreduce(x: jax.Array, axis: str = "data") -> jax.Array:
-    """Recursive distance-doubling Adasum across the named axis.
-
-    Each level exchanges the full working vector with partner ``rank ^ 2^l``
-    via a single ppermute (ICI neighbor traffic), then combines with the
-    canonical ordering (lower rank's vector is ``a``) so every rank computes
-    bit-identical results.
-    """
-    n = lax.axis_size(axis)
-    if n & (n - 1):
-        raise ValueError(
-            f"Adasum requires a power-of-two axis size, got {n} "
-            "(same restriction as the reference)")
-    idx = lax.axis_index(axis)
-    my = x
-    level = 1
-    while level < n:
-        perm = [(i, i ^ level) for i in range(n)]
-        other = lax.ppermute(my, axis, perm)
-        is_lower = (idx & level) == 0
-        a = jnp.where(is_lower, my, other)
-        b = jnp.where(is_lower, other, my)
-        my = _adasum_combine(a, b)
-        level <<= 1
-    return my
+    """VHDD Adasum of one tensor across the named axis. Every rank computes
+    bit-identical results (the canonical ordering puts the lower block's
+    vector as ``a`` at every level)."""
+    return adasum_allreduce_group([x], axis)[0]
