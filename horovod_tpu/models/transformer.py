@@ -14,19 +14,56 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from horovod_tpu.ops.flash_attention import flash_attention
+
+
+class FlashSelfAttention(nn.Module):
+    """Self-attention whose core is the Pallas flash kernel
+    (ops/flash_attention.py): same q/k/v/out projection geometry as
+    ``nn.MultiHeadDotProductAttention``, but the [T, T] score matrix never
+    touches HBM. Bidirectional (BERT) by default; set ``causal`` for
+    decoder use."""
+
+    heads: int
+    dtype: Any = jnp.bfloat16
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        d = x.shape[-1]
+        if d % self.heads:
+            raise ValueError(f"hidden dim {d} must be divisible by "
+                             f"heads ({self.heads})")
+        head_dim = d // self.heads
+        proj = dict(features=(self.heads, head_dim), dtype=self.dtype)
+        q = nn.DenseGeneral(name="query", **proj)(x)
+        k = nn.DenseGeneral(name="key", **proj)(x)
+        v = nn.DenseGeneral(name="value", **proj)(x)
+        o = flash_attention(q, k, v, causal=self.causal)
+        return nn.DenseGeneral(features=d, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(o)
+
 
 class EncoderBlock(nn.Module):
     hidden: int
     heads: int
     mlp_dim: int
     dtype: Any = jnp.bfloat16
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads, dtype=self.dtype,
-            deterministic=deterministic)(h, h, mask=mask)
+        if self.use_flash:
+            if mask is not None:
+                raise ValueError("use_flash supports mask=None (full "
+                                 "bidirectional) or causal only")
+            h = FlashSelfAttention(heads=self.heads, dtype=self.dtype)(
+                h, deterministic=deterministic)
+        else:
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=self.heads, dtype=self.dtype,
+                deterministic=deterministic)(h, h, mask=mask)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
@@ -45,6 +82,7 @@ class BertEncoder(nn.Module):
     mlp_dim: int = 3072
     max_len: int = 512
     dtype: Any = jnp.bfloat16
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True):
@@ -56,7 +94,8 @@ class BertEncoder(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype)(x)
         for _ in range(self.layers):
             x = EncoderBlock(self.hidden, self.heads, self.mlp_dim,
-                             self.dtype)(x, deterministic=deterministic)
+                             self.dtype, use_flash=self.use_flash)(
+                                 x, deterministic=deterministic)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # LM head tied to the input embedding (BERT geometry)
         logits = embed.attend(x)

@@ -64,7 +64,8 @@ def _block(q, k, v, mask, sm_scale):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis: str = "seq", causal: bool = False,
-                   sm_scale: Optional[float] = None) -> jax.Array:
+                   sm_scale: Optional[float] = None,
+                   use_flash: bool = False) -> jax.Array:
     """Exact attention over a sequence sharded across ``axis``.
 
     Each of the n ring steps attends this rank's query shard to one K/V
@@ -76,11 +77,45 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kv blocks entirely in the future contribute nothing (their rows mask
     to -inf and the merge is a no-op) — simple, compiler-friendly control
     flow rather than skipping steps.
+
+    ``use_flash=True`` runs each within-shard block through the Pallas
+    flash-attention kernel (ops/flash_attention.py) with global position
+    offsets, merging per-step (o, lse) partials — the [T_loc, T_loc] score
+    tile then never exists in HBM either, and fully-future blocks cost zero
+    MXU work (the kernel's traced k-loop bound excludes them).
     """
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     t_loc = q.shape[1]
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+
+    if use_flash:
+        from horovod_tpu.ops import flash_attention as fa
+
+        q_off = (idx * t_loc).astype(jnp.float32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def local(src, k_cur, v_cur):
+            return fa.flash_attention(
+                q, k_cur, v_cur, causal=causal, sm_scale=scale,
+                q_offset=q_off, k_offset=(src * t_loc).astype(jnp.float32),
+                return_lse=True)
+
+        def step(s, carry):
+            o, lse, k_cur, v_cur = carry
+            k_cur = collectives.ppermute(k_cur, perm, axis)
+            v_cur = collectives.ppermute(v_cur, perm, axis)
+            o_i, lse_i = local((idx - s) % n, k_cur, v_cur)
+            o, lse = fa.merge_attention(o, lse, o_i, lse_i)
+            return o, lse, k_cur, v_cur
+
+        o, lse = local(idx, k, v)
+        # fp32 accumulator across merges (like the non-flash path): a
+        # per-step cast to bf16 would compound rounding n-1 times
+        o, lse, _, _ = lax.fori_loop(1, n, step,
+                                     (o.astype(jnp.float32), lse, k, v),
+                                     unroll=True)
+        return o.astype(q.dtype)
 
     o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
     m = jnp.full(q.shape[:1] + (q.shape[2], t_loc), -jnp.inf, jnp.float32)
@@ -118,10 +153,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis: str = "seq", causal: bool = False,
-                      sm_scale: Optional[float] = None) -> jax.Array:
+                      sm_scale: Optional[float] = None,
+                      use_flash: bool = False) -> jax.Array:
     """DeepSpeed-Ulysses-style SP: all-to-all from sequence-sharded to
     head-sharded, exact local attention over the full sequence, all-to-all
-    back. Heads must divide the axis size."""
+    back. Heads must divide the axis size. ``use_flash=True`` runs the
+    local full-sequence attention through the Pallas kernel."""
     n = lax.axis_size(axis)
     h = q.shape[2]
     if h % n != 0:
@@ -138,6 +175,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     t = qh.shape[1]
+    if use_flash:
+        from horovod_tpu.ops import flash_attention as fa
+        out = fa.flash_attention(qh, kh, vh, causal=causal, sm_scale=scale)
+        return to_seq(out.astype(q.dtype))
     mask = None
     if causal:
         pos = jnp.arange(t)

@@ -33,6 +33,113 @@ def test_flash_matches_dense(causal, blocks):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
+def dense_jax(q, k, v, causal, t=None):
+    t = t if t is not None else T
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_dense(causal):
+    """The custom-VJP backward kernels (dq, dk/dv) match autodiff through
+    the dense formulation (reference parity: training usability of the
+    flagship kernel)."""
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.randn(2, 128, 2, 32), jnp.float32)
+               for _ in range(3))
+    dout = jnp.asarray(rng.randn(2, 128, 2, 32), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       interpret=True, block_q=64,
+                                       block_k=64) * dout)
+
+    def loss_dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(32)
+        if causal:
+            mask = jnp.tril(jnp.ones((128, 128), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd",
+                                  jax.nn.softmax(s, -1), v) * dout)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_lse_value_and_gradient():
+    """return_lse gives log-sum-exp rows, and the lse output itself is
+    differentiable (needed by ring-attention merges)."""
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.randn(2, 64, 2, 32), jnp.float32)
+               for _ in range(3))
+    _, lse = flash_attention(q, k, v, interpret=True, return_lse=True)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(32)
+    want = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+    wl = jnp.asarray(rng.randn(2, 2, 64), jnp.float32)
+    g1 = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, interpret=True, return_lse=True)[1] * wl),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(jax.scipy.special.logsumexp(
+        jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(32), axis=-1) * wl),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_global_offsets_shift_causal_mask():
+    """q_offset/k_offset move the causal mask to global coordinates — the
+    contract ring attention relies on for sequence-sharded blocks."""
+    rng = np.random.RandomState(4)
+    k, v = (jnp.asarray(rng.randn(2, 128, 2, 32), jnp.float32)
+            for _ in range(2))
+    q = jnp.asarray(rng.randn(2, 64, 2, 32), jnp.float32)
+    got = flash_attention(q, k, v, causal=True, interpret=True,
+                          q_offset=64.0, k_offset=0.0)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(32)
+    qp = 64 + jnp.arange(64)[:, None]
+    kp = jnp.arange(128)[None, :]
+    s = jnp.where((qp >= kp)[None, None], s, -1e30)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+    # a block entirely in the future produces lse=-inf and zero output,
+    # making downstream merges a no-op
+    o, lse = flash_attention(q, k, v, causal=True, interpret=True,
+                             q_offset=-1000.0, return_lse=True)
+    assert np.all(np.asarray(lse) < -1e29)
+    np.testing.assert_array_equal(np.asarray(o), 0)
+
+
+def test_merge_attention_combines_disjoint_key_sets():
+    """merge_attention(o1, lse1, o2, lse2) over a key split equals attention
+    over the full key set."""
+    from horovod_tpu.ops.flash_attention import merge_attention
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(2, 32, 2, 16), jnp.float32)
+    k, v = (jnp.asarray(rng.randn(2, 128, 2, 16), jnp.float32)
+            for _ in range(2))
+    o1, l1 = flash_attention(q, k[:, :64], v[:, :64], interpret=True,
+                             return_lse=True)
+    o2, l2 = flash_attention(q, k[:, 64:], v[:, 64:], interpret=True,
+                             return_lse=True)
+    got, _ = merge_attention(o1, l1, o2, l2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(16)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_flash_bf16_runs():
     rng = np.random.RandomState(1)
     q = jnp.asarray(rng.randn(1, 256, 2, 64), jnp.bfloat16)
