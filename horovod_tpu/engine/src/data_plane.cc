@@ -363,38 +363,84 @@ Status DataPlane::Allreduce(void* buffer, int64_t num_elements,
   return Status::OK();
 }
 
-Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
-                             std::string* out,
-                             std::vector<int64_t>* rank_bytes) {
-  std::string mine(static_cast<const char*>(in), in_bytes);
-  std::vector<std::string> all;
-  auto st = transport_->Gather(mine, transport_->rank() == 0 ? &all
-                                                             : nullptr);
+Status DataPlane::ExchangeInt64(int64_t mine, std::vector<int64_t>* all) {
+  const int size = transport_->size();
+  std::string m(reinterpret_cast<const char*>(&mine), sizeof(mine));
+  std::vector<std::string> gathered;
+  auto st = transport_->Gather(m, transport_->rank() == 0 ? &gathered
+                                                          : nullptr);
   if (!st.ok()) return st;
   std::string packed;
   if (transport_->rank() == 0) {
-    // [u32 count][i64 sizes...][data...]
-    uint32_t count = static_cast<uint32_t>(all.size());
-    packed.append(reinterpret_cast<const char*>(&count), sizeof(count));
-    for (auto& p : all) {
-      int64_t sz = static_cast<int64_t>(p.size());
-      packed.append(reinterpret_cast<const char*>(&sz), sizeof(sz));
+    for (auto& p : gathered) packed.append(p);
+  }
+  st = transport_->Bcast(&packed);
+  if (!st.ok()) return st;
+  if (packed.size() != static_cast<size_t>(size) * sizeof(int64_t)) {
+    return Status::Unknown("int64 exchange size mismatch");
+  }
+  all->resize(size);
+  std::memcpy(all->data(), packed.data(), packed.size());
+  return Status::OK();
+}
+
+Status DataPlane::RingAllgatherv(const void* in,
+                                 const std::vector<int64_t>& sizes,
+                                 std::string* out) {
+  const int size = transport_->size();
+  const int rank = transport_->rank();
+  // Rotate blobs around the ring: step s sends the blob received at step
+  // s-1 (starting with our own), so every blob travels each link exactly
+  // once — per-link traffic is O(total bytes), with no rank-0 relay.
+  std::vector<std::string> blobs(size);
+  blobs[rank].assign(static_cast<const char*>(in), sizes[rank]);
+  for (int s = 0; s < size - 1; ++s) {
+    const int send_r = ((rank - s) % size + size) % size;
+    const int recv_r = ((rank - s - 1) % size + size) % size;
+    std::string incoming;
+    auto st = transport_->RingExchange(blobs[send_r].data(),
+                                       blobs[send_r].size(), &incoming);
+    if (!st.ok()) return st;
+    if (static_cast<int64_t>(incoming.size()) != sizes[recv_r]) {
+      return Status::Unknown("ring allgatherv blob size mismatch");
     }
+    blobs[recv_r] = std::move(incoming);
+  }
+  int64_t total = 0;
+  for (auto s : sizes) total += s;
+  out->clear();
+  out->reserve(total);
+  for (int r = 0; r < size; ++r) out->append(blobs[r]);
+  ++ring_ops_;
+  return Status::OK();
+}
+
+Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
+                             std::string* out,
+                             std::vector<int64_t>* rank_bytes) {
+  const int size = transport_->size();
+  // Per-rank sizes ride the star first (8 bytes each): every rank needs
+  // them for the output layout, and all ranks must take the same
+  // star-or-ring branch.
+  auto st = ExchangeInt64(in_bytes, rank_bytes);
+  if (!st.ok()) return st;
+  int64_t total = 0;
+  for (auto s : *rank_bytes) total += s;
+  if (size > 1 && total >= ring_threshold_) {
+    return RingAllgatherv(in, *rank_bytes, out);
+  }
+  std::string mine(static_cast<const char*>(in), in_bytes);
+  std::vector<std::string> all;
+  st = transport_->Gather(mine, transport_->rank() == 0 ? &all : nullptr);
+  if (!st.ok()) return st;
+  std::string packed;
+  if (transport_->rank() == 0) {
+    packed.reserve(total);
     for (auto& p : all) packed.append(p);
   }
   st = transport_->Bcast(&packed);
   if (!st.ok()) return st;
-  uint32_t count = 0;
-  std::memcpy(&count, packed.data(), sizeof(count));
-  rank_bytes->resize(count);
-  size_t off = sizeof(count);
-  int64_t total = 0;
-  for (uint32_t r = 0; r < count; ++r) {
-    std::memcpy(&(*rank_bytes)[r], packed.data() + off, sizeof(int64_t));
-    off += sizeof(int64_t);
-    total += (*rank_bytes)[r];
-  }
-  out->assign(packed.data() + off, total);
+  *out = std::move(packed);
   return Status::OK();
 }
 
@@ -431,12 +477,123 @@ Status DataPlane::Bcast(void* buffer, int64_t nbytes, int32_t root) {
   return Status::OK();
 }
 
+Status DataPlane::RingAlltoallv(const void* in,
+                                const std::vector<int64_t>& send_bytes,
+                                std::string* out,
+                                std::vector<int64_t>* recv_bytes) {
+  const int size = transport_->size();
+  const int rank = transport_->rank();
+  const char* src_data = static_cast<const char*>(in);
+  // Entry-relay bundle: every chunk is tagged (src, dst) and rides the
+  // ring until its destination extracts it — chunk (s -> d) travels
+  // (d - s) mod size hops, so per-link traffic averages total/2 with no
+  // rank-0 funnel. All ranks run exactly size-1 lockstep exchanges
+  // (possibly with empty bundles), so the ring cannot skew.
+  struct Entry {
+    int32_t src;
+    int32_t dst;
+    std::string data;
+  };
+  std::vector<std::string> received(size);
+  std::vector<Entry> bundle;
+  int64_t off = 0;
+  for (int d = 0; d < size; ++d) {
+    if (d == rank) {
+      received[rank].assign(src_data + off, send_bytes[d]);
+    } else {
+      bundle.push_back({rank, d, std::string(src_data + off, send_bytes[d])});
+    }
+    off += send_bytes[d];
+  }
+
+  auto serialize = [](const std::vector<Entry>& es) {
+    std::string wire;
+    uint32_t count = static_cast<uint32_t>(es.size());
+    wire.append(reinterpret_cast<const char*>(&count), sizeof(count));
+    for (const auto& e : es) {
+      int64_t len = static_cast<int64_t>(e.data.size());
+      wire.append(reinterpret_cast<const char*>(&e.src), sizeof(e.src));
+      wire.append(reinterpret_cast<const char*>(&e.dst), sizeof(e.dst));
+      wire.append(reinterpret_cast<const char*>(&len), sizeof(len));
+    }
+    for (const auto& e : es) wire.append(e.data);
+    return wire;
+  };
+
+  for (int s = 0; s < size - 1; ++s) {
+    std::string outgoing = serialize(bundle);
+    std::string incoming;
+    auto st = transport_->RingExchange(outgoing.data(), outgoing.size(),
+                                       &incoming);
+    if (!st.ok()) return st;
+    uint32_t count = 0;
+    if (incoming.size() < sizeof(count)) {
+      return Status::Unknown("ring alltoallv truncated bundle");
+    }
+    std::memcpy(&count, incoming.data(), sizeof(count));
+    size_t hdr = sizeof(count);
+    size_t data_off = hdr + count * (2 * sizeof(int32_t) + sizeof(int64_t));
+    if (incoming.size() < data_off) {
+      return Status::Unknown("ring alltoallv truncated bundle header");
+    }
+    bundle.clear();
+    for (uint32_t i = 0; i < count; ++i) {
+      Entry e;
+      int64_t len = 0;
+      std::memcpy(&e.src, incoming.data() + hdr, sizeof(e.src));
+      hdr += sizeof(e.src);
+      std::memcpy(&e.dst, incoming.data() + hdr, sizeof(e.dst));
+      hdr += sizeof(e.dst);
+      std::memcpy(&len, incoming.data() + hdr, sizeof(len));
+      hdr += sizeof(len);
+      if (e.src < 0 || e.src >= size || e.dst < 0 || e.dst >= size ||
+          len < 0 ||
+          data_off + static_cast<size_t>(len) > incoming.size()) {
+        return Status::Unknown("ring alltoallv corrupt entry");
+      }
+      e.data.assign(incoming.data() + data_off, len);
+      data_off += len;
+      if (e.dst == rank) {
+        received[e.src] = std::move(e.data);
+      } else {
+        bundle.push_back(std::move(e));
+      }
+    }
+  }
+  if (!bundle.empty()) {
+    return Status::Unknown("ring alltoallv left undelivered chunks");
+  }
+  recv_bytes->resize(size);
+  int64_t total = 0;
+  for (int r = 0; r < size; ++r) {
+    (*recv_bytes)[r] = static_cast<int64_t>(received[r].size());
+    total += (*recv_bytes)[r];
+  }
+  out->clear();
+  out->reserve(total);
+  for (int r = 0; r < size; ++r) out->append(received[r]);
+  ++ring_ops_;
+  return Status::OK();
+}
+
 Status DataPlane::Alltoallv(const void* in,
                             const std::vector<int64_t>& send_bytes,
                             std::string* out,
                             std::vector<int64_t>* recv_bytes) {
   const int size = transport_->size();
   const int rank = transport_->rank();
+  // Uniform star-or-ring decision on the global total (per-rank totals
+  // ride the star first — 8 bytes each).
+  int64_t my_total = 0;
+  for (int64_t sz : send_bytes) my_total += sz;
+  std::vector<int64_t> totals;
+  auto status = ExchangeInt64(my_total, &totals);
+  if (!status.ok()) return status;
+  int64_t grand = 0;
+  for (auto t : totals) grand += t;
+  if (size > 1 && grand >= ring_threshold_) {
+    return RingAlltoallv(in, send_bytes, out, recv_bytes);
+  }
   // Pack [i64 sizes...][data] and gather at root; root reshuffles and
   // scatters each rank its incoming chunks in source-rank order.
   std::string mine;

@@ -65,10 +65,21 @@ class DataPlane {
  private:
   // O(bytes)-per-rank ring algorithms for payloads >= ring_threshold_:
   // reduce-scatter + allgather around the ring (allreduce), pipelined
-  // chunk relay (bcast).
+  // chunk relay (bcast), blob rotation (allgatherv), and an entry-relay
+  // bundle (alltoallv). No rank ever relays O(world * bytes) through one
+  // link (reference analog: gloo ring ops, ops/gloo_operations.cc).
   Status RingAllreduce(void* buffer, int64_t num_elements, DataType dtype,
                        ReduceKind kind);
   Status RingBcast(void* buffer, int64_t nbytes, int32_t root);
+  Status RingAllgatherv(const void* in, const std::vector<int64_t>& sizes,
+                        std::string* out);
+  Status RingAlltoallv(const void* in,
+                       const std::vector<int64_t>& send_bytes,
+                       std::string* out, std::vector<int64_t>* recv_bytes);
+  // Per-rank int64 exchange over the star (8 bytes/rank): gives every rank
+  // the full vector so star-vs-ring decisions are uniform (a split
+  // decision would deadlock the transports).
+  Status ExchangeInt64(int64_t mine, std::vector<int64_t>* all);
 
   std::shared_ptr<ControllerTransport> transport_;
   int64_t ring_threshold_;
