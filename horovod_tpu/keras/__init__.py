@@ -10,3 +10,50 @@ from horovod_tpu.tensorflow import (  # noqa: F401
     metric_average, rank, shutdown, size,
 )
 from horovod_tpu.keras import callbacks  # noqa: F401
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none, **distopt_kwargs):
+    """Load a saved Keras model with its optimizer re-wrapped in a
+    DistributedOptimizer, so saved optimizer state (iterations, slot
+    variables) is picked up for continued distributed training
+    (reference: keras/__init__.py:147-181 + _keras/__init__.py:165-181).
+
+    All built-in ``keras.optimizers`` classes are remapped automatically;
+    pass ``custom_optimizers`` (a list of Optimizer subclasses) for your
+    own, or ``custom_objects`` for any other custom layers/classes.
+    Extra ``distopt_kwargs`` (op=, backward_passes_per_step=, ...) flow to
+    DistributedOptimizer.
+    """
+    import keras
+
+    def wrap(cls):
+        # A dynamic subclass whose from_config returns the wrapped
+        # optimizer: keras deserializes into it, then loads the saved
+        # optimizer variables into the wrapped instance.
+        def from_config(klass, config, custom_objects=None):
+            del klass, custom_objects
+            base = cls.from_config(config)
+            return DistributedOptimizer(base, compression=compression,
+                                        **distopt_kwargs)
+
+        return type(cls.__name__, (cls,),
+                    {"from_config": classmethod(from_config)})
+
+    base_cls = keras.optimizers.Optimizer
+    horovod_objects = {}
+    for name in dir(keras.optimizers):
+        cls = getattr(keras.optimizers, name)
+        if (isinstance(cls, type) and issubclass(cls, base_cls)
+                and cls is not base_cls):
+            wrapped = wrap(cls)
+            horovod_objects[cls.__name__] = wrapped
+            # legacy h5 saves used lowercase class names (reference:
+            # _keras/__init__.py:167)
+            horovod_objects[cls.__name__.lower()] = wrapped
+    if custom_optimizers is not None:
+        horovod_objects.update(
+            {cls.__name__: wrap(cls) for cls in custom_optimizers})
+    if custom_objects is not None:
+        horovod_objects.update(custom_objects)
+    return keras.models.load_model(filepath, custom_objects=horovod_objects)

@@ -14,3 +14,4 @@ from horovod_tpu.tensorflow import (  # noqa: F401
     metric_average, rank, shutdown, size,
 )
 from horovod_tpu.keras import callbacks  # noqa: F401
+from horovod_tpu.keras import load_model  # noqa: F401

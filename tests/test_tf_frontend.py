@@ -316,3 +316,57 @@ def test_tf_function_bpps_and_sparse(tmp_path):
         assert np.allclose(out.numpy(), np.arange(size, dtype=np.float32)), \\
             out.numpy()
     """, size=2)
+
+
+def test_keras_load_model_resumes_distributed(tmp_path):
+    """save -> hvd.keras.load_model -> continue training across 2
+    processes: the saved optimizer (incl. iteration count and momentum
+    slots) comes back wrapped in DistributedOptimizer (reference:
+    keras/__init__.py:147-181)."""
+    _run_workers(tmp_path, """
+        import horovod_tpu.keras as hvd_keras
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(4, activation="relu",
+                                   input_shape=(3,)),
+             tf.keras.layers.Dense(1)])
+        opt = hvd_keras.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.05, momentum=0.9))
+        model.compile(optimizer=opt, loss="mse")
+        hvd_keras.broadcast_variables(model.weights, root_rank=0)
+
+        rs = np.random.RandomState(7)
+        x = rs.rand(16, 3).astype("float32")
+        y = rs.rand(16, 1).astype("float32")
+        model.fit(x, y, epochs=1, batch_size=8, verbose=0)
+        iters_before = int(model.optimizer.iterations.numpy())
+        assert iters_before > 0
+
+        import tempfile
+        path = os.path.join(
+            tempfile.gettempdir(),
+            f"m{rank}_{os.environ['HOROVOD_CONTROLLER_PORT']}.keras")
+        model.save(path)
+
+        loaded = hvd_keras.load_model(path)
+        os.unlink(path)
+        # the restored optimizer is distributed (our wrapper attribute)
+        assert hasattr(loaded.optimizer, "_hvd_state"), \
+            type(loaded.optimizer)
+        # iteration count survived the round trip
+        assert int(loaded.optimizer.iterations.numpy()) == iters_before
+        # weights identical across ranks and to the saved model
+        for a, b in zip(model.get_weights(), loaded.get_weights()):
+            assert np.allclose(a, b)
+
+        # continue training: gradients are combined across ranks — all
+        # ranks end with identical weights even on different data
+        x2 = rs.rand(8, 3).astype("float32") + rank
+        l0 = float(loaded.evaluate(x, y, verbose=0))
+        loaded.fit(x2, y[:8], epochs=2, batch_size=8, verbose=0)
+        w = loaded.get_weights()[0]
+        digest = hvd_keras.allgather(
+            tf.constant(w.ravel()[None, :4])).numpy()
+        for r in range(1, size):
+            assert np.allclose(digest[r], digest[0], atol=1e-6), digest
+    """, size=2)
