@@ -20,8 +20,16 @@ import time
 from typing import List, Optional
 
 from horovod_tpu.runner import hosts as hosts_lib
-from horovod_tpu.runner.exec_utils import WorkerProcess
+from horovod_tpu.runner.exec_utils import WorkerProcess, is_local
 from horovod_tpu.runner.http_kv import KVServer
+
+# ssh reachability results are cached here and trusted for this long
+# (reference: launch.py CACHE_FOLDER + CACHE_STALENESS_THRESHOLD_MINUTES)
+SSH_CACHE_FILE = os.path.join(os.path.expanduser("~"), ".horovod_tpu",
+                              "ssh_reachability.json")
+SSH_CACHE_STALENESS_S = 60 * 60
+SSH_ATTEMPTS = 3
+SSH_CONNECT_TIMEOUT_S = 10
 
 
 def free_port() -> int:
@@ -46,6 +54,170 @@ def free_ports(n: int) -> List[int]:
             s.close()
 
 
+def check_build(verbose: bool = False) -> str:
+    """Summarize what this installation can do — frameworks, controllers,
+    and TPU features (reference: launch.py:110-146 check_build; the
+    controller/ops sections are re-interpreted for the TPU stack)."""
+    import importlib.util as iu
+
+    def have(mod):
+        try:
+            return iu.find_spec(mod) is not None
+        except (ImportError, ValueError):
+            return False
+
+    try:
+        from horovod_tpu.engine import bindings
+        bindings.load_library()
+        engine_ok = True
+    except Exception:  # noqa: BLE001 — any load failure means "not built"
+        engine_ok = False
+    try:
+        from horovod_tpu import __version__ as version
+    except ImportError:
+        version = "dev"
+
+    def mark(v):
+        return "X" if v else " "
+
+    lines = [
+        f"horovod_tpu v{version}:",
+        "",
+        "Available Frameworks:",
+        f"    [{mark(have('jax'))}] JAX",
+        f"    [{mark(have('tensorflow'))}] TensorFlow",
+        f"    [{mark(have('torch'))}] PyTorch",
+        f"    [{mark(have('keras'))}] Keras",
+        "",
+        "Available Controllers:",
+        f"    [{mark(engine_ok)}] native engine (TCP / loopback)",
+        "",
+        "Available Tensor Operations:",
+        f"    [{mark(have('jax'))}] XLA collectives (ICI/DCN)",
+        f"    [{mark(engine_ok)}] host data plane (ring + star)",
+        f"    [{mark(have('jax'))}] Pallas flash attention",
+        "",
+        "Available Integrations:",
+        f"    [{mark(have('pyspark'))}] Spark",
+        f"    [{mark(have('ray'))}] Ray",
+    ]
+    out = "\n".join(lines)
+    if verbose and not engine_ok:
+        out += ("\n\nnative engine unavailable: build it with "
+                "`make -C horovod_tpu/engine`")
+    return out
+
+
+# YAML --config-file sections -> argparse dest names (reference schema:
+# runner/common/util/config_parser.py set_args_from_config)
+_CONFIG_SCHEMA = {
+    "params": {
+        "fusion_threshold_mb": "fusion_threshold_mb",
+        "cycle_time_ms": "cycle_time_ms",
+        "cache_capacity": "cache_capacity",
+        "hierarchical_allreduce": "hierarchical_allreduce",
+    },
+    "autotune": {
+        "enabled": "autotune",
+        "log_file": "autotune_log",
+        "warmup_samples": "autotune_warmup_samples",
+        "steps_per_sample": "autotune_steps",
+        "sample_cycles": "autotune_sample_cycles",
+    },
+    "timeline": {
+        "filename": "timeline_filename",
+        "mark_cycles": "timeline_mark_cycles",
+    },
+    "stall_check": {
+        "warning_time_seconds": "stall_check_time_seconds",
+        "shutdown_time_seconds": "stall_shutdown_time_seconds",
+    },
+}
+
+
+def apply_config_file(parser: argparse.ArgumentParser, path: str) -> None:
+    """Fold a YAML config into the parser's defaults, so explicit CLI flags
+    win over the file and the file wins over built-in defaults (reference:
+    launch.py:293,513-517; the reference's position-relative override order
+    is simplified to CLI-beats-config)."""
+    import yaml
+
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+    defaults = {}
+    for section, mapping in _CONFIG_SCHEMA.items():
+        values = config.get(section) or {}
+        for key, dest in mapping.items():
+            if key in values and values[key] is not None:
+                defaults[dest] = values[key]
+    stall = config.get("stall_check") or {}
+    if "enabled" in stall:
+        defaults["no_stall_check"] = not stall["enabled"]
+    parser.set_defaults(**defaults)
+
+
+def _load_ssh_cache() -> dict:
+    import json
+    try:
+        with open(SSH_CACHE_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _store_ssh_cache(cache: dict) -> None:
+    import json
+    try:
+        os.makedirs(os.path.dirname(SSH_CACHE_FILE), exist_ok=True)
+        with open(SSH_CACHE_FILE, "w") as f:
+            json.dump(cache, f)
+    except OSError:
+        pass  # cache is an optimization; never fail the launch over it
+
+
+def check_hosts_ssh(hostnames, ssh_port=None) -> List[str]:
+    """Return the subset of remote hosts that are NOT ssh-reachable.
+    Successes are cached for SSH_CACHE_STALENESS_S so repeated launches
+    skip the probe (reference: launch.py:57-107
+    _check_all_hosts_ssh_successful + cache.use_cache)."""
+    import subprocess
+    remote = [h for h in hostnames if not is_local(h)]
+    if not remote:
+        return []
+    cache = _load_ssh_cache()
+    now = time.time()
+    bad = []
+    for host in sorted(set(remote)):
+        key = f"{host}:{ssh_port or 22}"
+        if now - cache.get(key, 0) < SSH_CACHE_STALENESS_S:
+            continue
+        # BatchMode + closed stdin: a host behind password/interactive auth
+        # must fail the probe immediately, not hang on a prompt
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+               "-o", "BatchMode=yes",
+               "-o", f"ConnectTimeout={SSH_CONNECT_TIMEOUT_S}"]
+        if ssh_port:
+            cmd += ["-p", str(ssh_port)]
+        cmd += [host, "true"]
+        ok = False
+        for _ in range(SSH_ATTEMPTS):
+            try:
+                if subprocess.run(cmd, capture_output=True,
+                                  stdin=subprocess.DEVNULL,
+                                  timeout=SSH_CONNECT_TIMEOUT_S + 5
+                                  ).returncode == 0:
+                    ok = True
+                    break
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+        if ok:
+            cache[key] = now  # only successes are cached, like the reference
+        else:
+            bad.append(host)
+    _store_ssh_cache(cache)
+    return bad
+
+
 def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdrun-tpu",
@@ -55,6 +227,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("-H", "--hosts", default=None,
                    help='host slots, e.g. "localhost:4,host2:4"')
     p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print available frameworks/controllers/features "
+                        "and exit")
+    p.add_argument("--config-file", default=None,
+                   help="YAML runtime config; explicit CLI flags override "
+                        "it, it overrides built-in defaults")
     # elastic (reference: launch.py elastic group)
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
@@ -200,6 +378,13 @@ def run_static(args, liveness_check=None, kv=None) -> int:
     np_ = args.num_proc or sum(h.slots for h in host_list)
     slots = hosts_lib.get_host_assignments(host_list, np_)
 
+    bad = check_hosts_ssh({s.hostname for s in slots},
+                          getattr(args, "ssh_port", None))
+    if bad:
+        sys.stderr.write(
+            f"[launcher] hosts not ssh-reachable: {', '.join(bad)}\n")
+        return 1
+
     controller_addr = slots[0].hostname if slots[0].hostname != "localhost" \
         else "127.0.0.1"
     controller_port, data_port = free_ports(2)
@@ -285,7 +470,16 @@ def run_elastic(args) -> int:
 
 
 def run_commandline(argv: Optional[List[str]] = None) -> int:
-    args = make_parser().parse_args(argv)
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    if args.check_build:
+        print(check_build(args.verbose))
+        return 0
+    if args.config_file:
+        # re-parse with the file folded into defaults: CLI flags win over
+        # the file, the file wins over built-in defaults
+        apply_config_file(parser, args.config_file)
+        args = parser.parse_args(argv)
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
     if not args.command:

@@ -209,3 +209,96 @@ def test_programmatic_run_with_subset_comm():
 
     results = horovod_tpu.run(fn, np=3)
     assert sorted(results) == [(0, 1, 1.0), (0, 2, 2.0), (1, 2, 2.0)], results
+
+
+def test_check_build_reports_capabilities(capsys):
+    """--check-build prints the availability matrix and exits 0
+    (reference: launch.py:110-146,255)."""
+    rc = run_commandline(["--check-build"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "[X] native engine" in out
+
+
+def test_config_file_defaults_and_cli_precedence(tmp_path):
+    """YAML --config-file fills defaults; explicit CLI flags beat the file
+    (reference: launch.py:293,513-517 + config_parser schema)."""
+    from horovod_tpu.runner.launch import apply_config_file
+
+    cfg = tmp_path / "hvd.yaml"
+    cfg.write_text(textwrap.dedent("""
+        params:
+          fusion_threshold_mb: 32
+          cycle_time_ms: 7.5
+          hierarchical_allreduce: true
+        autotune:
+          enabled: true
+          log_file: /tmp/at.csv
+        timeline:
+          filename: /tmp/tl.json
+          mark_cycles: true
+        stall_check:
+          enabled: false
+          warning_time_seconds: 42
+    """))
+    parser = make_parser()
+    apply_config_file(parser, str(cfg))
+    # config fills in unset args...
+    args = parser.parse_args(["-np", "2", "cmd"])
+    assert args.fusion_threshold_mb == 32
+    assert args.cycle_time_ms == 7.5
+    assert args.hierarchical_allreduce is True
+    assert args.autotune is True
+    assert args.autotune_log == "/tmp/at.csv"
+    assert args.timeline_filename == "/tmp/tl.json"
+    assert args.timeline_mark_cycles is True
+    assert args.no_stall_check is True
+    assert args.stall_check_time_seconds == 42
+    # ...but explicit CLI flags win over the file
+    args = parser.parse_args(["-np", "2", "--fusion-threshold-mb", "64",
+                              "cmd"])
+    assert args.fusion_threshold_mb == 64
+
+
+def test_ssh_reachability_local_and_cache(tmp_path, monkeypatch):
+    """Local hostnames skip the probe; successes are cached with a
+    staleness window (reference: launch.py:57-107 + cache.use_cache)."""
+    from horovod_tpu.runner import launch as launch_lib
+
+    monkeypatch.setattr(launch_lib, "SSH_CACHE_FILE",
+                        str(tmp_path / "cache.json"))
+    assert launch_lib.check_hosts_ssh(["localhost", "127.0.0.1"]) == []
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        class R:
+            returncode = 0
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert launch_lib.check_hosts_ssh(["fakehost-a"]) == []
+    assert len(calls) == 1
+    # second call hits the cache — no new probe
+    assert launch_lib.check_hosts_ssh(["fakehost-a"]) == []
+    assert len(calls) == 1
+
+
+def test_ssh_unreachable_host_fails_launch(tmp_path, monkeypatch):
+    from horovod_tpu.runner import launch as launch_lib
+
+    monkeypatch.setattr(launch_lib, "SSH_CACHE_FILE",
+                        str(tmp_path / "cache.json"))
+
+    def fake_run(cmd, **kw):
+        class R:
+            returncode = 255
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(launch_lib, "SSH_ATTEMPTS", 1)
+    bad = launch_lib.check_hosts_ssh(["no-such-host-xyz"])
+    assert bad == ["no-such-host-xyz"]
