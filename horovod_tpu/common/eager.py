@@ -147,20 +147,32 @@ class EagerExecutor:
             return buf
 
         if t == "ALLREDUCE":
+            # nested timeline phases inside the EXEC span, on the first
+            # tensor's lane (reference: MEMCPY_IN_FUSION_BUFFER /
+            # COMMUNICATE / MEMCPY_OUT_FUSION_BUFFER activities,
+            # common/timeline.h:102-154)
+            mark = self.session.timeline_activity_start
+            mark_end = self.session.timeline_activity_end
             bufs = [np.ascontiguousarray(staged(i)) for i in range(len(names))]
             groups = {}
             for i, b in enumerate(bufs):
                 groups.setdefault(b.dtype, []).append(i)
             for dtype, idxs in groups.items():
+                lane = names[idxs[0]]
+                mark(lane, "MEMCPY_IN_FUSION_BUFFER")
                 fused = np.concatenate([bufs[i].ravel() for i in idxs]) \
                     if len(idxs) > 1 else bufs[idxs[0]].ravel().copy()
                 fused = np.ascontiguousarray(fused)
+                mark_end(lane)
+                mark(lane, "COMMUNICATE_ALLREDUCE")
                 rc = self.lib.hvdtpu_data_allreduce(
                     sess, fused.ctypes.data, fused.size,
                     _engine_dtype(dtype), resp["reduce_op"],
                     resp["prescale"], resp["postscale"])
+                mark_end(lane)
                 if rc != 0:
                     return rc
+                mark(lane, "MEMCPY_OUT_FUSION_BUFFER")
                 off = 0
                 for i in idxs:
                     n = bufs[i].size
@@ -168,14 +180,18 @@ class EagerExecutor:
                         self._results[names[i]] = \
                             fused[off:off + n].reshape(bufs[i].shape)
                     off += n
+                mark_end(lane)
             return 0
 
         if t == "ALLGATHER":
             buf = np.ascontiguousarray(staged(0))
             import ctypes
             rank_bytes = (ctypes.c_int64 * self.session.size)()
+            self.session.timeline_activity_start(names[0],
+                                                 "COMMUNICATE_ALLGATHER")
             total = self.lib.hvdtpu_data_allgatherv(
                 sess, buf.ctypes.data, buf.nbytes, rank_bytes)
+            self.session.timeline_activity_end(names[0])
             if total < 0:
                 return 1
             out = np.empty(total, np.uint8)
@@ -194,8 +210,11 @@ class EagerExecutor:
 
         if t == "BROADCAST":
             buf = np.ascontiguousarray(staged(0)).copy()
+            self.session.timeline_activity_start(names[0],
+                                                 "COMMUNICATE_BROADCAST")
             rc = self.lib.hvdtpu_data_bcast(sess, buf.ctypes.data, buf.nbytes,
                                             resp["root_rank"])
+            self.session.timeline_activity_end(names[0])
             if rc != 0:
                 return rc
             with self._lock:
@@ -220,8 +239,11 @@ class EagerExecutor:
             send_bytes = (ctypes.c_int64 * size)(
                 *[s * row_bytes for s in splits])
             recv_bytes = (ctypes.c_int64 * size)()
+            self.session.timeline_activity_start(names[0],
+                                                 "COMMUNICATE_ALLTOALL")
             total = self.lib.hvdtpu_data_alltoallv(
                 sess, buf.ctypes.data, send_bytes, size, recv_bytes)
+            self.session.timeline_activity_end(names[0])
             if total < 0:
                 return 1
             out = np.empty(total, np.uint8)

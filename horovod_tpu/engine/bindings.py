@@ -137,6 +137,12 @@ def load_library():
                                               ctypes.c_char_p,
                                               ctypes.c_int32]
         lib.hvdtpu_stop_timeline.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_timeline_activity_start.restype = ctypes.c_int32
+        lib.hvdtpu_timeline_activity_start.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p]
+        lib.hvdtpu_timeline_activity_end.restype = ctypes.c_int32
+        lib.hvdtpu_timeline_activity_end.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p]
         lib.hvdtpu_last_error.restype = ctypes.c_char_p
         # data plane (callback-thread only)
         lib.hvdtpu_data_allreduce.restype = ctypes.c_int32
@@ -357,3 +363,12 @@ class EngineSession:
 
     def stop_timeline(self):
         self._lib.hvdtpu_stop_timeline(self._session)
+
+    def timeline_activity_start(self, name: str, activity: str):
+        """Open a nested activity span on the tensor's timeline lane
+        (no-op unless a timeline is active)."""
+        self._lib.hvdtpu_timeline_activity_start(
+            self._session, name.encode(), activity.encode())
+
+    def timeline_activity_end(self, name: str):
+        self._lib.hvdtpu_timeline_activity_end(self._session, name.encode())

@@ -261,6 +261,8 @@ int32_t hvdtpu_start_timeline(int64_t session, const char* path,
                               int32_t mark_cycles) {
   Engine* e = GetSession(session);
   if (!e) return -1;
+  // Coordinator-only (see Engine::Initialize): all ranks share the path.
+  if (e->rank() != 0) return 0;
   e->timeline().Initialize(path, mark_cycles != 0);
   return 0;
 }
@@ -269,6 +271,24 @@ int32_t hvdtpu_stop_timeline(int64_t session) {
   Engine* e = GetSession(session);
   if (!e) return -1;
   e->timeline().Shutdown();
+  return 0;
+}
+
+// Frontend-phase markers nested inside the EXEC span (reference:
+// timeline.h:102-154 — MEMCPY_IN_FUSION_BUFFER / COMMUNICATE /
+// MEMCPY_OUT_FUSION_BUFFER ride the same per-tensor lane).
+int32_t hvdtpu_timeline_activity_start(int64_t session, const char* name,
+                                       const char* activity) {
+  Engine* e = GetSession(session);
+  if (!e || name == nullptr || activity == nullptr) return -1;
+  e->timeline().ActivityStart(name, activity);
+  return 0;
+}
+
+int32_t hvdtpu_timeline_activity_end(int64_t session, const char* name) {
+  Engine* e = GetSession(session);
+  if (!e || name == nullptr) return -1;
+  e->timeline().ActivityEnd(name);
   return 0;
 }
 

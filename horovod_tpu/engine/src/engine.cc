@@ -108,7 +108,10 @@ Status Engine::Init() {
     return Status::InvalidArgument("unknown transport: " + tcfg_.kind);
   }
   data_plane_ = std::make_unique<DataPlane>(data_transport);
-  if (!opts_.timeline_path.empty()) {
+  // Coordinator-only, like the reference: every worker gets the same
+  // HOROVOD_TIMELINE path, and concurrent writers would interleave
+  // corrupt JSON into one file.
+  if (!opts_.timeline_path.empty() && rank_ == 0) {
     timeline_.Initialize(opts_.timeline_path, opts_.timeline_mark_cycles);
   }
   controller_ = std::make_unique<Controller>(transport_, opts_, &timeline_);
@@ -142,8 +145,14 @@ Status Engine::EnqueueTensor(TensorTableEntry entry, int64_t* handle) {
   msg.group_id = entry.group_id;
   msg.group_size = entry.group_size;
 
+  // QUEUE phase: enqueue -> popped into a negotiation cycle (reference:
+  // timeline.h:102-154 per-activity states). Started BEFORE the message
+  // becomes visible in the queue — the cycle thread emits this lane's
+  // next event (the QUEUE end) only after it can pop the message.
+  timeline_.ActivityStart(msg.tensor_name, "QUEUE");
   auto st = queue_.AddToTensorQueue(entry, msg);
   if (!st.ok()) {
+    timeline_.ActivityEnd(msg.tensor_name);
     handles_.MarkDone(*handle, st.reason);
     return st;
   }
@@ -251,8 +260,17 @@ void Engine::PerformOperation(const Response& response) {
   // callbacks. Data execution is delegated to the frontend.
   std::string err = response.error_message;
   int32_t rc = 0;
-  if (response.type != Response::Type::ERROR) {
+  if (response.type == Response::Type::ERROR) {
+    // close the NEGOTIATE spans of locally-enqueued tensors — an error
+    // response must not leave dangling 'B' events on their lanes
     for (const auto& name : response.tensor_names) {
+      if (queue_.HasEntry(name)) timeline_.ActivityEnd(name);
+    }
+  } else {
+    for (const auto& name : response.tensor_names) {
+      if (queue_.HasEntry(name)) {  // locally enqueued (not a joined rank)
+        timeline_.ActivityEnd(name);  // close this rank's NEGOTIATE span
+      }
       timeline_.ActivityStart(name,
                               std::string("EXEC_") +
                                   ResponseTypeName(response.type));
@@ -303,6 +321,11 @@ void Engine::BackgroundLoopImpl() {
 
     Controller::CycleInput in;
     queue_.PopMessagesFromQueue(&in.messages);
+    for (const auto& msg : in.messages) {
+      // QUEUE -> NEGOTIATE: the request enters this cycle's negotiation
+      timeline_.ActivityEnd(msg.tensor_name);
+      timeline_.ActivityStart(msg.tensor_name, "NEGOTIATE");
+    }
     in.shutdown_requested = shutdown_requested_.load();
     in.join_requested = join_pending_.load();
 

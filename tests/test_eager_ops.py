@@ -301,3 +301,26 @@ def test_join_returns_last_joined_rank(ring):
 
     outs = run_all(ring, fn)
     assert outs == [1] * N
+
+
+def test_timeline_marks_frontend_phases(ring, tmp_path):
+    """The eager executor marks MEMCPY_IN/COMMUNICATE/MEMCPY_OUT inside
+    the EXEC span (reference: timeline.h:102-154 activity states)."""
+    import json
+    import time as time_mod
+
+    path = str(tmp_path / "tl.json")
+    ring[0].session.start_timeline(path)
+
+    def work(r, ex):
+        return submit_wait(ex, "tl.phases", _OP_ALLREDUCE,
+                           np.ones(8, np.float32), reduce_op=Sum)
+
+    run_all(ring, work)
+    time_mod.sleep(0.2)
+    ring[0].session.stop_timeline()
+    events = json.load(open(path))
+    names = [e.get("name", "") for e in events]
+    assert "MEMCPY_IN_FUSION_BUFFER" in names, names
+    assert "COMMUNICATE_ALLREDUCE" in names, names
+    assert "MEMCPY_OUT_FUSION_BUFFER" in names, names

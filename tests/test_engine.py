@@ -249,8 +249,23 @@ def test_timeline_writes_chrome_trace(tmp_path):
         time.sleep(0.1)
         sessions[0].stop_timeline()
         events = json.load(open(path))
-        assert any(e.get("name", "").startswith("NEGOTIATE_") for e in events)
-        assert any(e.get("name", "").startswith("EXEC_") for e in events)
+        names = [e.get("name", "") for e in events]
+        # per-activity lifecycle on the tensor's lane (reference:
+        # common/timeline.h:102-154 states): QUEUE -> NEGOTIATE ->
+        # coordinator NEGOTIATE_<op> -> EXEC_<type>
+        assert "QUEUE" in names
+        assert "NEGOTIATE" in names
+        assert any(n.startswith("NEGOTIATE_") for n in names)
+        assert any(n.startswith("EXEC_") for n in names)
+        # B/E events pair up on every lane (Chrome trace nesting is LIFO)
+        depth = {}
+        for e in events:
+            lane = e.get("tid")
+            if e.get("ph") == "B":
+                depth[lane] = depth.get(lane, 0) + 1
+            elif e.get("ph") == "E":
+                depth[lane] = depth.get(lane, 0) - 1
+                assert depth[lane] >= 0, events
     finally:
         destroy_all(sessions)
 
