@@ -1,0 +1,70 @@
+"""Long-context attention over a sequence-sharded mesh.
+
+The reference scales batch, never sequence (SURVEY §5.7); this example
+shows the TPU-native extension: a sequence sharded across a ``seq`` mesh
+axis, attended exactly with ring attention — the K/V shards rotate around
+the ICI ring while an online-softmax accumulator keeps the result equal to
+full softmax(QK^T)V — with the Pallas flash kernel as the within-shard
+block (``use_flash``), so no [T, T] score tile ever exists in HBM.
+
+Runs on however many devices are visible (virtual CPU mesh works:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``).
+
+Run: ``python examples/jax/jax_long_context_attention.py --seq-len 4096``
+"""
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import mesh as mesh_lib
+from horovod_tpu.parallel.sp import ring_attention
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=4096)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--use-flash", action="store_true",
+                   help="Pallas flash kernel for the within-shard block")
+    args = p.parse_args()
+
+    n = len(jax.devices())
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(data=1, seq=n))
+    t = args.seq_len
+    if t % n:
+        raise SystemExit(f"--seq-len {t} must divide the {n}-way seq axis")
+
+    rng = np.random.RandomState(0)
+    shape = (1, t, args.heads, args.head_dim)
+    q, k, v = (jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+               for _ in range(3))
+
+    attend = jax.jit(jax.shard_map(
+        functools.partial(ring_attention, causal=True,
+                          use_flash=args.use_flash),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+
+    out = attend(q, k, v)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = attend(q, k, v)
+    mean = float(jnp.mean(jnp.abs(out.astype(jnp.float32))))  # forces sync
+    dt = time.perf_counter() - t0
+    print(f"ring attention over {n} seq shards: T={t} "
+          f"({t // n} per shard), flash={args.use_flash}, "
+          f"{dt * 1e3:.1f} ms/step, mean|out|={mean:.4f}")
+    assert np.isfinite(mean)
+    print("done: long-context attention OK")
+
+
+if __name__ == "__main__":
+    main()

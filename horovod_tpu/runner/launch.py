@@ -140,7 +140,11 @@ def apply_config_file(parser: argparse.ArgumentParser, path: str) -> None:
     win over the file and the file wins over built-in defaults (reference:
     launch.py:293,513-517; the reference's position-relative override order
     is simplified to CLI-beats-config)."""
-    import yaml
+    try:
+        import yaml
+    except ImportError as e:
+        raise SystemExit(
+            "--config-file requires pyyaml (pip install pyyaml)") from e
 
     with open(path) as f:
         config = yaml.safe_load(f) or {}
@@ -181,16 +185,14 @@ def check_hosts_ssh(hostnames, ssh_port=None) -> List[str]:
     skip the probe (reference: launch.py:57-107
     _check_all_hosts_ssh_successful + cache.use_cache)."""
     import subprocess
+    from concurrent.futures import ThreadPoolExecutor
     remote = [h for h in hostnames if not is_local(h)]
     if not remote:
         return []
     cache = _load_ssh_cache()
     now = time.time()
-    bad = []
-    for host in sorted(set(remote)):
-        key = f"{host}:{ssh_port or 22}"
-        if now - cache.get(key, 0) < SSH_CACHE_STALENESS_S:
-            continue
+
+    def probe(host) -> bool:
         # BatchMode + closed stdin: a host behind password/interactive auth
         # must fail the probe immediately, not hang on a prompt
         cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
@@ -199,21 +201,32 @@ def check_hosts_ssh(hostnames, ssh_port=None) -> List[str]:
         if ssh_port:
             cmd += ["-p", str(ssh_port)]
         cmd += [host, "true"]
-        ok = False
         for _ in range(SSH_ATTEMPTS):
             try:
                 if subprocess.run(cmd, capture_output=True,
                                   stdin=subprocess.DEVNULL,
                                   timeout=SSH_CONNECT_TIMEOUT_S + 5
                                   ).returncode == 0:
-                    ok = True
-                    break
+                    return True
             except (subprocess.TimeoutExpired, OSError):
                 pass
-        if ok:
-            cache[key] = now  # only successes are cached, like the reference
-        else:
-            bad.append(host)
+        return False
+
+    to_probe = [h for h in sorted(set(remote))
+                if now - cache.get(f"{h}:{ssh_port or 22}", 0)
+                >= SSH_CACHE_STALENESS_S]
+    bad = []
+    if to_probe:
+        # concurrent probes: a fleet with several dead hosts must fail in
+        # one probe-timeout, not one per host (reference: launch.py:93-95
+        # execute_function_multithreaded)
+        with ThreadPoolExecutor(max_workers=min(32, len(to_probe))) as ex:
+            for host, ok in zip(to_probe, ex.map(probe, to_probe)):
+                if ok:
+                    # only successes are cached, like the reference
+                    cache[f"{host}:{ssh_port or 22}"] = now
+                else:
+                    bad.append(host)
     _store_ssh_cache(cache)
     return bad
 

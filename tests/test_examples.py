@@ -48,3 +48,20 @@ def test_tf_keras_mnist_example():
     text = _run_example("examples/tensorflow/tensorflow2_keras_mnist.py", 2,
                         ("--epochs", "2", "--batch-size", "16"))
     assert "final averaged loss" in text, text
+
+
+@pytest.mark.parametrize("flash", [False, True], ids=["jax", "flash"])
+def test_long_context_attention_example(flash):
+    """Sequence-sharded ring attention example runs on the virtual mesh
+    (SURVEY §5.7: the long-context strategy the reference lacks)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable,
+           os.path.join(REPO, "examples/jax/jax_long_context_attention.py"),
+           "--seq-len", "1024"] + (["--use-flash"] if flash else [])
+    out = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                         timeout=420)
+    text = out.stdout.decode() + out.stderr.decode()
+    assert out.returncode == 0, text
+    assert "done: long-context attention OK" in text, text
