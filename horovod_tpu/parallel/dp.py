@@ -99,7 +99,8 @@ def make_train_step(loss_fn: Callable,
                     postscale_factor: float = 1.0,
                     axes: Tuple[str, ...] = DP_AXES,
                     hierarchical: Optional[bool] = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True,
+                    remat: bool = False) -> Callable:
     """Build a jitted data-parallel train step.
 
     ``loss_fn(params, batch, rng) -> (loss, aux)`` computes the local loss on
@@ -112,8 +113,15 @@ def make_train_step(loss_fn: Callable,
     averaged (the cross-replica sync the reference provides via
     SyncBatchNormalization, horovod/torch/sync_batch_norm.py), integer leaves
     are summed (counts), everything else passes through.
+
+    ``remat=True`` wraps the loss in ``jax.checkpoint``: the backward pass
+    recomputes activations instead of keeping them in HBM — the standard
+    TPU trade of FLOPs for memory when a model's activations don't fit.
+    Gradients are bit-identical; only peak memory and step time change.
     """
     axes = tuple(a for a in axes if a in mesh.shape)
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
     # Accept both spellings of "no compression": None and the reference-style
     # Compression.none pass-through class.
     from horovod_tpu.jax.compression import Compression
@@ -168,7 +176,8 @@ def make_stateful_train_step(loss_fn: Callable,
                              postscale_factor: float = 1.0,
                              axes: Tuple[str, ...] = DP_AXES,
                              hierarchical: Optional[bool] = None,
-                             donate: bool = True) -> Callable:
+                             donate: bool = True,
+                             remat: bool = False) -> Callable:
     """Train step for models with non-gradient state (BatchNorm running
     statistics etc.).
 
@@ -177,9 +186,13 @@ def make_stateful_train_step(loss_fn: Callable,
     model_state, batch, rng) -> StatefulTrainStepOutput``. Floating leaves of
     ``new_model_state`` are averaged across replicas — the cross-replica
     statistics sync the reference provides via SyncBatchNormalization
-    (reference: horovod/torch/sync_batch_norm.py).
+    (reference: horovod/torch/sync_batch_norm.py). ``remat=True`` trades
+    FLOPs for activation memory via ``jax.checkpoint`` (see
+    :func:`make_train_step`).
     """
     axes = tuple(a for a in axes if a in mesh.shape)
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
     from horovod_tpu.jax.compression import Compression
     if compression is Compression.none:
         compression = None

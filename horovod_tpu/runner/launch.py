@@ -140,11 +140,7 @@ def apply_config_file(parser: argparse.ArgumentParser, path: str) -> None:
     win over the file and the file wins over built-in defaults (reference:
     launch.py:293,513-517; the reference's position-relative override order
     is simplified to CLI-beats-config)."""
-    try:
-        import yaml
-    except ImportError as e:
-        raise SystemExit(
-            "--config-file requires pyyaml (pip install pyyaml)") from e
+    import yaml  # declared dependency (pyproject.toml)
 
     with open(path) as f:
         config = yaml.safe_load(f) or {}

@@ -260,3 +260,29 @@ def test_stateful_train_step_threads_batch_stats(dp_mesh):
         lambda a, bb: not np.allclose(a, bb), prev, cur)
     assert any(jax.tree_util.tree_leaves(moved)), "batch stats never updated"
     assert np.isfinite(float(out.loss))
+
+
+def test_remat_step_matches_plain(dp_mesh, mnist_setup):
+    """remat=True (jax.checkpoint: recompute activations in backward) gives
+    the same params/loss as the plain step — only memory/FLOPs differ."""
+    model, params = mnist_setup
+    loss_fn = _loss_fn_factory(model)
+    opt = optax.sgd(0.1)
+    batch = _make_batch(32)
+    rng = jax.random.key(3)
+
+    def run(remat):
+        step = dp.make_train_step(loss_fn, opt, dp_mesh, donate=False,
+                                  remat=remat)
+        return step(dp.replicate(params, dp_mesh),
+                    dp.replicate(opt.init(params), dp_mesh),
+                    dp.shard_batch(batch, dp_mesh), rng)
+
+    plain = run(False)
+    remat = run(True)
+    np.testing.assert_allclose(float(remat.loss), float(plain.loss),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(plain.params),
+                    jax.tree_util.tree_leaves(remat.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
