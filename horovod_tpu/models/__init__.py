@@ -1,4 +1,9 @@
 from horovod_tpu.models.mnist import MnistConvNet  # noqa: F401
+from horovod_tpu.models.gpt import (  # noqa: F401
+    GptDecoder,
+    GptMedium,
+    GptSmall,
+)
 from horovod_tpu.models.transformer import (  # noqa: F401
     BertBase,
     BertEncoder,

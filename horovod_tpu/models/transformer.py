@@ -45,11 +45,15 @@ class FlashSelfAttention(nn.Module):
 
 
 class EncoderBlock(nn.Module):
+    """Pre-LN transformer block; ``causal=True`` makes it a decoder block
+    (the GPT family reuses it with that flag)."""
+
     hidden: int
     heads: int
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     use_flash: bool = False
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -58,9 +62,14 @@ class EncoderBlock(nn.Module):
             if mask is not None:
                 raise ValueError("use_flash supports mask=None (full "
                                  "bidirectional) or causal only")
-            h = FlashSelfAttention(heads=self.heads, dtype=self.dtype)(
-                h, deterministic=deterministic)
+            h = FlashSelfAttention(heads=self.heads, dtype=self.dtype,
+                                   causal=self.causal)(
+                                       h, deterministic=deterministic)
         else:
+            if self.causal:
+                if mask is not None:
+                    raise ValueError("causal=True builds its own mask")
+                mask = nn.make_causal_mask(jnp.ones((1, x.shape[1])))
             h = nn.MultiHeadDotProductAttention(
                 num_heads=self.heads, dtype=self.dtype,
                 deterministic=deterministic)(h, h, mask=mask)

@@ -1,0 +1,108 @@
+"""Expert parallelism: a GShard-style Mixture-of-Experts layer over the
+``expert`` mesh axis.
+
+The reference has no MoE — SURVEY §2.8 records EP as ABSENT, with its
+alltoall primitive (operations.cc:1101-1162) named as the building block an
+expert-parallel layer needs. This module is that layer, TPU-first:
+
+- **top-1 capacity routing** with static shapes: each token picks its
+  highest-gate expert; a cumulative-sum position assigns it a slot in that
+  expert's fixed-capacity buffer. Tokens past capacity are dropped (their
+  combine weight is zero), which keeps every shape static — the XLA
+  contract — exactly as GShard/Switch do on TPU.
+- **alltoall dispatch**: the [experts, capacity, d] buffers exchange over
+  the ``expert`` axis with one ``lax.all_to_all`` each way, riding ICI.
+- **expert-sharded parameters**: each rank holds ``E_total / n_ep`` expert
+  MLPs; gate weights are replicated.
+
+Shapes (inside shard_map): tokens ``[T_local, d]``; w_gate ``[d, E_total]``
+(replicated); w_in ``[E_local, d, hidden]``, w_out ``[E_local, hidden, d]``
+(sharded over ``expert``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel import collectives
+
+
+def top1_dispatch(gates: jax.Array, capacity: int
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Build dispatch/combine tensors for top-1 routing.
+
+    gates: [T, E] softmax router probabilities. Returns
+    (dispatch [T, E, C] one-hot, combine [T, E, C] = dispatch * gate_prob).
+    Token t goes to expert argmax(gates[t]) at slot ``position-in-expert``;
+    tokens whose slot >= capacity are dropped (all-zero rows).
+    """
+    t, e = gates.shape
+    expert_idx = jnp.argmax(gates, axis=-1)  # [T]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [T, E]
+    # 0-based position of each token within its expert's arrival order
+    # (cumsum counts the token itself, so subtract the onehot back out)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [T, E]
+    slot = jnp.sum(pos, axis=-1)  # [T]
+    keep = slot < capacity
+    dispatch = (jax.nn.one_hot(expert_idx, e)[:, :, None] *
+                jax.nn.one_hot(jnp.where(keep, slot, capacity), capacity + 1,
+                               dtype=gates.dtype)[:, None, :capacity])
+    prob = jnp.max(gates, axis=-1)  # [T]
+    combine = dispatch * prob[:, None, None]
+    return dispatch, combine
+
+
+def moe_layer(x: jax.Array, w_gate: jax.Array, w_in: jax.Array,
+              w_out: jax.Array, axis: str = "expert",
+              capacity_factor: float = 1.25,
+              activation=jax.nn.gelu) -> jax.Array:
+    """One expert-parallel MoE feed-forward layer (call under shard_map).
+
+    x: [T_local, d]; w_gate: [d, E_total] replicated; w_in/w_out:
+    [E_local, d, h] / [E_local, h, d] sharded over ``axis``. Returns
+    [T_local, d] — each token's output is its top-1 expert's MLP output
+    scaled by the gate probability (dropped tokens produce zeros, as in
+    GShard/Switch).
+    """
+    n_ep = lax.axis_size(axis)
+    t_loc, d = x.shape
+    e_loc = w_in.shape[0]
+    e_total = n_ep * e_loc
+    if w_gate.shape[-1] != e_total:
+        raise ValueError(
+            f"w_gate routes to {w_gate.shape[-1]} experts but the mesh "
+            f"provides {n_ep} ranks x {e_loc} local = {e_total}")
+    # per (source rank, expert) capacity
+    capacity = max(1, int(capacity_factor * t_loc / e_total))
+
+    xf = x.astype(jnp.float32)
+    gates = jax.nn.softmax(xf @ w_gate.astype(jnp.float32), axis=-1)
+    dispatch, combine = top1_dispatch(gates, capacity)  # [T, E, C]
+
+    # gather tokens into expert buffers: [E_total, C, d]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xf)
+    # exchange over the expert axis: split the expert dim across ranks,
+    # concat the arrivals — each rank ends with its local experts' tokens
+    # from every source rank: [n_ep * E_local, C, d] -> regroup to
+    # [E_local, n_ep * C, d]
+    expert_in = collectives.alltoall(expert_in, axis)
+    expert_in = expert_in.reshape(n_ep, e_loc, capacity, d) \
+        .transpose(1, 0, 2, 3).reshape(e_loc, n_ep * capacity, d)
+
+    # local expert MLPs (batched einsum over the expert dim — one big MXU
+    # matmul per projection, no Python loop)
+    h = jnp.einsum("esd,edh->esh", expert_in, w_in.astype(jnp.float32))
+    h = activation(h)
+    expert_out = jnp.einsum("esh,ehd->esd", h, w_out.astype(jnp.float32))
+
+    # reverse exchange: back to [E_total, C, d] on the source ranks
+    expert_out = expert_out.reshape(e_loc, n_ep, capacity, d) \
+        .transpose(1, 0, 2, 3).reshape(e_total, capacity, d)
+    expert_out = collectives.alltoall(expert_out, axis)
+
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.astype(x.dtype)

@@ -4,6 +4,7 @@ examples/pytorch/pytorch_mnist.py and pytorch_imagenet_resnet50.py)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from horovod_tpu.models import MnistConvNet, ResNet18, ResNet50
 
@@ -131,3 +132,55 @@ def test_bert_trains_under_dp_step(dp_mesh):
         p, s = out.params, out.opt_state
         losses.append(float(out.loss))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+@pytest.mark.parametrize("use_flash", [False, True], ids=["dot", "flash"])
+def test_gpt_decoder_is_causal(use_flash):
+    """A future-token perturbation must not change earlier positions'
+    logits — both attention paths enforce causality."""
+    from horovod_tpu.models import GptDecoder
+
+    model = GptDecoder(vocab=97, layers=2, hidden=32, heads=4, mlp_dim=64,
+                       max_len=16, dtype=jnp.float32, use_flash=use_flash)
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, 97, (2, 16)))
+    variables = model.init(jax.random.key(0), tokens)
+    base = model.apply(variables, tokens)
+    perturbed = tokens.at[:, -1].set((tokens[:, -1] + 1) % 97)
+    out = model.apply(variables, perturbed)
+    np.testing.assert_allclose(np.asarray(out[:, :-1]),
+                               np.asarray(base[:, :-1]), rtol=1e-5,
+                               atol=1e-6)
+    assert not np.allclose(np.asarray(out[:, -1]), np.asarray(base[:, -1]))
+
+
+def test_gpt_trains_under_dp_step(dp_mesh):
+    import optax
+    from horovod_tpu.models import GptDecoder
+    from horovod_tpu.parallel import dp
+
+    model = GptDecoder(vocab=97, layers=2, hidden=32, heads=4, mlp_dim=64,
+                       max_len=16, dtype=jnp.float32, use_flash=True)
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, 97, (8, 16)))
+    params = model.init(jax.random.key(0), tokens)["params"]
+    opt = optax.adamw(3e-3)
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["tokens"])
+        # next-token prediction: shift by one
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], batch["tokens"][:, 1:]).mean()
+        return loss, {}
+
+    step = dp.make_train_step(loss_fn, opt, dp_mesh, donate=False)
+    batch = {"tokens": dp.shard_batch(
+        jnp.asarray(rs.randint(0, 97, (16, 16))), dp_mesh)}
+    p = dp.replicate(params, dp_mesh)
+    s = dp.replicate(opt.init(params), dp_mesh)
+    losses = []
+    for i in range(6):
+        out = step(p, s, batch, jax.random.key(i))
+        p, s = out.params, out.opt_state
+        losses.append(float(out.loss))
+    assert losses[-1] < losses[0], losses
