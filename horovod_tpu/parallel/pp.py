@@ -1,0 +1,90 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution over the
+``pipe`` mesh axis.
+
+The reference has no PP (SURVEY §2.8: ABSENT). The TPU formulation keeps
+everything inside one compiled SPMD program: every rank holds one stage's
+parameters; activations travel stage→stage with ``lax.ppermute`` (ICI
+neighbor traffic); a ``lax.scan`` over ``n_micro + n_stages - 1`` ticks
+drives the classic pipeline schedule (rank s computes micro ``t - s`` at
+tick ``t``, bubbles at the edges), so XLA sees static shapes and a single
+loop — no per-microbatch dispatch.
+
+Collective-only design: no sends of parameters, no host round trips;
+reverse-mode differentiation of the scan gives the backward pipeline for
+free (activations rematerialize per-stage under ``jax.checkpoint`` if the
+caller wraps ``stage_fn``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.tp import reduce_from_tp
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
+                   n_micro: int, axis: str = "pipe") -> jax.Array:
+    """Run a ``n_stages``-deep pipeline over the ``axis`` mesh dimension.
+
+    ``stage_fn(stage_params, h) -> h`` is this rank's stage (all stages
+    must preserve the activation shape and dtype — pad or project
+    outside).
+    ``x`` is the FULL input batch (replicated view), split into ``n_micro``
+    equal microbatches on dim 0. Returns the full output batch, valid on
+    the LAST stage (other ranks return the same shape; use the last
+    stage's slice or psum-select outside).
+
+    Schedule: at tick t, stage s computes microbatch ``t - s`` (when in
+    range) on the activation received from stage ``s-1`` at tick's start;
+    stage 0 feeds microbatch t from ``x``. After ``n_micro + n_stages - 1``
+    ticks every microbatch has left the last stage; outputs are collected
+    on the last stage as they complete.
+    """
+    n_stages = lax.axis_size(axis)
+    s = lax.axis_index(axis)
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} must divide into n_micro={n_micro}")
+    mb = b // n_micro
+    # activations stay in the caller's dtype (bf16 rides ICI at half the
+    # bytes); stage_fn owns any accumulation-precision choices
+    micros = x.reshape(n_micro, mb, *x.shape[1:])
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # stage 0 injects microbatch t (garbage after the last micro;
+        # masked out by the validity window below)
+        feed_idx = jnp.clip(t, 0, n_micro - 1)
+        h_in = jnp.where(s == 0, micros[feed_idx], incoming)
+        h_out = stage_fn(stage_params, h_in)
+        # stage s works on microbatch t - s; valid while 0 <= t-s < n_micro
+        micro_idx = t - s
+        valid = (micro_idx >= 0) & (micro_idx < n_micro)
+        h_out = jnp.where(valid, h_out, jnp.zeros_like(h_out))
+        # the last stage banks its finished microbatch
+        is_last = s == n_stages - 1
+        bank_idx = jnp.clip(micro_idx, 0, n_micro - 1)
+        outputs = jnp.where(valid & is_last,
+                            outputs.at[bank_idx].set(h_out), outputs)
+        # everyone forwards to the next stage (ring; last->0 is ignored)
+        incoming = lax.ppermute(h_out, axis, perm)
+        return (incoming, outputs), None
+
+    outputs0 = jnp.zeros_like(micros)
+    incoming0 = jnp.zeros_like(micros[0])
+    (_, outputs), _ = lax.scan(
+        tick, (incoming0, outputs0),
+        jnp.arange(n_micro + n_stages - 1))
+    # replicate the last stage's banked outputs to every rank so callers
+    # can use the result uniformly (loss on the last stage, or anywhere).
+    # reduce_from_tp: identity backward — the cotangent is replicated, and
+    # the where-mask routes it to the last stage's pipeline.
+    outputs = reduce_from_tp(
+        jnp.where(s == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis)
+    return outputs.reshape(b, *x.shape[1:])
