@@ -1,0 +1,82 @@
+"""Data-parallel causal-LM training (GPT family) with flash attention.
+
+Reference analog: examples/pytorch/pytorch_synthetic_benchmark.py's role —
+a runnable synthetic training loop — but for the decoder family the
+reference lacks: causal Pallas flash attention, optional activation
+rematerialization, bf16-compressed gradient allreduce.
+
+Run: ``hvdrun-tpu -np 4 -H localhost:4 python examples/jax/jax_gpt_train.py``
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.jax.compression import Compression
+from horovod_tpu.models import GptDecoder
+from horovod_tpu.parallel import dp, mesh as mesh_lib
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch-per-replica", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--remat", action="store_true",
+                   help="recompute activations in backward (jax.checkpoint)")
+    p.add_argument("--no-flash", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    mesh = mesh_lib.data_parallel_mesh(jax.devices())
+    n_rep = mesh.shape["data"]
+
+    model = GptDecoder(vocab=args.vocab, layers=args.layers,
+                       hidden=args.hidden, heads=args.hidden // 32,
+                       mlp_dim=args.hidden * 4, max_len=args.seq_len,
+                       dtype=jnp.float32, use_flash=not args.no_flash)
+    rs = np.random.RandomState(hvd.rank())
+    init_tokens = jnp.asarray(rs.randint(0, args.vocab, (2, args.seq_len)))
+    params = model.init(jax.random.key(0), init_tokens)["params"]
+    opt = optax.adamw(3e-4)
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], batch[:, 1:]).mean()
+        return loss, {}
+
+    step = dp.make_train_step(loss_fn, opt, mesh, donate=False,
+                              compression=Compression.bf16,
+                              remat=args.remat)
+    b = args.batch_per_replica * n_rep
+    # a memorizable synthetic corpus so the loss visibly drops
+    corpus = np.random.RandomState(0).randint(0, args.vocab,
+                                              (b, args.seq_len))
+    batch = dp.shard_batch(jnp.asarray(corpus), mesh)
+
+    p_, s_ = dp.replicate(params, mesh), dp.replicate(opt.init(params), mesh)
+    first = last = None
+    for i in range(args.steps):
+        out = step(p_, s_, batch, jax.random.key(i))
+        p_, s_ = out.params, out.opt_state
+        loss = float(out.loss)
+        first = first if first is not None else loss
+        last = loss
+        if hvd.rank() == 0 and i % 10 == 0:
+            print(f"step {i}: loss {loss:.4f}")
+    assert last < first, (first, last)
+    if hvd.rank() == 0:
+        print(f"done: final loss {last:.4f} (from {first:.4f})")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,7 @@ residual blocks in the pre-LN arrangement XLA fuses cleanly.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -65,3 +65,11 @@ def test_long_context_attention_example(flash):
     text = out.stdout.decode() + out.stderr.decode()
     assert out.returncode == 0, text
     assert "done: long-context attention OK" in text, text
+
+
+def test_gpt_train_example():
+    text = _run_example("examples/jax/jax_gpt_train.py", 2,
+                        ("--steps", "12", "--batch-per-replica", "4",
+                         "--seq-len", "32", "--hidden", "64",
+                         "--layers", "2", "--remat"))
+    assert "done: final loss" in text, text
