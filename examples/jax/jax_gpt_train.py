@@ -29,17 +29,25 @@ def main():
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--heads", type=int, default=None,
+                   help="attention heads (default hidden/32; must divide "
+                        "hidden)")
     p.add_argument("--remat", action="store_true",
                    help="recompute activations in backward (jax.checkpoint)")
     p.add_argument("--no-flash", action="store_true")
     args = p.parse_args()
+
+    heads = args.heads if args.heads is not None else args.hidden // 32
+    if heads < 1 or args.hidden % heads:
+        p.error(f"--hidden {args.hidden} needs a head count that divides "
+                f"it (got {heads}); pass --heads explicitly")
 
     hvd.init()
     mesh = mesh_lib.data_parallel_mesh(jax.devices())
     n_rep = mesh.shape["data"]
 
     model = GptDecoder(vocab=args.vocab, layers=args.layers,
-                       hidden=args.hidden, heads=args.hidden // 32,
+                       hidden=args.hidden, heads=heads,
                        mlp_dim=args.hidden * 4, max_len=args.seq_len,
                        dtype=jnp.float32, use_flash=not args.no_flash)
     rs = np.random.RandomState(hvd.rank())

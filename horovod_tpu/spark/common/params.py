@@ -124,3 +124,8 @@ class ModelParams(_ParamsBase):
         if out is None:
             out = [f"{c}__output" for c in (self._get("label_cols") or [])]
         return out
+
+    def getHistory(self):
+        """Training history dict, e.g. ``{"loss": [...]}`` (reference:
+        keras estimator getHistory)."""
+        return self._get("history")

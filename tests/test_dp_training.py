@@ -286,3 +286,27 @@ def test_remat_step_matches_plain(dp_mesh, mnist_setup):
                     jax.tree_util.tree_leaves(remat.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_all_step_options_compose(dp_mesh, mnist_setup):
+    """compression + hierarchical + remat + prescale/postscale + donate all
+    on at once: the combinations users flip must not interact badly."""
+    model, params = mnist_setup
+    loss_fn = _loss_fn_factory(model)
+    opt = optax.sgd(0.1)
+    from horovod_tpu.jax.compression import Compression
+
+    step = dp.make_train_step(
+        loss_fn, opt, dp_mesh, donate=True, remat=True,
+        compression=Compression.bf16, hierarchical=True,
+        prescale_factor=2.0, postscale_factor=0.5)
+    batch = _make_batch(32)
+    p = dp.replicate(params, dp_mesh)
+    s = dp.replicate(opt.init(params), dp_mesh)
+    losses = []
+    for i in range(4):
+        out = step(p, s, dp.shard_batch(batch, dp_mesh), jax.random.key(i))
+        p, s = out.params, out.opt_state
+        losses.append(float(out.loss))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses

@@ -11,17 +11,28 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(path, np_, extra=()):
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+def _run(cmd, env_extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               **dict(env_extra))
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
-           "-np", str(np_), "-H", f"localhost:{np_}", "--",
-           sys.executable, os.path.join(REPO, path), *extra]
     out = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                          timeout=420)
     text = out.stdout.decode() + out.stderr.decode()
     assert out.returncode == 0, text
     return text
+
+
+def _run_example(path, np_, extra=()):
+    """Launch an example across np_ processes via hvdrun-tpu."""
+    return _run([sys.executable, "-m", "horovod_tpu.runner.launch",
+                 "-np", str(np_), "-H", f"localhost:{np_}", "--",
+                 sys.executable, os.path.join(REPO, path), *extra])
+
+
+def _run_script(path, extra=(), env_extra=()):
+    """Run a single-process example script directly."""
+    return _run([sys.executable, os.path.join(REPO, path), *extra],
+                env_extra)
 
 
 def test_jax_mnist_example():
@@ -54,16 +65,11 @@ def test_tf_keras_mnist_example():
 def test_long_context_attention_example(flash):
     """Sequence-sharded ring attention example runs on the virtual mesh
     (SURVEY §5.7: the long-context strategy the reference lacks)."""
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
-               XLA_FLAGS="--xla_force_host_platform_device_count=8")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    cmd = [sys.executable,
-           os.path.join(REPO, "examples/jax/jax_long_context_attention.py"),
-           "--seq-len", "1024"] + (["--use-flash"] if flash else [])
-    out = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
-                         timeout=420)
-    text = out.stdout.decode() + out.stderr.decode()
-    assert out.returncode == 0, text
+    text = _run_script(
+        "examples/jax/jax_long_context_attention.py",
+        ("--seq-len", "1024") + (("--use-flash",) if flash else ()),
+        env_extra={"XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=8"}.items())
     assert "done: long-context attention OK" in text, text
 
 
@@ -73,3 +79,12 @@ def test_gpt_train_example():
                          "--seq-len", "32", "--hidden", "64",
                          "--layers", "2", "--remat"))
     assert "done: final loss" in text, text
+
+
+def test_spark_estimator_example():
+    """The estimator workflow example runs end-to-end on the pandas path
+    (no Spark session needed)."""
+    text = _run_script("examples/spark/spark_keras_estimator.py",
+                       ("--epochs", "6"),
+                       env_extra={"TF_CPP_MIN_LOG_LEVEL": "3"}.items())
+    assert "done: estimator fit + transform OK" in text, text
