@@ -22,10 +22,11 @@ import pandas as pd
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--num-proc", type=int, default=2)
-    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=12)
     args = p.parse_args()
 
     import tensorflow as tf
+    tf.keras.utils.set_random_seed(0)  # deterministic weight init
 
     from horovod_tpu.spark.common import LocalBackend, Store
     from horovod_tpu.spark.keras import KerasEstimator
@@ -44,7 +45,7 @@ def main():
     with tempfile.TemporaryDirectory() as tmp:
         est = KerasEstimator(
             model=model,
-            optimizer=tf.keras.optimizers.SGD(0.3),
+            optimizer=tf.keras.optimizers.SGD(0.1),
             loss="mse",
             store=Store.create(tmp),
             backend=LocalBackend(num_proc=args.num_proc),
