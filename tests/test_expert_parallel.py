@@ -126,3 +126,21 @@ def test_moe_layer_differentiable(ep_mesh):
     for g in (gg, gi, go):
         assert np.isfinite(np.asarray(g)).all()
         assert float(jnp.abs(g).sum()) > 0
+
+
+def test_moe_layer_rejects_wrong_gate_width(ep_mesh):
+    """A gate routing to the wrong expert count fails loudly, not with a
+    silent shape broadcast."""
+    _, w_in, w_out = _weights()
+    x = jnp.zeros((8, 4, D), jnp.float32)
+    bad_gate = jnp.zeros((D, E_TOTAL + 1), jnp.float32)
+
+    def local(xs, wg, wi, wo):
+        return moe_layer(xs[0], wg, wi, wo)[None]
+
+    with pytest.raises(ValueError, match="routes to"):
+        jax.shard_map(
+            local, mesh=ep_mesh,
+            in_specs=(P("expert"), P(), P("expert"), P("expert")),
+            out_specs=P("expert"), check_vma=False)(
+                x, bad_gate, jnp.asarray(w_in), jnp.asarray(w_out))

@@ -146,3 +146,31 @@ def test_flash_bf16_runs():
     out = flash_attention(q, q, q, causal=True, interpret=True)
     assert out.dtype == jnp.bfloat16 and out.shape == q.shape
     assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_flash_rejects_degenerate_block_divisor():
+    """A prime sequence length above the block size fails with padding
+    advice instead of compiling a pathological 1-wide grid. (Lengths at or
+    below the block size are always fine: the whole sequence is one
+    block.)"""
+    q = jnp.zeros((1, 1021, 2, 32), jnp.float32)  # prime
+    with pytest.raises(ValueError, match="pad the"):
+        flash_attention(q, q, q, interpret=True)
+    # sub-block odd length: single block, no error
+    small = jnp.zeros((1, 254, 2, 32), jnp.float32)
+    out = flash_attention(small, small, small, interpret=True)
+    assert out.shape == small.shape
+
+
+def test_flash_rejects_mask_with_flash_model():
+    """EncoderBlock(use_flash=True) refuses an explicit mask — only full
+    bidirectional or causal are kernel-supported."""
+    import flax.linen as nn
+    from horovod_tpu.models.transformer import EncoderBlock
+
+    block = EncoderBlock(hidden=32, heads=4, mlp_dim=64,
+                         dtype=jnp.float32, use_flash=True)
+    x = jnp.zeros((1, 16, 32), jnp.float32)
+    mask = nn.make_causal_mask(jnp.ones((1, 16)))
+    with pytest.raises(ValueError, match="mask"):
+        block.init(jax.random.key(0), x, mask=mask)
