@@ -14,13 +14,19 @@ images/sec/chip against that per-device number.
 
 Secondary figures, all honest (no clamps):
 - scaling_sweep: weak-scaling efficiency at 1/2/4/8 devices on a virtual
-  CPU mesh (per-step time at n devices vs 1, same per-device batch) plus
-  the raw no-collective/with-collective overhead ratio at 8 devices. A
-  host mesh can't price ICI, but it prices everything the framework adds
+  CPU mesh, normalized against the TRUE single-device baseline at the same
+  per-device batch (efficiency_n = t_1 / t_n; ideal weak scaling keeps the
+  per-step time flat at t_1). Values > 1.0 are never silently reported —
+  when they occur an explanatory field accompanies them. The raw
+  no-collective/with-collective overhead ratio at 8 devices rides along.
+  A host mesh can't price ICI, but it prices everything the framework adds
   around the collectives (the north star is the reference's ~90% at scale,
   docs/benchmarks.rst:9-14).
 - mfu: model FLOPs utilization against the chip's bf16 peak.
-- collective_bytes_per_step: gradient bytes each replica moves per step.
+- collective_bytes_per_step_per_replica: ring-cost gradient-exchange wire
+  bytes per replica for {fp32, bf16, int8} x {allreduce, sharded ZeRO-1}
+  (one shared formula, parallel/zero.py collective_bytes_per_step).
+- grad_exchange_sweep: measured images/sec/chip for the same mode matrix.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -159,23 +165,40 @@ def _run_scaling_probe():
         line = out.stdout.decode().strip().splitlines()[-1]
         data = json.loads(line)
         t1 = data["t"]["1"]
-        # the virtual devices share this host's physical cores, so an
-        # n-device weak-scaling step does its n device-batches on only
-        # min(n, cores) real lanes; ideal per-step time is
-        # n*t1/min(n,cores). efficiency = ideal/actual, unclamped (>1
-        # means per-step overheads amortized, <1 means the framework
-        # added cost).
-        cores = os.cpu_count() or 1
-        sweep = {n: round(int(n) * t1 / (min(int(n), cores) * t), 3)
-                 for n, t in data["t"].items()}
+        # Weak-scaling efficiency against the TRUE single-device baseline
+        # at the same per-device batch: ideal weak scaling keeps per-step
+        # time flat at t_1, so efficiency_n = t_1 / t_n. No core-count
+        # rescaling — on a virtual CPU mesh whose devices contend for
+        # physical cores this understates a real slice, which is the honest
+        # direction; the context field carries the caveat. Values > 1.0
+        # (timing jitter at small n) are reported only alongside an
+        # explanation, never bare.
+        sweep = {n: round(t1 / t, 3) for n, t in data["t"].items()}
+        context = {
+            "baseline": "single-device per-step time at the same "
+                        "per-device batch (t_1 / t_n)",
+            "physical_cores": os.cpu_count() or 1,
+            "note": "virtual CPU devices contend for host cores, so large-n"
+                    " figures lower-bound a real TPU slice",
+        }
+        gt1 = {n: e for n, e in sweep.items() if e > 1.0}
+        if gt1:
+            context["efficiency_gt_1"] = {
+                "values": gt1,
+                "explanation": "efficiency above 1.0 means the n-device step"
+                               " timed FASTER per step than the single-device"
+                               " baseline — on this virtual-device probe that"
+                               " is timing jitter / cache effects, not real"
+                               " superlinear scaling",
+            }
         overhead = round(data["t_nosync8"] / data["t"]["8"], 3)
-        return sweep, overhead
+        return sweep, context, overhead
     except Exception as e:  # probe failure must not sink the headline metric
         print(f"scaling probe failed: {e!r}", file=sys.stderr)
         if out is not None:
             print(out.stderr.decode(errors="replace")[-2000:],
                   file=sys.stderr)
-        return {}, -1.0
+        return {}, {}, -1.0
 
 
 def _bert_bench(mesh, n_dev, use_flash=False):
@@ -275,6 +298,37 @@ def _flash_longcontext_bench():
     return round(times["xla"] / times["flash"], 2)
 
 
+def _resnet_mode_bench(loss_fn, mesh, n_dev, params, batch_stats, batch, opt,
+                       *, sharded, compression):
+    """Measured images/sec/chip for one gradient-exchange mode — short
+    windows (secondary figures; the headline keeps the long windows)."""
+    from horovod_tpu.parallel import dp, zero
+
+    step = dp.make_stateful_train_step(loss_fn, opt, mesh, donate=True,
+                                       sharded_update=sharded,
+                                       compression=compression)
+    p = dp.replicate(params, mesh)
+    s = (zero.sharded_opt_init(opt, params, mesh) if sharded
+         else dp.replicate(opt.init(params), mesh))
+    st = dp.replicate(batch_stats, mesh)
+    key = jax.random.key(1)
+    iters = 10
+    for _ in range(3):
+        out = step(p, s, st, batch, key)
+        p, s, st = out.params, out.opt_state, out.model_state
+    float(out.loss)
+    best = float("inf")
+    b = BATCH_PER_CHIP * n_dev
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(p, s, st, batch, key)
+            p, s, st = out.params, out.opt_state, out.model_state
+        float(out.loss)
+        best = min(best, time.perf_counter() - t0)
+    return round(b * iters / best / n_dev, 2)
+
+
 def main():
     from horovod_tpu.models import ResNet50
     from horovod_tpu.parallel import dp, mesh as mesh_lib
@@ -288,8 +342,12 @@ def main():
     batch_size = BATCH_PER_CHIP * n_dev
     init_images = jnp.zeros((8, 224, 224, 3), jnp.bfloat16)
     variables = model.init(rng, init_images, train=True)
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})
+    # Host-side snapshots: device_put may alias device buffers, and the
+    # donating step invalidates them — each (re)replication below must start
+    # from memory donation can't reach.
+    params = jax.tree_util.tree_map(np.asarray, variables["params"])
+    batch_stats = jax.tree_util.tree_map(
+        np.asarray, variables.get("batch_stats", {}))
     opt = optax.sgd(0.05, momentum=0.9)
 
     def loss_fn(params, model_state, batch, rng):
@@ -335,7 +393,26 @@ def main():
         float(out.loss)
         best_dt = min(best_dt, time.perf_counter() - t0)
 
-    sweep, overhead = _run_scaling_probe()
+    sweep, sweep_context, overhead = _run_scaling_probe()
+
+    # Gradient-exchange mode sweep: the ZeRO-1 sharded pipeline and the int8
+    # quantized wire vs the stock paths, same model/batch (short windows).
+    from horovod_tpu.jax.compression import Compression
+    # (the fp32 allreduce figure is the primary metric above — only the
+    # three modes it doesn't cover get extra compiles)
+    modes = {
+        "bf16_allreduce": dict(sharded=False, compression=Compression.bf16),
+        "sharded_fp32": dict(sharded=True, compression=None),
+        "sharded_int8": dict(sharded=True, compression=Compression.int8),
+    }
+    grad_sweep = {}
+    for mode_name, kw in modes.items():
+        try:
+            grad_sweep[mode_name] = _resnet_mode_bench(
+                loss_fn, mesh, n_dev, params, batch_stats, batch, opt, **kw)
+        except Exception as e:  # secondary figure must not sink the bench
+            print(f"grad mode {mode_name} failed: {e!r}", file=sys.stderr)
+            grad_sweep[mode_name] = -1.0
     # Headline BERT figure: XLA dot attention wins at seq 128 (tiny score
     # tiles); the Pallas flash kernel is reported alongside, and its
     # long-context figure below is where it beats XLA (1.5x at 2k tokens,
@@ -365,12 +442,46 @@ def main():
     bert_mfu = round(
         bert_seq_per_sec * BERT_TRAIN_FLOPS_PER_SEQ / (peak * 1e12), 4) \
         if peak > 0 and bert_seq_per_sec > 0 else -1.0
+    # One shared formula (parallel/zero.py) for the wire-byte accounting so
+    # tests, docs, and this bench can't drift apart. N_REF = 8: the slice
+    # size the multichip dryruns and scaling probe use.
+    from horovod_tpu.parallel import zero
+    N_REF = 8
+
+    def _bytes(mode, wire):
+        return zero.collective_bytes_per_step(
+            int(RESNET50_PARAMS), N_REF, mode=mode, wire_bytes_per_elem=wire)
+
+    fp32_allreduce_bytes = _bytes("allreduce", 4.0)
+    coll_bytes = {
+        "formula": "2*(N-1)/N * wire_payload bytes per replica per phase "
+                   "pair (reduce-scatter + all-gather); int8 payloads add "
+                   "one fp32 scale per 256-element block on each phase",
+        "world_size": N_REF,
+        "resnet50_fp32_allreduce": fp32_allreduce_bytes,
+        "resnet50_bf16_allreduce": _bytes("allreduce", 2.0),
+        "resnet50_int8_allreduce": _bytes("allreduce", 1.0),
+        "resnet50_sharded_fp32": _bytes("sharded", 4.0),
+        "resnet50_sharded_bf16": _bytes("sharded", 2.0),
+        "resnet50_sharded_int8": _bytes("sharded", 1.0),
+        "bert_base_bf16_allreduce": zero.collective_bytes_per_step(
+            int(BERT_BASE_PARAMS), N_REF, mode="allreduce",
+            wire_bytes_per_elem=2.0),
+    }
+    coll_bytes["reduction_vs_fp32_allreduce"] = {
+        k: round(fp32_allreduce_bytes / v, 2)
+        for k, v in coll_bytes.items()
+        if isinstance(v, int) and k.startswith("resnet50") and v > 0
+    }
+
     print(json.dumps({
         "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_PER_DEVICE, 3),
         "scaling_sweep_weak_efficiency": sweep,
+        "scaling_sweep_context": sweep_context,
+        "grad_exchange_sweep_images_per_sec_per_chip": grad_sweep,
         "collective_overhead_ratio_8dev": overhead,
         "resnet50_mfu_vs_bf16_peak": resnet_mfu,
         "bert_base_bf16comp_seqs_per_sec_per_chip": bert_seq_per_sec,
@@ -378,10 +489,7 @@ def main():
         "bert_base_flash_attention_seqs_per_sec_per_chip":
             bert_flash_seq_per_sec,
         "flash_attention_8k_causal_speedup_vs_xla": flash_speedup_8k,
-        "collective_bytes_per_step_per_replica": {
-            "resnet50_fp32_grads": int(RESNET50_PARAMS * 4),
-            "bert_base_bf16_compressed_grads": int(BERT_BASE_PARAMS * 2),
-        },
+        "collective_bytes_per_step_per_replica": coll_bytes,
         "device_kind": jax.devices()[0].device_kind,
     }))
 

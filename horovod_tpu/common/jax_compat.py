@@ -1,0 +1,53 @@
+"""Compatibility layer for the two jax API generations this framework meets.
+
+The codebase is written against the current spelling — ``jax.shard_map`` with
+``check_vma=`` — which older jaxlib toolchains (< 0.5) ship only as
+``jax.experimental.shard_map.shard_map`` with the ``check_rep=`` knob.
+:func:`install` bridges the gap by publishing a top-level ``jax.shard_map``
+when it is missing; on current jax it is a no-op. It runs once at
+``horovod_tpu`` import time so user code, tests, and bench scripts can use
+one spelling everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _compat_shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, check_rep=None, **kwargs):
+    """``jax.shard_map``-shaped wrapper over the experimental entry point."""
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if f is None:
+        return functools.partial(
+            _compat_shard_map, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, check_vma=check_vma, check_rep=check_rep,
+            **kwargs)
+    check = True
+    if check_vma is not None:
+        check = bool(check_vma)
+    if check_rep is not None:
+        check = bool(check_rep)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check, **kwargs)
+
+
+def _compat_axis_size(axis_name):
+    """``lax.axis_size`` for jax versions that predate it. ``psum`` of a
+    literal 1 is special-cased by jax to fold to a static int."""
+    from jax import lax
+
+    return lax.psum(1, axis_name)
+
+
+def install() -> None:
+    """Publish ``jax.shard_map`` / ``lax.axis_size`` if this jax predates
+    the top-level spellings."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _compat_shard_map
+    from jax import lax
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _compat_axis_size

@@ -230,6 +230,13 @@ DataPlane::DataPlane(std::shared_ptr<ControllerTransport> transport)
   if (const char* env = std::getenv("HOROVOD_RING_THRESHOLD_BYTES")) {
     if (*env) ring_threshold_ = std::atoll(env);
   }
+  if (const char* env = std::getenv("HOROVOD_DATA_FAULT_INJECT")) {
+    const std::string faults(env);
+    fault_truncate_star_allgatherv_ =
+        faults.find("truncate_star_allgatherv") != std::string::npos;
+    fault_truncate_ring_alltoallv_ =
+        faults.find("truncate_ring_alltoallv") != std::string::npos;
+  }
 }
 
 Status DataPlane::RingAllreduce(void* buffer, int64_t num_elements,
@@ -437,9 +444,18 @@ Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
   if (transport_->rank() == 0) {
     packed.reserve(total);
     for (auto& p : all) packed.append(p);
+    if (fault_truncate_star_allgatherv_ && !packed.empty()) {
+      packed.pop_back();  // test-only: simulate a truncated broadcast
+    }
   }
   st = transport_->Bcast(&packed);
   if (!st.ok()) return st;
+  // A truncated/corrupt Bcast would hand callers rank_bytes offsets running
+  // past the payload consumed via hvdtpu_data_fetch — validate like the
+  // ring path validates each blob.
+  if (static_cast<int64_t>(packed.size()) != total) {
+    return Status::Unknown("star allgatherv payload size mismatch");
+  }
   *out = std::move(packed);
   return Status::OK();
 }
@@ -489,42 +505,52 @@ Status DataPlane::RingAlltoallv(const void* in,
   // (d - s) mod size hops, so per-link traffic averages total/2 with no
   // rank-0 funnel. All ranks run exactly size-1 lockstep exchanges
   // (possibly with empty bundles), so the ring cannot skew.
-  struct Entry {
-    int32_t src;
-    int32_t dst;
-    std::string data;
+  //
+  // The bundle lives in wire format end-to-end:
+  //   [u32 count][count x (i32 src, i32 dst, i64 len)][payloads...]
+  // Each hop splices the incoming buffer in one pass — delivered chunks
+  // copy out, kept chunks copy straight into the next outgoing buffer —
+  // so per-hop work is O(bytes still in flight), not the
+  // O(world x total_bytes) a deserialize-reserialize round trip costs.
+  constexpr size_t kEntryHdr = 2 * sizeof(int32_t) + sizeof(int64_t);
+  auto append_hdr = [](std::string* wire, int32_t src, int32_t dst,
+                       int64_t len) {
+    wire->append(reinterpret_cast<const char*>(&src), sizeof(src));
+    wire->append(reinterpret_cast<const char*>(&dst), sizeof(dst));
+    wire->append(reinterpret_cast<const char*>(&len), sizeof(len));
   };
   std::vector<std::string> received(size);
-  std::vector<Entry> bundle;
-  int64_t off = 0;
-  for (int d = 0; d < size; ++d) {
-    if (d == rank) {
-      received[rank].assign(src_data + off, send_bytes[d]);
-    } else {
-      bundle.push_back({rank, d, std::string(src_data + off, send_bytes[d])});
+  std::string wire;
+  {
+    uint32_t count = static_cast<uint32_t>(size > 0 ? size - 1 : 0);
+    int64_t payload_total = 0, off = 0;
+    for (int d = 0; d < size; ++d) {
+      if (d != rank) payload_total += send_bytes[d];
     }
-    off += send_bytes[d];
+    wire.reserve(sizeof(count) + count * kEntryHdr + payload_total);
+    wire.append(reinterpret_cast<const char*>(&count), sizeof(count));
+    for (int d = 0; d < size; ++d) {
+      if (d == rank) {
+        received[rank].assign(src_data + off, send_bytes[d]);
+      } else {
+        append_hdr(&wire, rank, d, send_bytes[d]);
+      }
+      off += send_bytes[d];
+    }
+    off = 0;
+    for (int d = 0; d < size; ++d) {
+      if (d != rank) wire.append(src_data + off, send_bytes[d]);
+      off += send_bytes[d];
+    }
   }
 
-  auto serialize = [](const std::vector<Entry>& es) {
-    std::string wire;
-    uint32_t count = static_cast<uint32_t>(es.size());
-    wire.append(reinterpret_cast<const char*>(&count), sizeof(count));
-    for (const auto& e : es) {
-      int64_t len = static_cast<int64_t>(e.data.size());
-      wire.append(reinterpret_cast<const char*>(&e.src), sizeof(e.src));
-      wire.append(reinterpret_cast<const char*>(&e.dst), sizeof(e.dst));
-      wire.append(reinterpret_cast<const char*>(&len), sizeof(len));
-    }
-    for (const auto& e : es) wire.append(e.data);
-    return wire;
-  };
-
   for (int s = 0; s < size - 1; ++s) {
-    std::string outgoing = serialize(bundle);
+    if (fault_truncate_ring_alltoallv_ && s == 0 &&
+        wire.size() > sizeof(uint32_t)) {
+      wire.pop_back();  // test-only: simulate a corrupt relay payload
+    }
     std::string incoming;
-    auto st = transport_->RingExchange(outgoing.data(), outgoing.size(),
-                                       &incoming);
+    auto st = transport_->RingExchange(wire.data(), wire.size(), &incoming);
     if (!st.ok()) return st;
     uint32_t count = 0;
     if (incoming.size() < sizeof(count)) {
@@ -532,35 +558,52 @@ Status DataPlane::RingAlltoallv(const void* in,
     }
     std::memcpy(&count, incoming.data(), sizeof(count));
     size_t hdr = sizeof(count);
-    size_t data_off = hdr + count * (2 * sizeof(int32_t) + sizeof(int64_t));
+    size_t data_off = hdr + count * kEntryHdr;
     if (incoming.size() < data_off) {
       return Status::Unknown("ring alltoallv truncated bundle header");
     }
-    bundle.clear();
+    // One pass: validate headers, deliver our chunks, splice the rest.
+    std::string next;
+    uint32_t kept = 0;
+    next.append(reinterpret_cast<const char*>(&kept), sizeof(kept));
+    int64_t kept_payload = 0;
+    struct Span {
+      size_t off;
+      int64_t len;
+    };
+    std::vector<Span> kept_spans;
+    kept_spans.reserve(count);
     for (uint32_t i = 0; i < count; ++i) {
-      Entry e;
+      int32_t src = 0, dst = 0;
       int64_t len = 0;
-      std::memcpy(&e.src, incoming.data() + hdr, sizeof(e.src));
-      hdr += sizeof(e.src);
-      std::memcpy(&e.dst, incoming.data() + hdr, sizeof(e.dst));
-      hdr += sizeof(e.dst);
+      std::memcpy(&src, incoming.data() + hdr, sizeof(src));
+      hdr += sizeof(src);
+      std::memcpy(&dst, incoming.data() + hdr, sizeof(dst));
+      hdr += sizeof(dst);
       std::memcpy(&len, incoming.data() + hdr, sizeof(len));
       hdr += sizeof(len);
-      if (e.src < 0 || e.src >= size || e.dst < 0 || e.dst >= size ||
-          len < 0 ||
+      if (src < 0 || src >= size || dst < 0 || dst >= size || len < 0 ||
           data_off + static_cast<size_t>(len) > incoming.size()) {
         return Status::Unknown("ring alltoallv corrupt entry");
       }
-      e.data.assign(incoming.data() + data_off, len);
-      data_off += len;
-      if (e.dst == rank) {
-        received[e.src] = std::move(e.data);
+      if (dst == rank) {
+        received[src].assign(incoming.data() + data_off, len);
       } else {
-        bundle.push_back(std::move(e));
+        append_hdr(&next, src, dst, len);
+        kept_spans.push_back({data_off, len});
+        kept_payload += len;
+        ++kept;
       }
+      data_off += len;
     }
+    next.reserve(next.size() + kept_payload);
+    for (const auto& span : kept_spans) {
+      next.append(incoming.data() + span.off, span.len);
+    }
+    std::memcpy(&next[0], &kept, sizeof(kept));
+    wire = std::move(next);
   }
-  if (!bundle.empty()) {
+  if (wire.size() > sizeof(uint32_t)) {
     return Status::Unknown("ring alltoallv left undelivered chunks");
   }
   recv_bytes->resize(size);

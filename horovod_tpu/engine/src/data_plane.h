@@ -84,6 +84,11 @@ class DataPlane {
   std::shared_ptr<ControllerTransport> transport_;
   int64_t ring_threshold_;
   int64_t ring_ops_ = 0;
+  // Test-only fault injection (HOROVOD_DATA_FAULT_INJECT): corrupt a wire
+  // payload so the negative paths of the size-validation checks are
+  // exercisable from the multi-process tests. Never set in production.
+  bool fault_truncate_star_allgatherv_ = false;
+  bool fault_truncate_ring_alltoallv_ = false;
 };
 
 }  // namespace hvdtpu

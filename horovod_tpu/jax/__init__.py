@@ -99,7 +99,21 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             outs = collectives.grouped_allreduce(
                 leaves, op=op, axis=_axes_in_scope(axis))
             return jax.tree_util.tree_unflatten(treedef, outs)
-        if gradient_predivide_factor != 1.0:
+        if getattr(compression, "quantized", False):
+            # int8 block payloads are not psum-reducible — ride the
+            # dequantize-reduce-requantize collective.
+            def red(v):
+                ax = _axes_in_scope(axis)
+                if gradient_predivide_factor != 1.0:
+                    return collectives.quantized_allreduce(
+                        v, op=Sum, axis=ax,
+                        prescale_factor=1.0 / gradient_predivide_factor,
+                        postscale_factor=gradient_predivide_factor
+                        / collectives.axis_size(ax),
+                        block_size=compression.block_size)
+                return collectives.quantized_allreduce(
+                    v, op=op, axis=ax, block_size=compression.block_size)
+        elif gradient_predivide_factor != 1.0:
             pre = 1.0 / gradient_predivide_factor
             # Average = sum * (1/size); split the divisor around the wire.
             def red(v):

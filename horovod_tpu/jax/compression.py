@@ -5,11 +5,45 @@ On TPU "wire" compression means the dtype the ICI collective runs in: a bf16
 psum moves half the bytes of an fp32 one. We default to bfloat16 rather than
 float16 (same 16-bit wire size, but bf16's fp32-matched exponent range makes
 gradient overflow a non-issue on TPU); ``fp16`` is offered for parity.
+
+``int8`` goes further (EQuARX, arXiv:2506.17615): per-block symmetric int8
+payloads with one fp32 scale per ``block_size`` elements — ~4x fewer wire
+bytes than fp32 at ~1.6% scale overhead. Unlike the dtype-cast compressors,
+int8 values from different replicas carry different scales and CANNOT be
+summed directly by a psum; the collective layer detects ``quantized = True``
+and routes through the dequantize-reduce-requantize collectives in
+:mod:`horovod_tpu.parallel.collectives` (quantized_allreduce /
+quantized_reducescatter / quantized_allgather).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+
+def block_quantize_rows(rows, block_size: int):
+    """Symmetric per-block int8 quantization of a ``[rows, cols]`` float
+    array (``cols`` divisible by ``block_size``).
+
+    Returns ``(payload int8 [rows, cols], scales fp32 [rows, cols/block])``
+    with ``payload * scale ≈ rows``; max elementwise error is ``scale / 2``
+    = ``max|block| / 254``. All-zero blocks get scale 0 and round-trip
+    exactly."""
+    r, c = rows.shape
+    blocks = rows.astype(jnp.float32).reshape(r, c // block_size, block_size)
+    amax = jnp.max(jnp.abs(blocks), axis=-1)
+    scale = amax / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(blocks / safe[..., None]), -127, 127)
+    return q.astype(jnp.int8).reshape(r, c), scale
+
+
+def block_dequantize_rows(payload, scales, block_size: int):
+    """Inverse of :func:`block_quantize_rows`; returns fp32 ``[rows, cols]``."""
+    r, c = payload.shape
+    blocks = payload.astype(jnp.float32).reshape(r, c // block_size,
+                                                 block_size)
+    return (blocks * scales[..., None]).reshape(r, c)
 
 
 class Compressor:
@@ -68,9 +102,47 @@ class BF16Compressor(Compressor):
         return tensor.astype(ctx) if jnp.issubdtype(ctx, jnp.floating) else tensor
 
 
+class Int8Compressor(Compressor):
+    """Per-block int8 wire format (EQuARX-style, arXiv:2506.17615).
+
+    ``quantized = True`` marks that the payload is NOT reducible by a plain
+    psum — paths that see this marker (dp.make_train_step, the jax
+    DistributedOptimizer, the ZeRO sharded update) route the gradient through
+    the quantized collectives instead of compress → psum → decompress.
+    ``compress``/``decompress`` still work as a local round-trip pair so the
+    compressor composes with code that only needs the representation."""
+
+    quantized = True
+    block_size = 256
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = (tensor.dtype, tensor.shape)
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor, (ctx, None)
+        flat = tensor.reshape(1, -1)
+        pad = (-flat.shape[1]) % cls.block_size
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, pad)))
+        payload, scales = block_quantize_rows(flat, cls.block_size)
+        return payload, (ctx, scales)
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        (dtype, shape), scales = ctx
+        if scales is None:
+            return tensor
+        rows = block_dequantize_rows(tensor, scales, cls.block_size)
+        size = 1
+        for d in shape:
+            size *= d
+        return rows.reshape(-1)[:size].reshape(shape).astype(dtype)
+
+
 class Compression:
     """Option enum parity (reference: compression.py:69-74)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

@@ -8,6 +8,11 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     replicated,
 )
 from horovod_tpu.parallel import collectives  # noqa: F401
+from horovod_tpu.parallel import zero  # noqa: F401
+from horovod_tpu.parallel.zero import (  # noqa: F401
+    apply_sharded_update,
+    sharded_opt_init,
+)
 from horovod_tpu.parallel.sp import (  # noqa: F401
     ring_attention,
     ulysses_attention,

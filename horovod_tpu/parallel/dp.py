@@ -25,8 +25,9 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.ops.fusion import fused_apply_tree
-from horovod_tpu.parallel import collectives
+from horovod_tpu.parallel import collectives, zero
 from horovod_tpu.parallel.collectives import Average, Op
+from horovod_tpu.parallel.zero import sharded_opt_init  # noqa: F401 (re-export)
 
 # The replica axes a pure-DP step reduces over.
 DP_AXES = ("data", "fsdp")
@@ -43,9 +44,59 @@ def _resolve_hierarchical(hierarchical: Optional[bool],
     return hierarchical and len(axes) >= 2
 
 
+def _make_param_update(optimizer, op, axes, compression, prescale_factor,
+                       postscale_factor, hierarchical, sharded_update):
+    """Build ``(grads, opt_state, params) -> (new_params, new_opt_state)``
+    plus the opt-state PartitionSpec, switching between the replicated path
+    (allreduce + full update on every replica) and the ZeRO-1 sharded path
+    (reduce-scatter → shard update → all-gather, parallel/zero.py)."""
+    if sharded_update:
+        if op is collectives.Adasum:
+            raise ValueError("sharded_update is incompatible with Adasum — "
+                             "Adasum has no reduce-scatter form")
+        if hierarchical:
+            raise ValueError(
+                "sharded_update is incompatible with hierarchical allreduce "
+                "— the sharded pipeline already reduce-scatters over all "
+                "reduce axes; unset hierarchical= (or "
+                "HOROVOD_HIERARCHICAL_ALLREDUCE)")
+        update = functools.partial(
+            zero.apply_sharded_update, optimizer, axes=axes, op=op,
+            compression=compression, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+        return update, P(axes)
+
+    allreduce_grads = _make_grad_allreduce(
+        op, axes, compression, prescale_factor, postscale_factor,
+        hierarchical)
+
+    def apply(grads, opt_state, params):
+        grads = allreduce_grads(grads)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state
+
+    return apply, P()
+
+
 def _make_grad_allreduce(op, axes, compression, prescale_factor,
                          postscale_factor, hierarchical):
     """The gradient-combining tree map shared by both step builders."""
+    quantized = bool(getattr(compression, "quantized", False))
+    if quantized:
+        if hierarchical:
+            raise ValueError(
+                "quantized compression is incompatible with hierarchical "
+                "allreduce — the quantized collective is already a "
+                "reduce-scatter/all-gather composition")
+        # int8 payloads carry per-block scales — not psum-reducible; route
+        # through the dequantize-reduce-requantize collective (fused per
+        # dtype class like the plain path).
+        def qred(v):
+            return collectives.quantized_allreduce(
+                v, op=op, axis=axes, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                block_size=compression.block_size)
+        return lambda tree: fused_apply_tree(qred, tree)
     if op is collectives.Adasum:
         def adasum_tree(tree):
             # Per-tensor coefficients — must not be elementwise-fused.
@@ -100,7 +151,8 @@ def make_train_step(loss_fn: Callable,
                     axes: Tuple[str, ...] = DP_AXES,
                     hierarchical: Optional[bool] = None,
                     donate: bool = True,
-                    remat: bool = False) -> Callable:
+                    remat: bool = False,
+                    sharded_update: bool = False) -> Callable:
     """Build a jitted data-parallel train step.
 
     ``loss_fn(params, batch, rng) -> (loss, aux)`` computes the local loss on
@@ -108,6 +160,17 @@ def make_train_step(loss_fn: Callable,
     GradientTransformation. The returned step has signature
     ``step(params, opt_state, batch, rng) -> TrainStepOutput`` with params and
     opt_state replicated, batch sharded on its leading dim.
+
+    ``sharded_update=True`` switches the gradient-combine + update to the
+    ZeRO-1 pipeline (:mod:`horovod_tpu.parallel.zero`): reduce-scatter the
+    grads, update only the local 1/N shard of params and optimizer state,
+    all-gather the param updates. Optimizer state must then be built with
+    :func:`horovod_tpu.parallel.zero.sharded_opt_init` (NOT
+    ``replicate(opt.init(params))``) — it lives sharded over ``axes`` and
+    is 1/N the size per device. The optimizer must be elementwise; Adasum
+    and ``hierarchical`` are incompatible with this path. ``compression``
+    composes: fp16/bf16 cast the wire dtype of both phases, int8
+    (``Compression.int8``) block-quantizes both phases (~4x fewer bytes).
 
     Leaves of ``aux`` are made replica-consistent: floating leaves are
     averaged (the cross-replica sync the reference provides via
@@ -127,9 +190,9 @@ def make_train_step(loss_fn: Callable,
     from horovod_tpu.jax.compression import Compression
     if compression is Compression.none:
         compression = None
-    _allreduce_grads = _make_grad_allreduce(
-        op, axes, compression, prescale_factor, postscale_factor,
-        _resolve_hierarchical(hierarchical, axes))
+    _apply_update, opt_spec = _make_param_update(
+        optimizer, op, axes, compression, prescale_factor, postscale_factor,
+        _resolve_hierarchical(hierarchical, axes), sharded_update)
 
     def _sync_aux(aux):
         def sync(v):
@@ -148,9 +211,7 @@ def make_train_step(loss_fn: Callable,
         rng = jax.random.fold_in(rng, collectives.axis_rank(axes))
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch, rng)
-        grads = _allreduce_grads(grads)
-        updates, new_opt_state = optimizer.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        new_params, new_opt_state = _apply_update(grads, opt_state, params)
         loss = collectives.allreduce(loss, op=Average, axis=axes)
         return TrainStepOutput(new_params, new_opt_state, loss, _sync_aux(aux))
 
@@ -158,8 +219,8 @@ def make_train_step(loss_fn: Callable,
     mapped = jax.shard_map(
         _local_step,
         mesh=mesh,
-        in_specs=(P(), P(), batch_spec, P()),
-        out_specs=TrainStepOutput(P(), P(), P(), P()),
+        in_specs=(P(), opt_spec, batch_spec, P()),
+        out_specs=TrainStepOutput(P(), opt_spec, P(), P()),
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()
@@ -177,7 +238,8 @@ def make_stateful_train_step(loss_fn: Callable,
                              axes: Tuple[str, ...] = DP_AXES,
                              hierarchical: Optional[bool] = None,
                              donate: bool = True,
-                             remat: bool = False) -> Callable:
+                             remat: bool = False,
+                             sharded_update: bool = False) -> Callable:
     """Train step for models with non-gradient state (BatchNorm running
     statistics etc.).
 
@@ -188,7 +250,9 @@ def make_stateful_train_step(loss_fn: Callable,
     statistics sync the reference provides via SyncBatchNormalization
     (reference: horovod/torch/sync_batch_norm.py). ``remat=True`` trades
     FLOPs for activation memory via ``jax.checkpoint`` (see
-    :func:`make_train_step`).
+    :func:`make_train_step`); ``sharded_update=True`` routes the update
+    through the ZeRO-1 reduce-scatter pipeline (see :func:`make_train_step`
+    — opt state must come from :func:`~horovod_tpu.parallel.zero.sharded_opt_init`).
     """
     axes = tuple(a for a in axes if a in mesh.shape)
     if remat:
@@ -196,9 +260,9 @@ def make_stateful_train_step(loss_fn: Callable,
     from horovod_tpu.jax.compression import Compression
     if compression is Compression.none:
         compression = None
-    _allreduce_grads = _make_grad_allreduce(
-        op, axes, compression, prescale_factor, postscale_factor,
-        _resolve_hierarchical(hierarchical, axes))
+    _apply_update, opt_spec = _make_param_update(
+        optimizer, op, axes, compression, prescale_factor, postscale_factor,
+        _resolve_hierarchical(hierarchical, axes), sharded_update)
 
     def _sync_state(tree):
         def sync(v):
@@ -212,9 +276,7 @@ def make_stateful_train_step(loss_fn: Callable,
         rng = jax.random.fold_in(rng, collectives.axis_rank(axes))
         (loss, (new_model_state, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, model_state, batch, rng)
-        grads = _allreduce_grads(grads)
-        updates, new_opt_state = optimizer.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        new_params, new_opt_state = _apply_update(grads, opt_state, params)
         loss = collectives.allreduce(loss, op=Average, axis=axes)
         return StatefulTrainStepOutput(new_params, new_opt_state,
                                        _sync_state(new_model_state), loss,
@@ -222,8 +284,8 @@ def make_stateful_train_step(loss_fn: Callable,
 
     mapped = jax.shard_map(
         _local_step, mesh=mesh,
-        in_specs=(P(), P(), P(), P(axes), P()),
-        out_specs=StatefulTrainStepOutput(P(), P(), P(), P(), P()),
+        in_specs=(P(), opt_spec, P(), P(axes), P()),
+        out_specs=StatefulTrainStepOutput(P(), opt_spec, P(), P(), P()),
         check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
     return jax.jit(mapped, donate_argnums=donate_argnums)

@@ -165,8 +165,32 @@ def _load_ssh_cache() -> dict:
         return {}
 
 
-def _store_ssh_cache(cache: dict) -> None:
+def _effective_ssh_user(host: str) -> str:
+    """The user ssh will authenticate as for ``host``: an explicit
+    ``user@host`` prefix wins, else the invoking user. Folding this into the
+    cache key keeps a success for one credential set from being trusted for
+    another."""
+    if "@" in host:
+        return host.split("@", 1)[0]
+    import getpass
+    try:
+        return getpass.getuser()
+    except Exception:
+        return os.environ.get("USER", "?")
+
+
+def _ssh_cache_key(host: str, ssh_port) -> str:
+    return f"{_effective_ssh_user(host)}@{host}:{ssh_port or 22}"
+
+
+def _store_ssh_cache(cache: dict, now: Optional[float] = None) -> None:
     import json
+    if now is not None:
+        # Prune entries past the staleness window on every store — they can
+        # never satisfy a lookup again, and without pruning the file grows
+        # with every host/credential combination ever probed.
+        cache = {k: t for k, t in cache.items()
+                 if now - t < SSH_CACHE_STALENESS_S}
     try:
         os.makedirs(os.path.dirname(SSH_CACHE_FILE), exist_ok=True)
         with open(SSH_CACHE_FILE, "w") as f:
@@ -209,7 +233,7 @@ def check_hosts_ssh(hostnames, ssh_port=None) -> List[str]:
         return False
 
     to_probe = [h for h in sorted(set(remote))
-                if now - cache.get(f"{h}:{ssh_port or 22}", 0)
+                if now - cache.get(_ssh_cache_key(h, ssh_port), 0)
                 >= SSH_CACHE_STALENESS_S]
     bad = []
     if to_probe:
@@ -220,10 +244,10 @@ def check_hosts_ssh(hostnames, ssh_port=None) -> List[str]:
             for host, ok in zip(to_probe, ex.map(probe, to_probe)):
                 if ok:
                     # only successes are cached, like the reference
-                    cache[f"{host}:{ssh_port or 22}"] = now
+                    cache[_ssh_cache_key(host, ssh_port)] = now
                 else:
                     bad.append(host)
-    _store_ssh_cache(cache)
+    _store_ssh_cache(cache, now=now)
     return bad
 
 

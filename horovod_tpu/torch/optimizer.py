@@ -32,7 +32,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def __init__(self, params, named_parameters, compression,
                  backward_passes_per_step, op,
-                 gradient_predivide_factor, groups):
+                 gradient_predivide_factor, groups, sharded=False):
         super(self.__class__, self).__init__(params)
         self._compression = compression or Compression.none
         self._bpps = int(backward_passes_per_step)
@@ -41,6 +41,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._op = op
         self._gradient_predivide_factor = gradient_predivide_factor
         self._groups = groups
+        self._sharded = bool(sharded)
+        self._owner = {}
         if named_parameters is not None:
             named_parameters = list(named_parameters)
             self._param_names = {id(p): name for name, p in named_parameters}
@@ -136,6 +138,50 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         finally:
             self._should_synchronize = True
 
+    # -- ZeRO-1 weight-update sharding (eager analog of parallel/zero.py;
+    # -- technique: Xu et al., arXiv:2004.13336) ------------------------------
+
+    def _compute_owners(self):
+        """Deterministic greedy partition of parameters across ranks:
+        largest-first onto the least-loaded rank. Every rank computes the
+        same assignment from the same param_groups order — no negotiation
+        round needed."""
+        size = max(basics._context().size, 1)
+        loads = [0] * size
+        ordered = [p for g in self.param_groups for p in g["params"]
+                   if p.requires_grad]
+        # stable: sort by (-numel, original position)
+        for pos, p in sorted(enumerate(ordered),
+                             key=lambda ip: (-ip[1].numel(), ip[0])):
+            owner = min(range(size), key=lambda r: (loads[r], r))
+            loads[owner] += p.numel()
+            self._owner[p] = owner
+
+    def _sharded_step(self, closure):
+        """Owner-only inner step + parameter broadcast: the optimizer
+        materializes state (Adam moments, ...) ONLY for the ~1/N of
+        parameters this rank owns, and performs ~1/N of the update FLOPs.
+        Grads still arrive via allreduce (the eager engine's reduction
+        primitive); the saving here is state memory + update compute, the
+        redundancy arXiv:2004.13336 targets."""
+        rank = basics._context().rank
+        stashed = []
+        for p in list(self._owner):
+            if self._owner[p] != rank and p.grad is not None:
+                stashed.append((p, p.grad))
+                p.grad = None  # torch optimizers skip grad-None params
+        loss = super(self.__class__, self).step(closure)
+        for p, grad in stashed:
+            p.grad = grad  # restore: post-step grad consumers see all grads
+        handles = []
+        for p, owner in self._owner.items():
+            name = self._param_names.get(id(p)) or f"param.{id(p)}"
+            handles.append(mpi_ops.broadcast_async_(
+                p.data, root_rank=owner, name=f"zero.param.{name}"))
+        for h in handles:
+            mpi_ops.synchronize(h)
+        return loss
+
     def step(self, closure=None):
         if self._should_synchronize:
             if self._synchronized:
@@ -145,6 +191,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     "gradients were already synchronized")
             self.synchronize()
         self._synchronized = False
+        if self._sharded and basics._context().size > 1:
+            if not self._owner:
+                self._compute_owners()
+            return self._sharded_step(closure)
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, set_to_none: bool = True):
@@ -209,13 +259,23 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          op=mpi_ops.Average,
                          gradient_predivide_factor: float = 1.0,
-                         groups=None) -> torch.optim.Optimizer:
+                         groups=None,
+                         sharded: bool = False) -> torch.optim.Optimizer:
     """Wrap a torch optimizer with hook-driven gradient allreduce
-    (reference: horovod/torch/optimizer.py:443-508)."""
+    (reference: horovod/torch/optimizer.py:443-508).
+
+    ``sharded=True`` enables ZeRO-1-style weight-update sharding (the eager
+    analog of ``horovod_tpu.parallel.zero``): parameters are partitioned
+    across ranks, each rank runs the inner optimizer only on its ~1/N
+    partition (so optimizer state is ~1/N per rank), and updated parameters
+    are broadcast from their owners after ``step()``."""
     if gradient_predivide_factor != 1.0 and op is not mpi_ops.Average:
         raise ValueError(
             "gradient_predivide_factor not supported with op != Average")
     if op is mpi_ops.Adasum:
+        if sharded:
+            raise ValueError("sharded=True is incompatible with Adasum — "
+                             "Adasum combines full parameter deltas")
         cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                    dict(_DistributedAdasumOptimizer.__dict__))
         return cls(optimizer.param_groups, named_parameters, compression,
@@ -224,7 +284,7 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, gradient_predivide_factor,
-               groups)
+               groups, sharded)
 
 
 def _find_duplicates(names) -> set:

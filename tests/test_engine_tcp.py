@@ -211,6 +211,75 @@ def test_tcp_ring_allgatherv_alltoallv_8ranks(tmp_path):
         assert f"gather worker {r} OK" in out
 
 
+FAULT_WORKER = textwrap.dedent("""
+    import ctypes, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from horovod_tpu.engine import bindings
+    from horovod_tpu.engine.bindings import EngineSession
+
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    port = int(os.environ["HOROVOD_CONTROLLER_PORT"])
+    mode = os.environ["FAULT_MODE"]
+    s = EngineSession(rank=rank, size=size, transport="tcp",
+                      addr="127.0.0.1", port=port, timeout_sec=60.0)
+    lib = bindings.load_library()
+
+    if mode == "star_allgatherv":
+        # small payload -> star path; rank 0 drops a byte of the packed
+        # broadcast (HOROVOD_DATA_FAULT_INJECT) -> every rank must see the
+        # size-validation error, not a silent short buffer
+        buf = np.full((rank + 1) * 8, float(rank), np.float32)
+        rank_bytes = (ctypes.c_int64 * size)()
+        total = lib.hvdtpu_data_allgatherv(s._session, buf.ctypes.data,
+                                           buf.nbytes, rank_bytes)
+        assert total < 0, f"truncated allgatherv not detected: {{total}}"
+    else:
+        # large payload -> ring path; every rank truncates its outgoing
+        # bundle on hop 0 -> corrupt-entry validation must fire everywhere
+        sends = [2048 for _ in range(size)]
+        data = np.full(sum(sends), float(rank), np.float32)
+        send_b = (ctypes.c_int64 * size)(*[c * 4 for c in sends])
+        recv_b = (ctypes.c_int64 * size)()
+        total = lib.hvdtpu_data_alltoallv(s._session, data.ctypes.data,
+                                          send_b, size, recv_b)
+        assert total < 0, f"corrupt alltoallv bundle not detected: {{total}}"
+
+    s.shutdown()
+    print(f"fault worker {{rank}} OK")
+""")
+
+
+@pytest.mark.parametrize("mode,fault,size", [
+    ("star_allgatherv", "truncate_star_allgatherv", 3),
+    ("ring_alltoallv", "truncate_ring_alltoallv", 4),
+])
+def test_data_plane_corruption_detected(tmp_path, mode, fault, size):
+    """Negative path for the round-5 advisor findings: a truncated star
+    Allgatherv broadcast and a corrupt RingAlltoallv bundle must surface as
+    errors on every rank instead of handing callers bad offsets."""
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(FAULT_WORKER.format(repo=REPO))
+    procs = []
+    for r in range(size):
+        env = dict(os.environ,
+                   HOROVOD_RANK=str(r), HOROVOD_SIZE=str(size),
+                   HOROVOD_CONTROLLER_PORT=str(port),
+                   HOROVOD_RING_THRESHOLD_BYTES="4096",
+                   HOROVOD_DATA_FAULT_INJECT=fault,
+                   FAULT_MODE=mode)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen([sys.executable, str(script)], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"fault worker {r} OK" in out
+
+
 def test_tcp_ring_data_plane(tmp_path):
     """Large payloads take the O(bytes)-per-rank ring path: numerics for
     sum/max/bcast plus the ring-ops counter proving the star was bypassed

@@ -287,6 +287,39 @@ def test_ssh_reachability_local_and_cache(tmp_path, monkeypatch):
     assert len(calls) == 1
 
 
+def test_ssh_cache_prunes_stale_and_keys_by_user(tmp_path, monkeypatch):
+    """ADVICE r5: entries older than the staleness window are dropped on
+    store (the file cannot grow unboundedly), and the key carries the
+    effective ssh user so one credential set's success is not trusted for
+    another."""
+    import json
+    import time as time_lib
+    from horovod_tpu.runner import launch as launch_lib
+
+    cache_file = tmp_path / "cache.json"
+    monkeypatch.setattr(launch_lib, "SSH_CACHE_FILE", str(cache_file))
+    now = time_lib.time()
+    stale_key = launch_lib._ssh_cache_key("old-host", None)
+    cache_file.write_text(json.dumps({
+        stale_key: now - launch_lib.SSH_CACHE_STALENESS_S - 10}))
+
+    def fake_run(cmd, **kw):
+        class R:
+            returncode = 0
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert launch_lib.check_hosts_ssh(["fakehost-b"]) == []
+    stored = json.loads(cache_file.read_text())
+    assert stale_key not in stored, "stale entry survived the store"
+    fresh_key = launch_lib._ssh_cache_key("fakehost-b", None)
+    assert fresh_key in stored
+    # the key is user-qualified: an explicit user@host maps to its own entry
+    assert launch_lib._ssh_cache_key("alice@h", 2222).startswith("alice@")
+    assert launch_lib._ssh_cache_key("alice@h", 2222) != \
+        launch_lib._ssh_cache_key("bob@h", 2222)
+
+
 def test_ssh_unreachable_host_fails_launch(tmp_path, monkeypatch):
     from horovod_tpu.runner import launch as launch_lib
 

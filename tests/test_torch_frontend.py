@@ -229,6 +229,54 @@ def test_torch_distributed_optimizer_training(tmp_path):
     """, size=2)
 
 
+def test_torch_sharded_distributed_optimizer(tmp_path):
+    """ZeRO-1 weight-update sharding (sharded=True): ranks own disjoint
+    ~1/N param partitions, optimizer state materializes only for owned
+    params, and post-step broadcasts keep ranks bit-identical."""
+    _run_workers(tmp_path, """
+        torch.manual_seed(7)
+        model = torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(), sharded=True)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+        g = torch.Generator().manual_seed(99)
+        X = torch.randn(32, 8, generator=g)
+        W = torch.randn(8, 1, generator=g)
+        Y = X @ W + 0.1 * torch.randn(32, 1, generator=g)
+        Xr, Yr = X[rank::size], Y[rank::size]
+
+        losses = []
+        for step in range(20):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(Xr), Yr)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses
+
+        # every param has exactly one owner, owners partition the set
+        owners = opt._owner
+        n_params = sum(1 for _ in model.parameters())
+        assert len(owners) == n_params
+        counts = hvd.allgather_object(
+            sum(1 for o in owners.values() if o == rank))
+        assert sum(counts) == n_params, counts
+        # momentum state exists ONLY for owned params (the 1/N memory win)
+        stateful = sum(1 for p in owners if len(opt.state[p]) > 0)
+        assert stateful == counts[rank], (stateful, counts)
+
+        # params identical across ranks after sharded training
+        blob = b"".join(p.detach().numpy().tobytes()
+                        for p in model.parameters())
+        import hashlib
+        digests = hvd.allgather_object(hashlib.sha256(blob).hexdigest())
+        assert len(set(digests)) == 1, digests
+    """, size=2)
+
+
 def test_torch_backward_passes_per_step_and_fp16(tmp_path):
     _run_workers(tmp_path, """
         torch.manual_seed(3)
