@@ -1,0 +1,106 @@
+"""One Perfetto trace showing engine negotiation beside device activity.
+
+The reference's timeline story (`HOROVOD_TIMELINE` + chrome://tracing,
+reference docs/timeline.rst) covers only the engine's half of a TPU step;
+the device half lives in a JAX profiler trace. This example produces BOTH
+in one process — a 2-rank loopback engine running eager allreduces through
+the C++ host data plane while a jitted compute loop runs under
+``jax.profiler.trace`` — and merges them with
+``horovod_tpu.profiler.merge_traces`` into a single file loadable in
+https://ui.perfetto.dev (or chrome://tracing).
+
+Run:  python examples/jax/jax_merged_trace.py [outdir]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.common import eager
+from horovod_tpu.engine import EngineSession
+from horovod_tpu.profiler import trace_merge
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="hvd_trace_")
+    os.makedirs(outdir, exist_ok=True)
+    timeline_path = os.path.join(outdir, "engine_timeline.json")
+    profile_dir = os.path.join(outdir, "jax_profile")
+    merged_path = os.path.join(outdir, "merged.trace.json")
+
+    n = 2
+    group = f"trace-example-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=n, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(n)]
+    try:
+        for s in sessions:
+            s.start_timeline(timeline_path)  # rank 0 writes; others no-op
+        executors = [eager.EagerExecutor(s) for s in sessions]
+
+        with jax.profiler.trace(profile_dir):
+            # Device half: a jitted matmul chain (the stand-in for a train
+            # step; on a TPU this shows up as MXU activity).
+            x = jnp.ones((256, 256), jnp.float32)
+            f = jax.jit(lambda x: x @ x / 256.0)
+            for _ in range(10):
+                x = f(x)
+            x.block_until_ready()
+
+            # Engine half: eager allreduces negotiated by the C++ engine
+            # and executed on the host data plane.
+            def work(rank, ex):
+                for i in range(5):
+                    name = f"grad/layer{i}"
+                    h = ex.submit(name, eager.OP_ALLREDUCE,
+                                  np.full(1 << 16, rank + 1, np.float32))
+                    ex.session.wait(h, timeout=0.0)
+                    ex.take_result(name)
+
+            threads = [threading.Thread(target=work, args=(r, ex))
+                       for r, ex in enumerate(executors)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for s in sessions:
+            s.stop_timeline()
+    finally:
+        # Two-phase teardown (all ranks shutdown, THEN all destroy) — the
+        # repo-wide idiom for multi-rank loopback groups (see
+        # tests/test_eager_ops.py): a rank destroyed while peers are still
+        # shutting down would wedge the loopback hub.
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+    merged = trace_merge.merge_traces(timeline_path, profile_dir,
+                                      merged_path)
+    engine_events = sum(
+        1 for e in merged["traceEvents"]
+        if e.get("pid") == trace_merge.DEFAULT_ENGINE_PID and
+        e.get("ph") in "BEi")
+    device_events = sum(
+        1 for e in merged["traceEvents"]
+        if e.get("pid") != trace_merge.DEFAULT_ENGINE_PID)
+    print(json.dumps({
+        "merged_trace": merged_path,
+        "engine_timeline_events": engine_events,
+        "jax_profiler_events": device_events,
+        "view_with": "https://ui.perfetto.dev (open the merged file)",
+    }))
+    assert engine_events > 0, "engine timeline produced no events"
+    assert device_events > 0, "jax profiler produced no events"
+
+
+if __name__ == "__main__":
+    main()

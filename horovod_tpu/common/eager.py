@@ -27,6 +27,10 @@ from horovod_tpu.engine.bindings import (
     DTYPE_IDS, DTYPE_NAMES,
     OP_ALLGATHER, OP_ALLREDUCE, OP_ALLTOALL, OP_BARRIER, OP_BROADCAST,
 )
+# jax-optional (no-op without jax): engine phases appear as host spans in a
+# JAX profiler trace, which profiler/trace_merge lines up with the engine's
+# own HOROVOD_TIMELINE lanes.
+from horovod_tpu.profiler.annotate import host_annotation
 
 
 class Handle:
@@ -88,12 +92,13 @@ class EagerExecutor:
             if splits is not None:
                 self._splits[name] = list(splits)
         try:
-            return self.session.enqueue(
-                name, op_type, arr.dtype.name, list(arr.shape),
-                root_rank=root_rank, reduce_op=REDUCE_KIND[reduce_op],
-                prescale_factor=prescale, postscale_factor=postscale,
-                group_id=group_id, group_size=group_size,
-                splits=splits)
+            with host_annotation(f"hvd_enqueue:{name}"):
+                return self.session.enqueue(
+                    name, op_type, arr.dtype.name, list(arr.shape),
+                    root_rank=root_rank, reduce_op=REDUCE_KIND[reduce_op],
+                    prescale_factor=prescale, postscale_factor=postscale,
+                    group_id=group_id, group_size=group_size,
+                    splits=splits)
         except Exception:
             with self._lock:
                 self._inputs.pop(name, None)
@@ -119,6 +124,13 @@ class EagerExecutor:
     # -- engine callback (background thread, lockstep across ranks) ----------
 
     def _execute(self, resp: dict) -> int:
+        # Negotiation has completed when the engine invokes this callback;
+        # the span covers the host data-plane execution of the response.
+        with host_annotation(
+                f"hvd_engine_exec:{resp.get('type', '?')}"):
+            return self._execute_response(resp)
+
+    def _execute_response(self, resp: dict) -> int:
         t = resp["type"]
         names = resp["names"]
         shapes = resp["shapes"]
@@ -464,7 +476,11 @@ def synchronize(handle, timeout: float = 0.0):
         return np.asarray(handle.result)
     ex = handle._executor
     try:
-        ex.session.wait(handle._engine_handle, timeout=timeout)
+        # Span covers QUEUE + NEGOTIATE + EXEC as seen from the caller —
+        # the host-side cost of the whole collective.
+        with host_annotation(
+                f"hvd_negotiate_wait:{handle._name or handle._engine_handle}"):
+            ex.session.wait(handle._engine_handle, timeout=timeout)
     except HorovodInternalError:
         if handle._name:
             ex.take_result(handle._name, aux_out=handle.aux)

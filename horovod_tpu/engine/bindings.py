@@ -50,7 +50,7 @@ _lib_lock = threading.Lock()
 
 # Must match hvdtpu_abi_version() in src/c_api.cc; bumped together with any
 # semantic ABI change so a stale prebuilt .so is rejected at load time.
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 
 def _lib_path() -> Path:
@@ -166,8 +166,24 @@ def load_library():
                                           ctypes.c_int64]
         lib.hvdtpu_data_ring_ops.restype = ctypes.c_int64
         lib.hvdtpu_data_ring_ops.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_bench_combine.restype = ctypes.c_double
+        lib.hvdtpu_bench_combine.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
         _lib = lib
         return _lib
+
+
+def bench_combine(dtype_name: str, num_elements: int, iters: int,
+                  scalar_baseline: bool = False) -> float:
+    """Payload bytes/s of the host SUM combine kernel (data_plane.cc).
+
+    ``scalar_baseline=True`` times the pre-vectorization per-element
+    fp16/bf16 kernel — the denominator of the bench's reported speedup.
+    Session-free: the kernel runs on local buffers, no transport."""
+    lib = load_library()
+    return float(lib.hvdtpu_bench_combine(
+        DTYPE_IDS[dtype_name], num_elements, iters,
+        1 if scalar_baseline else 0))
 
 
 def _env_float(name, default):

@@ -40,7 +40,16 @@ extern "C" {
 // changed return-code contracts). bindings.py refuses a prebuilt .so
 // whose version doesn't match, so a stale library fails loudly instead
 // of silently changing behavior.
-int32_t hvdtpu_abi_version() { return 3; }
+int32_t hvdtpu_abi_version() { return 4; }
+
+// Host data-plane microbenchmark: payload bytes/s of the SUM combine
+// kernel (bench.py --host-microbench). dtype per DataType ids;
+// scalar_baseline=1 times the pre-vectorization scalar kernel.
+double hvdtpu_bench_combine(int32_t dtype, int64_t num_elements,
+                            int32_t iters, int32_t scalar_baseline) {
+  return BenchCombineSum(static_cast<DataType>(dtype), num_elements, iters,
+                         scalar_baseline != 0);
+}
 
 // Collectives served by the ring data path (diagnostics/tests).
 int64_t hvdtpu_data_ring_ops(int64_t session) {

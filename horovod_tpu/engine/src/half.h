@@ -1,9 +1,16 @@
 // Half-precision (fp16 + bf16) conversion and accumulation.
 //
 // Reference analog: horovod/common/half.{h,cc} — fp16↔fp32 bit conversion
-// and vectorized CPU fp16 sum (AVX/F16C there; plain loops here, which the
-// compiler auto-vectorizes, plus bf16 which the reference lacks and a TPU
-// framework cannot ship without).
+// and vectorized CPU fp16 sum (AVX/F16C there). Two layers here:
+//
+// - scalar HalfToFloat/FloatToHalf: exact single-value conversions for the
+//   cold paths (ToDouble/FromDouble staging, Adasum).
+// - bulk *N converters: branch-free blocks the compiler auto-vectorizes,
+//   with a runtime-dispatched F16C fast path on x86 (8 halves per
+//   instruction) — the hot-path building blocks CombineHalf
+//   (data_plane.cc) reduces through. bf16 is shift-only and vectorizes
+//   for free (the reference lacks bf16, which a TPU framework cannot
+//   ship without).
 
 #ifndef HVD_TPU_HALF_H
 #define HVD_TPU_HALF_H
@@ -15,6 +22,14 @@ namespace hvdtpu {
 
 float HalfToFloat(uint16_t h);
 uint16_t FloatToHalf(float f);
+
+// Bulk conversions (dst/src must not alias). fp16 variants dispatch to
+// F16C when the CPU has it, else a branch-free autovectorizable loop;
+// rounding is to-nearest-even either way.
+void HalfToFloatN(const uint16_t* src, float* dst, int64_t n);
+void FloatToHalfN(const float* src, uint16_t* dst, int64_t n);
+void Bfloat16ToFloatN(const uint16_t* src, float* dst, int64_t n);
+void FloatToBfloat16N(const float* src, uint16_t* dst, int64_t n);
 
 inline float Bfloat16ToFloat(uint16_t b) {
   uint32_t bits = static_cast<uint32_t>(b) << 16;

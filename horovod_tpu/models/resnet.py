@@ -3,9 +3,26 @@
 Parity target: the reference benchmarks ResNet-50/101 data-parallel training
 (reference: docs/benchmarks.rst:9-43, examples/pytorch/
 pytorch_imagenet_resnet50.py, examples/pytorch/pytorch_synthetic_benchmark.py).
-This is a from-scratch flax.linen implementation, NHWC, with a dtype knob:
-bfloat16 activations/convs on the MXU with float32 params and batch-norm
-statistics (the standard TPU mixed-precision recipe).
+This is a from-scratch flax.linen implementation with an EXPLICIT TPU
+mixed-precision policy instead of a single dtype knob:
+
+- ``dtype`` (default fp32; the bench passes bf16): conv/matmul compute dtype
+  — what rides the MXU.
+- ``param_dtype`` (fp32): master weights, BN scale/bias AND the BN running
+  statistics. flax additionally force-float32s the batch-statistics
+  *reduction* itself (``_compute_stats(force_float32_reductions=True)``), so
+  with bf16 activations the mean/var accumulation never happens in bf16 —
+  the recipe the conv path's numerics depend on, pinned by
+  tests/test_profiler.py.
+- layout: NHWC is the TPU-native conv layout (channels on the 128-wide
+  lane dimension). ``input_layout="NCHW"`` transposes PyTorch-style inputs
+  once at entry instead of letting every conv do it implicitly.
+- ``pad_stem_to``: zero-pads the 3-channel image to a lane-friendlier
+  channel count (e.g. 8) before the 7x7 stem conv. Zero input channels
+  contribute exactly zero to the conv output, so the function is unchanged
+  (the stem filter just grows dead input slices) while the conv's innermost
+  contraction stops being a 3-deep tail that misaligns the (8,128) tiling.
+  Off by default: it changes the param tree shape (checkpoints).
 """
 
 from __future__ import annotations
@@ -17,6 +34,19 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 ModuleDef = Any
+
+
+def pad_channels_to_multiple(x: jnp.ndarray, multiple: int) -> jnp.ndarray:
+    """Zero-pad the trailing (channel) dim up to a multiple. Exact for convs:
+    zero channels contribute nothing to any output element."""
+    if multiple <= 1:
+        return x
+    c = x.shape[-1]
+    pad = (-c) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+    return jnp.pad(x, widths)
 
 
 class BottleneckBlock(nn.Module):
@@ -79,20 +109,35 @@ class ResNet(nn.Module):
     block_cls: ModuleDef
     num_classes: int = 1000
     num_filters: int = 64
-    dtype: Any = jnp.float32
+    dtype: Any = jnp.float32        # compute dtype (conv/matmul/BN outputs)
+    param_dtype: Any = jnp.float32  # master weights + BN scale/bias/stats
+    input_layout: str = "NHWC"      # or "NCHW" (transposed once at entry)
+    pad_stem_to: int = 0            # 0 = off; e.g. 8 pads RGB 3 -> 8 lanes
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        # BN computes in the model dtype (bf16 on TPU) — flax still
-        # accumulates the batch statistics in float32 and stores running
-        # stats/params as float32, so this is the standard TPU recipe;
-        # an all-fp32 BN forces casts + 2x HBM bytes around every one of
+        if x.ndim != 4:
+            raise ValueError(f"expected a rank-4 image batch, got {x.shape}")
+        if self.input_layout == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        elif self.input_layout != "NHWC":
+            raise ValueError(f"input_layout must be NHWC or NCHW, got "
+                             f"{self.input_layout!r}")
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 param_dtype=self.param_dtype)
+        # BN computes its *output* in the model dtype (bf16 on TPU); flax
+        # accumulates the batch statistics in float32 regardless
+        # (force_float32_reductions) and stores running stats + scale/bias
+        # in param_dtype (fp32) — the standard TPU recipe. An all-fp32 BN
+        # output path would force casts + 2x HBM bytes around every one of
         # the ~53 normalizations and costs ~25% of step time on v5e.
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
-                                 dtype=self.dtype)
+                                 dtype=self.dtype,
+                                 param_dtype=self.param_dtype)
         x = x.astype(self.dtype)
+        if self.pad_stem_to > 1:
+            x = pad_channels_to_multiple(x, self.pad_stem_to)
         x = conv(self.num_filters, (7, 7), (2, 2),
                  padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
@@ -105,7 +150,8 @@ class ResNet(nn.Module):
                                    strides=strides, conv=conv, norm=norm,
                                    act=nn.relu)(x)
         x = jnp.mean(x, axis=(1, 2))
-        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=self.param_dtype, name="head")(x)
         return x.astype(jnp.float32)
 
 

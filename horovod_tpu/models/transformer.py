@@ -14,15 +14,18 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from horovod_tpu.ops.flash_attention import flash_attention
+from horovod_tpu.ops.flash_attention import attention
 
 
 class FlashSelfAttention(nn.Module):
-    """Self-attention whose core is the Pallas flash kernel
+    """Self-attention whose core is the length-routed attention op
     (ops/flash_attention.py): same q/k/v/out projection geometry as
-    ``nn.MultiHeadDotProductAttention``, but the [T, T] score matrix never
-    touches HBM. Bidirectional (BERT) by default; set ``causal`` for
-    decoder use."""
+    ``nn.MultiHeadDotProductAttention``. At/above the measured crossover
+    (HOROVOD_FLASH_MIN_SEQ, default 1024) the Pallas flash kernel runs and
+    the [T, T] score matrix never touches HBM; below it plain XLA dot
+    attention wins (BENCH_r05: flash was 16% slower at seq 128) and the
+    router uses that instead. Bidirectional (BERT) by default; set
+    ``causal`` for decoder use."""
 
     heads: int
     dtype: Any = jnp.bfloat16
@@ -39,7 +42,7 @@ class FlashSelfAttention(nn.Module):
         q = nn.DenseGeneral(name="query", **proj)(x)
         k = nn.DenseGeneral(name="key", **proj)(x)
         v = nn.DenseGeneral(name="value", **proj)(x)
-        o = flash_attention(q, k, v, causal=self.causal)
+        o = attention(q, k, v, causal=self.causal)
         return nn.DenseGeneral(features=d, axis=(-2, -1), dtype=self.dtype,
                                name="out")(o)
 

@@ -28,6 +28,7 @@ from jax import lax
 from horovod_tpu.common.reduce_ops import (  # noqa: F401  (re-exported)
     Adasum, Average, Max, Min, Op, Product, Sum,
 )
+from horovod_tpu.profiler.annotate import collective_scope
 
 # Default axis: data parallelism — the reference's only axis (SURVEY §2.8).
 DEFAULT_AXIS = "data"
@@ -77,6 +78,13 @@ def allreduce(x: jax.Array,
     ``accumulate_in_fp32=False`` keeps low-precision inputs in their dtype on
     the wire — the point of fp16/bf16 compression (half the ICI bytes);
     compressed paths set it."""
+    with collective_scope(f"hvd_allreduce_{op.value}"):
+        return _allreduce(x, op, axis, prescale_factor, postscale_factor,
+                          accumulate_in_fp32)
+
+
+def _allreduce(x, op, axis, prescale_factor, postscale_factor,
+               accumulate_in_fp32):
     x = _scale(x, prescale_factor)
     if op in (Average, Sum):
         # Default: sum in fp32 for low-precision inputs — same accumulation
@@ -164,6 +172,14 @@ def hierarchical_allreduce(x: jax.Array,
                          prescale_factor=prescale_factor,
                          postscale_factor=postscale_factor,
                          accumulate_in_fp32=accumulate_in_fp32)
+    with collective_scope(f"hvd_hierarchical_allreduce_{op.value}"):
+        return _hierarchical_allreduce(
+            x, op, outer_axis, inner_axis, prescale_factor,
+            postscale_factor, accumulate_in_fp32)
+
+
+def _hierarchical_allreduce(x, op, outer_axis, inner_axis, prescale_factor,
+                            postscale_factor, accumulate_in_fp32):
     x = _scale(x, prescale_factor)
     orig_dtype = x.dtype
     orig_shape = x.shape
@@ -196,20 +212,22 @@ def allgather(x: jax.Array, axis=DEFAULT_AXIS) -> jax.Array:
     per-rank sizes) are handled by the eager engine path via padding
     (horovod_tpu.jax.mpi_ops).
     """
-    return lax.all_gather(x, axis, axis=0, tiled=True)
+    with collective_scope("hvd_allgather"):
+        return lax.all_gather(x, axis, axis=0, tiled=True)
 
 
 def broadcast(x: jax.Array, root_rank: int, axis=DEFAULT_AXIS) -> jax.Array:
     """Every rank receives root's value (reference: EnqueueTensorBroadcast,
     operations.cc:1062). Implemented as a masked psum — a single collective,
     no gather of all shards."""
-    idx = axis_rank(axis)
-    orig_dtype = x.dtype
-    xf = x.astype(jnp.float32) if orig_dtype in (jnp.float16, jnp.bfloat16, jnp.bool_) \
-        else x
-    masked = jnp.where(idx == root_rank, xf, jnp.zeros_like(xf))
-    out = lax.psum(masked, axis)
-    return out.astype(orig_dtype)
+    with collective_scope("hvd_broadcast"):
+        idx = axis_rank(axis)
+        orig_dtype = x.dtype
+        xf = x.astype(jnp.float32) \
+            if orig_dtype in (jnp.float16, jnp.bfloat16, jnp.bool_) else x
+        masked = jnp.where(idx == root_rank, xf, jnp.zeros_like(xf))
+        out = lax.psum(masked, axis)
+        return out.astype(orig_dtype)
 
 
 def alltoall(x: jax.Array,
@@ -219,8 +237,9 @@ def alltoall(x: jax.Array,
     """Scatter equal slices of ``x`` to every rank and gather their slices
     (reference: EnqueueTensorAlltoall, operations.cc:1101; even-split case of
     MPI_Alltoallv). Ragged splits go through the eager engine path."""
-    return lax.all_to_all(x, axis, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    with collective_scope("hvd_alltoall"):
+        return lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
 
 
 def reducescatter(x: jax.Array, op: Op = Average, axis=DEFAULT_AXIS) -> jax.Array:
@@ -230,10 +249,11 @@ def reducescatter(x: jax.Array, op: Op = Average, axis=DEFAULT_AXIS) -> jax.Arra
     psum_scatter is the natural TPU gradient-sharding primitive."""
     if op not in (Average, Sum):
         raise ValueError(f"reducescatter supports Sum/Average, got {op}")
-    out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
-    if op is Average:
-        out = (out.astype(jnp.float32) / axis_size(axis)).astype(x.dtype)
-    return out
+    with collective_scope(f"hvd_reducescatter_{op.value}"):
+        out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+        if op is Average:
+            out = (out.astype(jnp.float32) / axis_size(axis)).astype(x.dtype)
+        return out
 
 
 def quantized_reducescatter(x: jax.Array,
@@ -254,18 +274,20 @@ def quantized_reducescatter(x: jax.Array,
     if op not in (Average, Sum):
         raise ValueError(f"quantized_reducescatter supports Sum/Average, "
                          f"got {op}")
-    n = axis_size(axis)
-    rows = x.reshape(n, -1)
-    payload, scales = block_quantize_rows(rows, block_size)
-    # Row d goes to rank d; we receive rank s's row-for-us as row s.
-    payload = lax.all_to_all(payload, axis, split_axis=0, concat_axis=0,
-                             tiled=True)
-    scales = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
-                            tiled=True)
-    out = jnp.sum(block_dequantize_rows(payload, scales, block_size), axis=0)
-    if op is Average:
-        out = out / n
-    return out
+    with collective_scope(f"hvd_quantized_reducescatter_{op.value}"):
+        n = axis_size(axis)
+        rows = x.reshape(n, -1)
+        payload, scales = block_quantize_rows(rows, block_size)
+        # Row d goes to rank d; we receive rank s's row-for-us as row s.
+        payload = lax.all_to_all(payload, axis, split_axis=0, concat_axis=0,
+                                 tiled=True)
+        scales = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+        out = jnp.sum(block_dequantize_rows(payload, scales, block_size),
+                      axis=0)
+        if op is Average:
+            out = out / n
+        return out
 
 
 def quantized_allgather(x: jax.Array,
@@ -275,13 +297,14 @@ def quantized_allgather(x: jax.Array,
     fp32 scales; returns the concatenated fp32 array (rank order, dim 0)."""
     from horovod_tpu.jax.compression import (block_dequantize_rows,
                                              block_quantize_rows)
-    payload, scales = block_quantize_rows(x.reshape(1, -1), block_size)
-    payload = lax.all_gather(payload, axis, axis=0, tiled=False)
-    scales = lax.all_gather(scales, axis, axis=0, tiled=False)
-    n = payload.shape[0]
-    out = block_dequantize_rows(payload.reshape(n, -1),
-                                scales.reshape(n, -1), block_size)
-    return out.reshape(-1)
+    with collective_scope("hvd_quantized_allgather"):
+        payload, scales = block_quantize_rows(x.reshape(1, -1), block_size)
+        payload = lax.all_gather(payload, axis, axis=0, tiled=False)
+        scales = lax.all_gather(scales, axis, axis=0, tiled=False)
+        n = payload.shape[0]
+        out = block_dequantize_rows(payload.reshape(n, -1),
+                                    scales.reshape(n, -1), block_size)
+        return out.reshape(-1)
 
 
 def quantized_allreduce(x: jax.Array,

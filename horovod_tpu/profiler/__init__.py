@@ -1,0 +1,64 @@
+"""Profiling / performance-accounting subsystem.
+
+The reference's perf methodology is timeline-driven (HOROVOD_TIMELINE,
+reference: horovod/common/timeline.cc, docs/timeline.rst): you can't fix what
+you can't attribute. This package is the TPU-native version of that story,
+split into four layers:
+
+- :mod:`~horovod_tpu.profiler.flops` — per-step FLOPs accounting via XLA's
+  own ``jit(...).lower().compile().cost_analysis()`` with analytic fallbacks
+  for the flagship models (the numbers ``bench.py`` used to hardcode).
+- :mod:`~horovod_tpu.profiler.mfu` — the one shared MFU/throughput
+  calculator (chip bf16 peak table + utilization math) that the bench, tests
+  and docs all consume, so the accounting cannot drift between them.
+- :mod:`~horovod_tpu.profiler.annotate` — ``jax.named_scope`` wrapping for
+  in-jit collectives (shows up as HLO op metadata in device traces) and
+  ``jax.profiler.TraceAnnotation`` wrapping for host-side engine negotiation
+  (shows up in the JAX host trace). jax-optional: the annotations degrade to
+  no-ops so the torch/TF frontends can import this without pulling in JAX.
+- :mod:`~horovod_tpu.profiler.trace_merge` — the bridge that merges the C++
+  engine timeline (engine/src/timeline.cc, Chrome-trace JSON) with a JAX
+  profiler trace into ONE Perfetto-loadable view: engine negotiation lanes
+  beside device activity.
+
+Import is lazy (PEP 562) so ``horovod_tpu.profiler.annotate`` stays usable
+from jax-free processes.
+"""
+
+from __future__ import annotations
+
+_SUBMODULE_EXPORTS = {
+    # flops
+    "FlopsEstimate": "flops",
+    "compiled_flops": "flops",
+    "executable_flops": "flops",
+    "train_step_flops": "flops",
+    "resnet50_train_flops_per_image": "flops",
+    "transformer_train_flops_per_seq": "flops",
+    # mfu
+    "PEAK_TFLOPS_BF16": "mfu",
+    "peak_tflops": "mfu",
+    "mfu": "mfu",
+    "mfu_report": "mfu",
+    # annotate
+    "collective_scope": "annotate",
+    "host_annotation": "annotate",
+    # trace_merge
+    "load_engine_timeline": "trace_merge",
+    "find_jax_trace": "trace_merge",
+    "merge_traces": "trace_merge",
+}
+
+__all__ = sorted(_SUBMODULE_EXPORTS) + [
+    "annotate", "flops", "mfu", "trace_merge",
+]
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("annotate", "flops", "mfu", "trace_merge"):
+        return importlib.import_module(f"{__name__}.{name}")
+    mod = _SUBMODULE_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)

@@ -1,0 +1,54 @@
+"""Trace annotations bridging the framework into JAX profiler traces.
+
+Two distinct mechanisms, matching where the work actually happens:
+
+- :func:`collective_scope` — ``jax.named_scope`` for code that runs INSIDE a
+  jitted program (the in-jit collectives of ``parallel/collectives.py``).
+  The scope becomes HLO op-name metadata, so the device trace of a bench
+  step shows ``hvd_allreduce_average/...`` spans on the TPU lanes.
+- :func:`host_annotation` — ``jax.profiler.TraceAnnotation`` for host-side
+  work (eager engine enqueue, negotiation wait, the data-plane execute
+  callback). These appear on the Python/host threads of the same JAX
+  profiler trace, which is what lets :mod:`~horovod_tpu.profiler.trace_merge`
+  line engine activity up beside device activity.
+
+Both degrade to cheap no-ops when jax is not importable — the torch/TF
+frontends and the engine executor (``common/eager.py``) must stay usable in
+jax-free processes (reference analog: the timeline is always-on
+infrastructure, never a hard dependency).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _null_scope():
+    yield
+
+
+def collective_scope(name: str):
+    """Name the enclosed traced ops in HLO metadata (device-trace visible).
+
+    Usable as a context manager around collective construction inside a
+    jitted/shard_mapped function."""
+    try:
+        import jax
+    except ImportError:
+        return _null_scope()
+    return jax.named_scope(name)
+
+
+def host_annotation(name: str, **kwargs):
+    """Annotate a host-side span in the JAX profiler trace (no-op without
+    jax, and free when no trace is being collected)."""
+    try:
+        import jax
+        annotation = jax.profiler.TraceAnnotation
+    except (ImportError, AttributeError):
+        return _null_scope()
+    try:
+        return annotation(name, **kwargs)
+    except Exception:
+        return _null_scope()

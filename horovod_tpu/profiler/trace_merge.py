@@ -1,0 +1,175 @@
+"""Merge the C++ engine timeline with a JAX profiler trace.
+
+The engine writes a Chrome-trace JSON array (engine/src/timeline.cc, the
+reference's ``HOROVOD_TIMELINE`` format: one lane per tensor, QUEUE →
+NEGOTIATE → EXEC phases). The JAX profiler writes a Chrome/Perfetto trace
+(``jax.profiler.start_trace``) with host threads and device lanes. Each view
+alone answers half the question — this bridge rewrites the engine events
+into their own process group of the JAX trace so ONE Perfetto-loadable file
+shows engine negotiation/communication beside device activity
+(reference analog: docs/timeline.rst, VERDICT item 10).
+
+Clock caveat: the engine timeline's timestamps are relative to its
+``Initialize`` (steady clock), the JAX trace's to the profiler session
+start. ``offset_us`` shifts the engine lanes for best-effort alignment;
+without it the merged view is structurally correct (both timelines visible,
+each internally exact) but the absolute skew between the two processes is
+unknowable after the fact — start the profiler and the timeline together to
+keep it small.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import io
+import json
+import os
+from typing import Any, Iterable, List, Optional, Union
+
+TraceLike = Union[str, os.PathLike, dict, list, None]
+
+# Engine lanes get their own pid, far from real host pids.
+DEFAULT_ENGINE_PID = 90210
+
+
+def _read_text(path: str) -> str:
+    if str(path).endswith(".gz"):
+        with gzip.open(path, "rt", encoding="utf-8", errors="replace") as f:
+            return f.read()
+    with io.open(path, "r", encoding="utf-8", errors="replace") as f:
+        return f.read()
+
+
+def load_engine_timeline(path: Union[str, os.PathLike]) -> List[dict]:
+    """Parse the engine timeline JSON array, tolerating a missing closing
+    bracket (a killed process never runs Timeline::Shutdown) and a trailing
+    comma."""
+    text = _read_text(str(path)).strip()
+    if not text:
+        return []
+    try:
+        events = json.loads(text)
+    except json.JSONDecodeError:
+        # A killed writer can stop anywhere: after a record + comma, or
+        # mid-record. Truncate at the end of the last COMPLETE record
+        # (events are flat objects, so their closing brace is the last
+        # '}'), drop the partial tail, and close the array.
+        cut = text.rfind("}")
+        if cut < 0:
+            return []
+        fixed = text[:cut + 1].rstrip().rstrip(",")
+        if not fixed.endswith("]"):
+            fixed += "]"
+        events = json.loads(fixed)
+    if not isinstance(events, list):
+        raise ValueError(f"engine timeline {path} is not a JSON array")
+    return [e for e in events if isinstance(e, dict)]
+
+
+def find_jax_trace(logdir: Union[str, os.PathLike]) -> Optional[str]:
+    """Locate the trace file ``jax.profiler.start_trace(logdir)`` wrote
+    (``<logdir>/plugins/profile/<run>/<host>.trace.json.gz``); newest wins."""
+    logdir = str(logdir)
+    if os.path.isfile(logdir):
+        return logdir
+    hits: List[str] = []
+    for pattern in ("*.trace.json.gz", "*.trace.json"):
+        hits += glob.glob(os.path.join(logdir, "**", pattern),
+                          recursive=True)
+    if not hits:
+        return None
+    return max(hits, key=os.path.getmtime)
+
+
+def _load_trace_events(trace: TraceLike) -> List[dict]:
+    """Events from a Chrome-trace object/array, a path to one (.json/.gz),
+    or a profiler logdir."""
+    if trace is None:
+        return []
+    if isinstance(trace, dict):
+        return list(trace.get("traceEvents", []))
+    if isinstance(trace, list):
+        return list(trace)
+    path = find_jax_trace(trace)
+    if path is None:
+        return []
+    data = json.loads(_read_text(path))
+    if isinstance(data, dict):
+        return list(data.get("traceEvents", []))
+    return list(data) if isinstance(data, list) else []
+
+
+def _meta(pid: int, tid: int, name: str, value: str) -> dict:
+    return {"ph": "M", "pid": pid, "tid": tid, "name": name,
+            "args": {"name": value}}
+
+
+def _rewrite_engine_events(events: Iterable[dict], engine_pid: int,
+                           engine_label: str,
+                           offset_us: float) -> List[dict]:
+    """Move engine events into their own process group: integer tids (one
+    per tensor lane, Perfetto wants ints) + thread_name metadata carrying
+    the original lane name, pid remapped, timestamps shifted."""
+    out: List[dict] = [_meta(engine_pid, 0, "process_name", engine_label)]
+    tid_of: dict = {}
+    for e in events:
+        lane = str(e.get("tid", ""))
+        tid = tid_of.get(lane)
+        if tid is None:
+            tid = len(tid_of) + 1
+            tid_of[lane] = tid
+            out.append(_meta(engine_pid, tid, "thread_name", lane))
+        ev = dict(e)
+        ev["pid"] = engine_pid
+        ev["tid"] = tid
+        if offset_us:
+            ev["ts"] = float(ev.get("ts", 0)) + offset_us
+        out.append(ev)
+    return out
+
+
+def merge_traces(engine_timeline: TraceLike,
+                 jax_trace: TraceLike = None,
+                 out_path: Optional[Union[str, os.PathLike]] = None,
+                 *,
+                 engine_pid: int = DEFAULT_ENGINE_PID,
+                 engine_label: str = "horovod engine",
+                 offset_us: float = 0.0) -> dict:
+    """Produce one Perfetto-compatible Chrome trace combining both views.
+
+    ``engine_timeline``: path to the ``HOROVOD_TIMELINE`` file (or
+    pre-loaded events). ``jax_trace``: profiler logdir, trace file path, or
+    pre-loaded trace (optional — merging with nothing still normalizes the
+    engine timeline into a loadable trace). Returns the merged trace dict;
+    writes it to ``out_path`` when given (gzipped iff it ends in ``.gz``).
+    """
+    if isinstance(engine_timeline, (str, os.PathLike)):
+        engine_events = load_engine_timeline(engine_timeline)
+    elif isinstance(engine_timeline, dict):
+        engine_events = list(engine_timeline.get("traceEvents", []))
+    else:
+        engine_events = list(engine_timeline or [])
+
+    merged = _rewrite_engine_events(engine_events, engine_pid, engine_label,
+                                    offset_us)
+    merged += _load_trace_events(jax_trace)
+    trace = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "producer": "horovod_tpu.profiler.trace_merge",
+            "engine_pid": engine_pid,
+            "engine_offset_us": offset_us,
+        },
+    }
+    if out_path is not None:
+        out_path = str(out_path)
+        payload = json.dumps(trace)
+        if out_path.endswith(".gz"):
+            with gzip.open(out_path, "wt", encoding="utf-8") as f:
+                f.write(payload)
+        else:
+            with io.open(out_path, "w", encoding="utf-8") as f:
+                f.write(payload)
+    return trace

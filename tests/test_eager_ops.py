@@ -324,3 +324,55 @@ def test_timeline_marks_frontend_phases(ring, tmp_path):
     assert "MEMCPY_IN_FUSION_BUFFER" in names, names
     assert "COMMUNICATE_ALLREDUCE" in names, names
     assert "MEMCPY_OUT_FUSION_BUFFER" in names, names
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_eager_allreduce_half_simd_sizes(ring, dtype):
+    """The vectorized half/bf16 combine kernel (data_plane.cc CombineHalf →
+    half.cc blocked bulk converters) at sizes that exercise the F16C/SIMD
+    main loop, the scalar tail AND the multi-block path (block = 2048
+    elements) — the 6-element test above never leaves the tail loop."""
+    import ml_dtypes
+    np_dtype = dict(bfloat16=ml_dtypes.bfloat16).get(dtype, dtype)
+    n_elem = 2048 * 2 + 13  # two full blocks + a non-multiple-of-8 tail
+
+    def fn(r, ex):
+        x = ((np.arange(n_elem) % 31) * 0.25 + r).astype(np_dtype)
+        return submit_wait(ex, "big", _OP_ALLREDUCE, x, reduce_op=Sum)
+
+    outs = run_all(ring, fn)
+    expected = sum(((np.arange(n_elem) % 31) * 0.25 + r).astype(np_dtype)
+                   .astype(np.float64) for r in range(N))
+    for out in outs:
+        assert out.dtype == np_dtype
+        np.testing.assert_allclose(np.asarray(out, np.float64), expected,
+                                   rtol=1e-2)
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_eager_allreduce_half_min_max(ring, dtype):
+    """MIN/MAX ride CombineHalf's blocked non-sum path; they must be exact
+    (selection, no rounding)."""
+    import ml_dtypes
+    from horovod_tpu.parallel.collectives import Max, Min
+    np_dtype = dict(bfloat16=ml_dtypes.bfloat16).get(dtype, dtype)
+    n_elem = 2048 + 9
+
+    def fn(r, ex):
+        base = ((np.arange(n_elem) * 7) % 23 - 11).astype(np_dtype)
+        x = np.where(np.arange(n_elem) % N == r, base,
+                     np.zeros(1, np_dtype))
+        got_min = submit_wait(ex, "mn", _OP_ALLREDUCE, x, reduce_op=Min)
+        got_max = submit_wait(ex, "mx", _OP_ALLREDUCE, x, reduce_op=Max)
+        return got_min, got_max
+
+    outs = run_all(ring, fn)
+    base = ((np.arange(n_elem) * 7) % 23 - 11).astype(np_dtype)
+    stack = np.stack([
+        np.where(np.arange(n_elem) % N == r, base, np.zeros(1, np_dtype))
+        for r in range(N)]).astype(np.float64)
+    for got_min, got_max in outs:
+        np.testing.assert_array_equal(np.asarray(got_min, np.float64),
+                                      stack.min(0))
+        np.testing.assert_array_equal(np.asarray(got_max, np.float64),
+                                      stack.max(0))

@@ -1,0 +1,321 @@
+"""Profiler subsystem (horovod_tpu/profiler): MFU arithmetic against
+hand-computed FLOPs, the cost-analysis-vs-analytic fallback contract, the
+engine-timeline + JAX-trace merge bridge, and the conv-path mixed-precision
+policy regression (bf16 compute must keep BN statistics in fp32)."""
+
+import glob
+import json
+import os
+import threading
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.profiler import flops as pflops
+from horovod_tpu.profiler import mfu as pmfu
+from horovod_tpu.profiler import trace_merge
+from horovod_tpu.profiler.flops import FlopsEstimate
+
+
+# ---------------------------------------------------------------------------
+# FLOPs accounting
+
+
+def test_compiled_flops_matches_hand_matmul():
+    m, k, n = 256, 512, 128
+    got = pflops.compiled_flops(jax.jit(lambda a, b: a @ b),
+                                jnp.ones((m, k)), jnp.ones((k, n)))
+    assert got is not None
+    hand = pflops.dense_flops(m, k, n)  # 2*m*k*n
+    # XLA's cost model counts the same MACs; allow fusion slack.
+    assert 0.8 <= got / hand <= 1.3
+
+
+def test_train_step_flops_tiny_model_matches_hand():
+    """End-to-end: value_and_grad of a one-matmul model costs ~3x the
+    forward (fwd + two backward matmuls) — the same fwd/bwd ratio the
+    analytic ResNet/transformer models assume."""
+    m, k, n = 128, 256, 64
+    w = jnp.ones((k, n))
+    x = jnp.ones((m, k))
+
+    def loss(w, x):
+        return jnp.sum(x @ w)
+
+    step = jax.jit(jax.grad(loss))
+    est = pflops.train_step_flops(step, (w, x))
+    assert est.source == "xla_cost_analysis"
+    fwd = pflops.dense_flops(m, k, n)
+    # grad-of-matmul = one backward matmul (dw = x^T @ dy) after XLA DCE's
+    # the unused primal; accept anything from 1x to 4x the forward cost.
+    assert fwd * 0.5 <= est.flops <= fwd * 4.0
+
+
+def test_cost_analysis_result_shapes():
+    f = pflops._flops_from_cost_analysis
+    assert f([{"flops": 10.0}]) == 10.0     # jax <= 0.4.x list form
+    assert f({"flops": 7.0}) == 7.0         # newer dict form
+    assert f([]) is None
+    assert f({"bytes accessed": 1.0}) is None
+    assert f(None) is None
+    assert f({"flops": float("nan")}) is None
+
+
+def test_fallback_path_when_cost_analysis_unavailable():
+    # object() has no .lower and jax.jit refuses it -> compiled_flops None
+    est = pflops.train_step_flops(object(), (), fallback_flops=123.0,
+                                  fallback_detail="hand model")
+    assert est.source == "analytic"
+    assert est.flops == 123.0
+    assert bool(est)
+
+
+def test_no_fallback_reports_unavailable():
+    est = pflops.train_step_flops(object(), ())
+    assert est.source == "unavailable"
+    assert not bool(est)
+
+
+def test_analytic_models():
+    assert pflops.resnet50_train_flops_per_image() == pytest.approx(
+        3 * 4.09e9)
+    assert pflops.resnet50_train_flops_per_image(train=False) == \
+        pytest.approx(4.09e9)
+    assert pflops.transformer_train_flops_per_seq(110e6, 128) == \
+        pytest.approx(6 * 110e6 * 128)
+
+
+# ---------------------------------------------------------------------------
+# MFU calculator
+
+
+def test_mfu_arithmetic_exact():
+    # 100 items/s * 1e9 FLOP/item = 1e11 FLOP/s on a 1-TFLOP chip = 10%
+    assert pmfu.mfu(100.0, 1e9, 1.0) == pytest.approx(0.1)
+
+
+def test_mfu_rejects_unusable_inputs():
+    assert pmfu.mfu(0.0, 1e9, 100.0) == -1.0
+    assert pmfu.mfu(10.0, -1.0, 100.0) == -1.0
+    assert pmfu.mfu(10.0, 1e9, -1.0) == -1.0
+
+
+def test_peak_table_prefix_match():
+    assert pmfu.peak_tflops("TPU v5 lite") == 197.0
+    assert pmfu.peak_tflops("TPU v4 (something)") == 275.0
+    assert pmfu.peak_tflops("GPU A100") == -1.0
+
+
+def test_mfu_report_provenance():
+    est = FlopsEstimate(1e9, "analytic", "hand")
+    rep = pmfu.mfu_report(100.0, est, 1.0)
+    assert rep["mfu"] == pytest.approx(0.1)
+    assert rep["flops_source"] == "analytic"
+    assert rep["peak_tflops_bf16"] == 1.0
+    # unusable throughput must surface as -1, never 0% or a crash
+    assert pmfu.mfu_report(-1.0, est, 1.0)["mfu"] == -1.0
+
+
+def test_bench_consumes_shared_calculator():
+    """bench.py must use the profiler's constants, not re-hardcode them."""
+    import bench
+    assert bench.RESNET50_PARAMS == pflops.RESNET50_PARAMS
+    assert bench.BERT_TRAIN_FLOPS_PER_SEQ == pytest.approx(
+        pflops.transformer_train_flops_per_seq(pflops.BERT_BASE_PARAMS, 128))
+
+
+# ---------------------------------------------------------------------------
+# Trace merge bridge
+
+
+ENGINE_EVENTS = (
+    '[\n'
+    '{"ph":"B","name":"NEGOTIATE_ALLREDUCE","pid":0,"tid":"grad/w",'
+    '"ts":10},\n'
+    '{"ph":"i","name":"0","pid":0,"tid":"grad/w","ts":12,"s":"t"},\n'
+    '{"ph":"E","name":"","pid":0,"tid":"grad/w","ts":20}'
+)
+
+
+def test_engine_timeline_tolerant_parse(tmp_path):
+    clean = tmp_path / "clean.json"
+    clean.write_text(ENGINE_EVENTS + "\n]\n")
+    assert len(trace_merge.load_engine_timeline(clean)) == 3
+    # killed process: no closing bracket, trailing comma
+    torn = tmp_path / "torn.json"
+    torn.write_text(ENGINE_EVENTS + ",")
+    events = trace_merge.load_engine_timeline(torn)
+    assert len(events) == 3
+    assert events[0]["name"] == "NEGOTIATE_ALLREDUCE"
+    # killed MID-RECORD: the partial tail is dropped, complete events kept
+    mid = tmp_path / "mid.json"
+    mid.write_text(ENGINE_EVENTS + ',\n{"ph":"B","na')
+    assert len(trace_merge.load_engine_timeline(mid)) == 3
+    # nothing complete at all
+    empty = tmp_path / "empty.json"
+    empty.write_text('[\n{"ph":"B","na')
+    assert trace_merge.load_engine_timeline(empty) == []
+
+
+def test_merge_normalizes_engine_lanes(tmp_path):
+    timeline = tmp_path / "t.json"
+    timeline.write_text(ENGINE_EVENTS + "\n]\n")
+    out = tmp_path / "merged.json"
+    merged = trace_merge.merge_traces(timeline, None, out, offset_us=5.0)
+    data = json.loads(out.read_text())
+    assert data == merged
+    evs = data["traceEvents"]
+    # engine events got the engine pid, integer tids, shifted timestamps
+    engine = [e for e in evs if e.get("ph") in "BEi"]
+    assert engine and all(e["pid"] == trace_merge.DEFAULT_ENGINE_PID
+                          for e in engine)
+    assert all(isinstance(e["tid"], int) for e in engine)
+    assert engine[0]["ts"] == 15.0
+    # lane name preserved via thread_name metadata
+    metas = [e for e in evs if e.get("ph") == "M"]
+    assert any(e["name"] == "thread_name" and
+               e["args"]["name"] == "grad/w" for e in metas)
+
+
+def test_merged_trace_engine_beside_device_activity(tmp_path):
+    """The VERDICT-item-10 smoke: a REAL engine timeline (loopback
+    sessions running an allreduce through the C++ data plane) merged with
+    a REAL JAX profiler trace into one loadable Perfetto JSON."""
+    from horovod_tpu.engine import EngineSession
+    from horovod_tpu.common import eager
+
+    timeline_path = tmp_path / "engine_timeline.json"
+    group = f"trace-{uuid.uuid4().hex[:8]}"
+    n = 2
+    sessions = [EngineSession(rank=r, size=n, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(n)]
+    try:
+        for s in sessions:
+            s.start_timeline(str(timeline_path))  # coordinator-only write
+        executors = [eager.EagerExecutor(s) for s in sessions]
+
+        profile_dir = tmp_path / "jaxprof"
+        with jax.profiler.trace(str(profile_dir)):
+            jax.jit(lambda x: x @ x)(jnp.ones((64, 64))).block_until_ready()
+
+            def work(ex):
+                h = ex.submit("grad/w", eager.OP_ALLREDUCE,
+                              np.ones(8, np.float32))
+                ex.session.wait(h, timeout=0.0)
+                ex.take_result("grad/w")
+
+            threads = [threading.Thread(target=work, args=(ex,))
+                       for ex in executors]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for s in sessions:
+            s.stop_timeline()
+    finally:
+        # Two-phase teardown (all ranks shutdown, THEN all destroy) — the
+        # repo-wide idiom for multi-rank loopback groups (see
+        # tests/test_eager_ops.py): a rank destroyed while peers are still
+        # shutting down would wedge the loopback hub.
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+    assert timeline_path.exists()
+    jax_trace = trace_merge.find_jax_trace(profile_dir)
+    assert jax_trace is not None, (
+        f"no jax trace under {profile_dir}: "
+        f"{glob.glob(str(profile_dir / '**' / '*'), recursive=True)}")
+    out = tmp_path / "merged.trace.json"
+    merged = trace_merge.merge_traces(timeline_path, profile_dir, out)
+
+    data = json.loads(out.read_text())  # loadable
+    evs = data["traceEvents"]
+    engine_evs = [e for e in evs
+                  if e.get("pid") == trace_merge.DEFAULT_ENGINE_PID and
+                  e.get("ph") in "BEi"]
+    other_evs = [e for e in evs
+                 if e.get("pid") != trace_merge.DEFAULT_ENGINE_PID]
+    assert engine_evs, "engine timeline events missing from merged trace"
+    assert other_evs, "jax profiler events missing from merged trace"
+    # the negotiation phases the reference timeline contract promises
+    names = {e.get("name", "") for e in engine_evs}
+    assert any(n.startswith("NEGOTIATE_") or n.startswith("COMMUNICATE_")
+               or n in ("QUEUE", "EXEC") for n in names), names
+    assert merged["metadata"]["engine_pid"] == trace_merge.DEFAULT_ENGINE_PID
+
+
+# ---------------------------------------------------------------------------
+# Conv-path mixed-precision policy regression
+
+
+def _tiny_resnet(**kw):
+    from horovod_tpu.models.resnet import ResNet, ResNetBlock
+    return ResNet(stage_sizes=[1, 1], block_cls=ResNetBlock, num_classes=10,
+                  num_filters=8, **kw)
+
+
+def test_bf16_policy_keeps_bn_statistics_fp32():
+    model = _tiny_resnet(dtype=jnp.bfloat16, param_dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3), jnp.bfloat16)
+    variables = model.init(jax.random.key(0), x, train=True)
+
+    def dtypes(tree):
+        return {leaf.dtype for leaf in jax.tree_util.tree_leaves(tree)}
+
+    assert dtypes(variables["params"]) == {jnp.dtype(jnp.float32)}
+    assert dtypes(variables["batch_stats"]) == {jnp.dtype(jnp.float32)}
+
+    # one train-mode apply: the UPDATED running stats must still be fp32
+    # and finite (the stat reduction ran in fp32, not bf16)
+    logits, mutated = model.apply(variables, x, train=True,
+                                  mutable=["batch_stats"])
+    assert dtypes(mutated["batch_stats"]) == {jnp.dtype(jnp.float32)}
+    assert all(bool(jnp.all(jnp.isfinite(leaf)))
+               for leaf in jax.tree_util.tree_leaves(mutated["batch_stats"]))
+    assert logits.dtype == jnp.float32
+
+
+def test_nchw_input_layout_matches_nhwc():
+    """NCHW enforcement is a single entry transpose: identical params,
+    identical outputs."""
+    nhwc = _tiny_resnet(dtype=jnp.float32)
+    nchw = _tiny_resnet(dtype=jnp.float32, input_layout="NCHW")
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 16, 16, 3), jnp.float32)
+    variables = nhwc.init(jax.random.key(0), x)
+    y_nhwc = nhwc.apply(variables, x)
+    y_nchw = nchw.apply(variables, jnp.transpose(x, (0, 3, 1, 2)))
+    np.testing.assert_allclose(np.asarray(y_nhwc), np.asarray(y_nchw),
+                               rtol=1e-6)
+    with pytest.raises(ValueError):
+        _tiny_resnet(input_layout="NHCW").init(jax.random.key(0), x)
+
+
+def test_stem_channel_padding_is_exact():
+    """Zero-padded input channels contribute exactly nothing: the padded
+    conv with the original kernel embedded reproduces the unpadded conv."""
+    from horovod_tpu.models.resnet import pad_channels_to_multiple
+
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.rand(2, 8, 8, 3), jnp.float32)
+    xp = pad_channels_to_multiple(x, 8)
+    assert xp.shape == (2, 8, 8, 8)
+    np.testing.assert_array_equal(np.asarray(xp[..., :3]), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(xp[..., 3:]), 0.0)
+    assert pad_channels_to_multiple(xp, 8) is xp  # already aligned: no-op
+
+    kernel = jnp.asarray(rs.rand(3, 3, 3, 4), jnp.float32)
+    kernel_padded = jnp.concatenate(
+        [kernel, jnp.asarray(rs.rand(3, 3, 5, 4), jnp.float32)], axis=2)
+    dn = jax.lax.conv_dimension_numbers(x.shape, kernel.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    y = jax.lax.conv_general_dilated(x, kernel, (1, 1), "SAME",
+                                     dimension_numbers=dn)
+    yp = jax.lax.conv_general_dilated(xp, kernel_padded, (1, 1), "SAME",
+                                      dimension_numbers=dn)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yp), rtol=1e-5)

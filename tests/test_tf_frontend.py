@@ -3,11 +3,16 @@ and DistributedGradientTape training, keras callbacks — run across real
 processes over the TCP controller (the analog of the reference's
 test/parallel/test_tensorflow2.py)."""
 
+import pytest
+
 import os
 import socket
 import subprocess
 import sys
 import textwrap
+
+# TF import + graph-mode session tests push the file past the ~3 min tier-1 per-file budget (ISSUE 2 satellite: tier-1 runs -m 'not slow')
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
