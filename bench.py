@@ -441,8 +441,14 @@ def main():
                 p = dp.replicate(params, mesh)
                 s = dp.replicate(opt.init(params), mesh)
                 st = dp.replicate(batch_stats, mesh)
-                compiled_cache[batch_size] = step.lower(
-                    p, s, st, batch, jax.random.key(1)).compile()
+                # .lower() forwards through the timed-step wrapper to the
+                # raw jitted fn, so the compiled executable must be
+                # re-wrapped for the step-time stats to reach the
+                # engine_metrics BENCH field (cost_analysis still forwards).
+                from horovod_tpu.metrics import timed_step
+                compiled_cache[batch_size] = timed_step(step.lower(
+                    p, s, st, batch, jax.random.key(1)).compile(),
+                    framework="jax")
             except Exception as e:  # AOT quirk on some backends: fall back
                 print(f"aot compile failed ({e!r}); using jit path",
                       file=sys.stderr)
@@ -608,6 +614,17 @@ def main():
         if isinstance(v, int) and k.startswith("resnet50") and v > 0
     }
 
+    # Engine + frontend telemetry snapshot: the perf trajectory records
+    # cache hit rate / fusion efficiency / step-time stats alongside img/s
+    # (ISSUE 3 acceptance: engine_metrics field in BENCH json). Single-chip
+    # CI runs have no engine (size 1) — the field is then frontend-only.
+    from horovod_tpu.metrics import bench_snapshot
+    try:
+        engine_metrics = bench_snapshot()
+    except Exception as e:  # telemetry must not sink the bench
+        print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
+        engine_metrics = {"error": repr(e)}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -625,6 +642,7 @@ def main():
             bert_flash_seq_per_sec,
         "flash_attention_8k_causal_speedup_vs_xla": flash_speedup_8k,
         "collective_bytes_per_step_per_replica": coll_bytes,
+        "engine_metrics": engine_metrics,
         "device_kind": jax.devices()[0].device_kind,
     }))
 

@@ -31,9 +31,11 @@ from horovod_tpu.common.basics import (  # noqa: F401
     cross_size,
     cuda_built,
     ddl_built,
+    engine_metrics,
     gloo_built,
     gloo_enabled,
     init,
+    stall_report,
     is_homogeneous,
     is_initialized,
     local_rank,

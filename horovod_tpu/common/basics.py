@@ -48,6 +48,7 @@ class _HorovodTpuContext:
         self.cross_size = 1
         self.mesh = None
         self.engine = None  # native engine session, when booted
+        self.metrics_exporter = None  # HOROVOD_METRICS_PORT endpoint
         self.elastic = False
 
     def init(self,
@@ -58,6 +59,10 @@ class _HorovodTpuContext:
         with self._lock:
             if self.initialized:
                 return
+            # Python logging honors the same HOROVOD_LOG_LEVEL /
+            # HOROVOD_LOG_TIMESTAMP the C++ engine reads (logging.cc).
+            from horovod_tpu.common.hvd_logging import setup_python_logging
+            setup_python_logging()
             from horovod_tpu.runner.elastic import worker as elastic_worker
             if elastic_worker.is_elastic_worker():
                 # Synchronize with the driver's current topology generation
@@ -174,6 +179,11 @@ class _HorovodTpuContext:
                             "pass init(start_engine=False) for a pure-SPMD "
                             f"run without the eager path.{hint} "
                             f"Cause: {e}") from e
+                # Prometheus endpoint — off by default, one per worker when
+                # HOROVOD_METRICS_PORT is set (metrics/exporter.py).
+                from horovod_tpu.metrics import start_exporter_from_env
+                self.metrics_exporter = start_exporter_from_env(
+                    rank=self.rank, engine=self.engine)
                 self.initialized = True
             except BaseException:
                 self.mesh = None
@@ -184,6 +194,9 @@ class _HorovodTpuContext:
         with self._lock:
             if not self.initialized:
                 return
+            if self.metrics_exporter is not None:
+                self.metrics_exporter.stop()
+                self.metrics_exporter = None
             if self.engine is not None:
                 self.engine.shutdown()
                 self.engine = None
@@ -377,6 +390,23 @@ def cuda_built() -> bool:
 
 def rocm_built() -> bool:
     return False
+
+
+def engine_metrics() -> Optional[dict]:
+    """Runtime metrics snapshot of this process's engine session
+    (``Session.metrics()``), or None when no engine is running. The
+    Prometheus exporter serves the same data as ``hvd_engine_*`` families;
+    this is the programmatic view."""
+    _require_init()
+    return _ctx.engine.metrics() if _ctx.engine is not None else None
+
+
+def stall_report() -> Optional[dict]:
+    """The last stall-inspector report observed by this rank (ready/missing
+    ranks per stalled tensor, machine-readable), or None. Available on
+    EVERY rank — the coordinator broadcasts each new report."""
+    _require_init()
+    return _ctx.engine.stall_report() if _ctx.engine is not None else None
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False):

@@ -31,6 +31,48 @@ from horovod_tpu.engine.bindings import (
 # JAX profiler trace, which profiler/trace_merge lines up with the engine's
 # own HOROVOD_TIMELINE lanes.
 from horovod_tpu.profiler.annotate import host_annotation
+# monitoring layer: enqueue→exec→wait phase latencies, bytes by dtype,
+# grouped-op sizes — served by the Prometheus exporter when enabled.
+from horovod_tpu.metrics.registry import (
+    DEFAULT_SIZE_BUCKETS, get_registry as _get_metrics_registry,
+)
+
+import time as _time
+
+_OP_TYPE_NAMES = {
+    OP_ALLREDUCE: "allreduce", OP_ALLGATHER: "allgather",
+    OP_BROADCAST: "broadcast", OP_ALLTOALL: "alltoall",
+    OP_BARRIER: "barrier",
+}
+
+# Instrument caches: resolving a registry child takes the registry lock and
+# rebuilds the label key; the hot path must pay that once per (phase /
+# op-type / dtype), not once per op.
+_phase_hists = {}
+_op_counters = {}
+_byte_counters = {}
+
+
+def _observe_phase(phase: str, seconds: float):
+    h = _phase_hists.get(phase)
+    if h is None:
+        h = _phase_hists[phase] = _get_metrics_registry().histogram(
+            "hvd_eager_phase_seconds", phase=phase)
+    h.observe(seconds)
+
+
+def _count_op(op_type: int, dtype_name: str, nbytes: int):
+    c = _op_counters.get(op_type)
+    if c is None:
+        c = _op_counters[op_type] = _get_metrics_registry().counter(
+            "hvd_eager_ops_total",
+            type=_OP_TYPE_NAMES.get(op_type, "other"))
+    c.inc()
+    b = _byte_counters.get(dtype_name)
+    if b is None:
+        b = _byte_counters[dtype_name] = _get_metrics_registry().counter(
+            "hvd_eager_bytes_total", dtype=dtype_name)
+    b.inc(nbytes)
 
 
 class Handle:
@@ -91,14 +133,18 @@ class EagerExecutor:
             self._inputs[name] = arr
             if splits is not None:
                 self._splits[name] = list(splits)
+        _count_op(op_type, arr.dtype.name, arr.nbytes)
         try:
+            t0 = _time.perf_counter()
             with host_annotation(f"hvd_enqueue:{name}"):
-                return self.session.enqueue(
+                handle = self.session.enqueue(
                     name, op_type, arr.dtype.name, list(arr.shape),
                     root_rank=root_rank, reduce_op=REDUCE_KIND[reduce_op],
                     prescale_factor=prescale, postscale_factor=postscale,
                     group_id=group_id, group_size=group_size,
                     splits=splits)
+            _observe_phase("enqueue", _time.perf_counter() - t0)
+            return handle
         except Exception:
             with self._lock:
                 self._inputs.pop(name, None)
@@ -126,9 +172,12 @@ class EagerExecutor:
     def _execute(self, resp: dict) -> int:
         # Negotiation has completed when the engine invokes this callback;
         # the span covers the host data-plane execution of the response.
+        t0 = _time.perf_counter()
         with host_annotation(
                 f"hvd_engine_exec:{resp.get('type', '?')}"):
-            return self._execute_response(resp)
+            rc = self._execute_response(resp)
+        _observe_phase("exec", _time.perf_counter() - t0)
+        return rc
 
     def _execute_response(self, resp: dict) -> int:
         t = resp["type"]
@@ -419,6 +468,9 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                                             postscale_factor))
                 for t in tensors]
     base = name or ex.auto_name("grouped_allreduce")
+    _get_metrics_registry().histogram(
+        "hvd_eager_grouped_tensors", buckets=DEFAULT_SIZE_BUCKETS,
+    ).observe(len(tensors))
     # Deterministic across processes (Python hash() is salted per process).
     import zlib
     gid = zlib.crc32(base.encode()) & 0x3fffffff
@@ -478,9 +530,11 @@ def synchronize(handle, timeout: float = 0.0):
     try:
         # Span covers QUEUE + NEGOTIATE + EXEC as seen from the caller —
         # the host-side cost of the whole collective.
+        t0 = _time.perf_counter()
         with host_annotation(
                 f"hvd_negotiate_wait:{handle._name or handle._engine_handle}"):
             ex.session.wait(handle._engine_handle, timeout=timeout)
+        _observe_phase("wait", _time.perf_counter() - t0)
     except HorovodInternalError:
         if handle._name:
             ex.take_result(handle._name, aux_out=handle.aux)

@@ -1,0 +1,65 @@
+"""Python-side logging honoring the same knobs as the C++ engine.
+
+Satellite of the observability PR: ``HOROVOD_LOG_LEVEL`` previously only
+reached the native engine (``engine/src/logging.cc``) — the Python layers
+(runner, elastic driver, basics, metrics) each had ad-hoc stderr prints.
+Now both halves read the same two variables:
+
+- ``HOROVOD_LOG_LEVEL``     — trace|debug|info|warning|error|fatal
+  (default warning, same parse as logging.cc:ParseLevel);
+- ``HOROVOD_LOG_TIMESTAMP`` — any non-"0" value prefixes timestamps,
+  matching the engine's format intent.
+
+The full HOROVOD_* observability env table lives in docs/DESIGN.md
+("Observability" section).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_ROOT = "horovod_tpu"
+
+# trace has no Python analog below DEBUG; both map to DEBUG like glog's
+# VLOG collapse.
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "warn": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+
+def setup_python_logging(force: bool = False) -> logging.Logger:
+    """Configure the ``horovod_tpu`` logger tree from the env. Idempotent;
+    ``force=True`` re-reads the env (tests, elastic re-init)."""
+    logger = logging.getLogger(_ROOT)
+    if getattr(logger, "_hvd_configured", False) and not force:
+        return logger
+    level = _LEVELS.get(os.environ.get("HOROVOD_LOG_LEVEL", "").lower(),
+                        logging.WARNING)
+    ts = os.environ.get("HOROVOD_LOG_TIMESTAMP", "0") not in ("", "0")
+    fmt = "[hvdtpu %(levelname)s %(name)s] %(message)s"
+    if ts:
+        fmt = "[hvdtpu %(asctime)s %(levelname)s %(name)s] %(message)s"
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(fmt,
+                                           datefmt="%Y-%m-%d %H:%M:%S"))
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger.propagate = False
+    logger._hvd_configured = True  # type: ignore[attr-defined]
+    return logger
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    """A child of the configured ``horovod_tpu`` logger."""
+    setup_python_logging()
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)

@@ -50,7 +50,8 @@ _lib_lock = threading.Lock()
 
 # Must match hvdtpu_abi_version() in src/c_api.cc; bumped together with any
 # semantic ABI change so a stale prebuilt .so is rejected at load time.
-ABI_VERSION = 4
+# 5: hvdtpu_metrics_snapshot + hvdtpu_last_stall_report.
+ABI_VERSION = 5
 
 
 def _lib_path() -> Path:
@@ -169,6 +170,12 @@ def load_library():
         lib.hvdtpu_bench_combine.restype = ctypes.c_double
         lib.hvdtpu_bench_combine.argtypes = [
             ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
+        lib.hvdtpu_metrics_snapshot.restype = ctypes.c_int64
+        lib.hvdtpu_metrics_snapshot.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        lib.hvdtpu_last_stall_report.restype = ctypes.c_int64
+        lib.hvdtpu_last_stall_report.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -289,6 +296,39 @@ class EngineSession:
     def data_ring_ops(self) -> int:
         """Collectives served by the ring data path (diagnostics)."""
         return self._lib.hvdtpu_data_ring_ops(self._session)
+
+    def _json_call(self, fn) -> Optional[dict]:
+        """Shared buffer dance for the JSON-returning C calls: the return
+        value is the full payload length, so one retry with a right-sized
+        buffer always suffices."""
+        size = 1 << 16
+        for _ in range(4):
+            buf = ctypes.create_string_buffer(size)
+            n = fn(self._session, buf, size)
+            if n < 0:
+                raise HorovodInternalError("invalid engine session")
+            if n < size:
+                raw = buf.value.decode()
+                return json.loads(raw) if raw else None
+            # headroom, not exact fit: the payload may grow between the
+            # probe and the retry (background thread keeps counting)
+            size = max(n + 1, size * 2)
+        raise HorovodInternalError("metrics snapshot kept growing")
+
+    def metrics(self) -> dict:
+        """Runtime metrics snapshot: {"rank", "counters", "gauges",
+        "histograms"} — counters are monotonic, histogram buckets are
+        per-bucket (not cumulative). The Prometheus exporter
+        (horovod_tpu.metrics) converts these into `hvd_engine_*` families."""
+        return self._json_call(self._lib.hvdtpu_metrics_snapshot) or {}
+
+    def stall_report(self) -> Optional[dict]:
+        """The last stall-inspector report observed by this rank, or None.
+        {"stalled": [{"tensor", "ready", "missing", "waited_sec"}, ...],
+        "warning_sec": N} — the coordinator broadcasts each new report so
+        every rank can name the missing ranks (reference behavior analog:
+        test_stall.py in the reference only sees rank-0 log text)."""
+        return self._json_call(self._lib.hvdtpu_last_stall_report)
 
     # -- data plane hookup --------------------------------------------------
 

@@ -6,6 +6,7 @@
 // real multi-process harness for this; SURVEY §7.2 calls out the
 // single-process N-rank testability win).
 
+#include <algorithm>
 #include <cstdlib>
 #include <cstring>
 #include <map>
@@ -40,7 +41,45 @@ extern "C" {
 // changed return-code contracts). bindings.py refuses a prebuilt .so
 // whose version doesn't match, so a stale library fails loudly instead
 // of silently changing behavior.
-int32_t hvdtpu_abi_version() { return 4; }
+int32_t hvdtpu_abi_version() { return 5; }
+
+namespace {
+
+// Shared contract of the JSON-returning calls below: returns the full
+// payload length in bytes (excluding the NUL terminator), or <0 on an
+// invalid session. Up to len-1 bytes plus a NUL are written to buf; a
+// return value >= len means the caller's buffer was too small — retry
+// with a larger one (the snapshot is cheap to recompute).
+int64_t CopyJson(const std::string& json, char* buf, int64_t len) {
+  if (buf != nullptr && len > 0) {
+    int64_t n = std::min<int64_t>(len - 1,
+                                  static_cast<int64_t>(json.size()));
+    std::memcpy(buf, json.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int64_t>(json.size());
+}
+
+}  // namespace
+
+// Runtime metrics snapshot (counters/gauges/histograms populated by the
+// controller, tensor queue, response cache, data plane and stall
+// inspector). JSON; see MetricsStore::SnapshotJson for the schema.
+int64_t hvdtpu_metrics_snapshot(int64_t session, char* buf, int64_t len) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  return CopyJson(e->MetricsSnapshotJson(), buf, len);
+}
+
+// Machine-readable stall report: {"stalled":[{"tensor","ready","missing",
+// "waited_sec"}...],"warning_sec":N}. Produced on the coordinator by the
+// stall inspector's warning scan and broadcast to every rank, so any rank
+// can name the missing ranks. Returns 0 (empty) before the first warning.
+int64_t hvdtpu_last_stall_report(int64_t session, char* buf, int64_t len) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  return CopyJson(e->LastStallReport(), buf, len);
+}
 
 // Host data-plane microbenchmark: payload bytes/s of the SUM combine
 // kernel (bench.py --host-microbench). dtype per DataType ids;

@@ -12,6 +12,10 @@ namespace {
 constexpr uint64_t kFlagUncached = 1ull << 0;
 constexpr uint64_t kFlagShutdown = 1ull << 1;
 constexpr uint64_t kFlagJoin = 1ull << 2;
+// A fresh stall report exists on the coordinator: every rank joins one
+// extra Bcast this cycle so the machine-readable report reaches all ranks
+// (Session.stall_report() works anywhere, not just rank 0).
+constexpr uint64_t kFlagStallReport = 1ull << 3;
 
 Response::Type OpToResponseType(OpType t) {
   switch (t) {
@@ -66,9 +70,13 @@ bool Cacheable(const Response& r) {
 }  // namespace
 
 Controller::Controller(std::shared_ptr<ControllerTransport> transport,
-                       const EngineOptions& opts, Timeline* timeline)
-    : transport_(std::move(transport)), opts_(opts), timeline_(timeline) {
+                       const EngineOptions& opts, Timeline* timeline,
+                       MetricsStore* metrics)
+    : transport_(std::move(transport)), opts_(opts), timeline_(timeline),
+      metrics_(metrics) {
   cache_.set_capacity(opts_.cache_enabled ? opts_.cache_capacity : 0);
+  cache_.set_metrics(metrics_);
+  stall_.set_metrics(metrics_);
   stall_.set_warning_time_sec(opts_.stall_warning_time_sec);
   stall_.set_shutdown_time_sec(opts_.stall_shutdown_time_sec);
   stall_.set_disabled(opts_.stall_check_disable);
@@ -273,13 +281,20 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
 
 Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
   // --- 1. classify fresh messages by cache state -------------------------
+  auto count = [this](std::atomic<int64_t> MetricsStore::*member) {
+    if (metrics_ != nullptr) {
+      (metrics_->*member).fetch_add(1, std::memory_order_relaxed);
+    }
+  };
   std::vector<uint32_t> my_invalid;
   for (const auto& msg : in.messages) {
     switch (cache_.Cached(msg)) {
       case ResponseCache::CacheState::HIT:
+        count(&MetricsStore::cache_hits);
         cached_pending_.push_back(msg);
         break;
       case ResponseCache::CacheState::INVALID:
+        count(&MetricsStore::cache_invalidations);
         // Parameters changed (e.g. a new allgather first-dim): every rank
         // must evict this entry or its fast-path bit deadlocks against our
         // slow-path renegotiation (reference: CacheCoordinator invalid
@@ -289,6 +304,7 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
         uncached_pending_.push_back(msg);
         break;
       case ResponseCache::CacheState::MISS:
+        count(&MetricsStore::cache_misses);
         uncached_pending_.push_back(msg);
         break;
     }
@@ -302,9 +318,17 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
   if (in.join_requested) flags |= kFlagJoin;
   // Stall scan every cycle on the coordinator (reference: controller.cc
   // invokes the inspector from ComputeResponseList each cycle); a shutdown
-  // verdict rides the OR'd flags so every rank stops together.
-  if (rank() == 0 && stall_.CheckForStalledTensors(size())) {
-    flags |= kFlagShutdown;
+  // verdict rides the OR'd flags so every rank stops together, and a fresh
+  // machine-readable report rides its own flag + Bcast below.
+  std::string stall_report_payload;
+  if (rank() == 0) {
+    if (stall_.CheckForStalledTensors(size())) {
+      flags |= kFlagShutdown;
+    }
+    stall_report_payload = stall_.ConsumeNewReport();
+    if (!stall_report_payload.empty()) {
+      flags |= kFlagStallReport;
+    }
   }
 
   // Layout: word 0 = ~flags (AND of inverted = inverted OR); then
@@ -328,6 +352,14 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
   bool any_uncached = or_flags & kFlagUncached;
   bool any_shutdown = or_flags & kFlagShutdown;
   bool any_join = or_flags & kFlagJoin;
+
+  // Stall-report fan-out: the flag rode the OR word, so every rank knows to
+  // join this Bcast in the same cycle (same mechanism as shutdown).
+  if (or_flags & kFlagStallReport) {
+    st = transport_->Bcast(&stall_report_payload);
+    if (!st.ok()) return st;
+    if (rank() != 0) stall_.SetLastReport(stall_report_payload);
+  }
 
   // Apply coordinated invalidations: evict and re-announce anything we had
   // riding the fast path on a now-stale entry.
@@ -498,6 +530,33 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
   }
 
   FuseResponses(&responses);
+
+  if (metrics_ != nullptr) {
+    for (const auto& r : responses) {
+      metrics_->responses_total.fetch_add(1, std::memory_order_relaxed);
+      size_t n = r.tensor_names.size();
+      metrics_->fused_tensors.fetch_add(n, std::memory_order_relaxed);
+      if (n > 1) {
+        metrics_->fused_responses.fetch_add(1, std::memory_order_relaxed);
+      }
+      if (r.type == Response::Type::ALLREDUCE) {
+        metrics_->fusion_batch_tensors.Observe(static_cast<int64_t>(n));
+      }
+      switch (r.type) {
+        case Response::Type::ALLREDUCE:
+        case Response::Type::ALLGATHER:
+        case Response::Type::BROADCAST:
+        case Response::Type::ALLTOALL:
+          metrics_->response_bytes.Observe(ResponseBytes(r));
+          break;
+        default:
+          break;
+      }
+    }
+    metrics_->cache_size.store(
+        static_cast<int64_t>(cache_.num_active_bits()),
+        std::memory_order_relaxed);
+  }
 
   out->responses.responses = std::move(responses);
   out->responses.shutdown = any_shutdown;

@@ -33,6 +33,7 @@
 
 #include "common.h"
 #include "message.h"
+#include "metrics.h"
 #include "parameter_manager.h"
 #include "response_cache.h"
 #include "stall_inspector.h"
@@ -44,7 +45,8 @@ namespace hvdtpu {
 class Controller {
  public:
   Controller(std::shared_ptr<ControllerTransport> transport,
-             const EngineOptions& opts, Timeline* timeline);
+             const EngineOptions& opts, Timeline* timeline,
+             MetricsStore* metrics = nullptr);
 
   struct CycleInput {
     std::vector<Request> messages;
@@ -100,6 +102,7 @@ class Controller {
   std::shared_ptr<ControllerTransport> transport_;
   EngineOptions opts_;
   Timeline* timeline_;
+  MetricsStore* metrics_;
   ResponseCache cache_;
   StallInspector stall_;
   ParameterManager pm_;

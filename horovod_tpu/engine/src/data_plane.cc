@@ -416,9 +416,9 @@ Status DataPlane::RingBcast(void* buffer, int64_t nbytes, int32_t root) {
   return Status::OK();
 }
 
-Status DataPlane::Allreduce(void* buffer, int64_t num_elements,
-                            DataType dtype, ReduceKind kind, double prescale,
-                            double postscale) {
+Status DataPlane::AllreduceImpl(void* buffer, int64_t num_elements,
+                                DataType dtype, ReduceKind kind,
+                                double prescale, double postscale) {
   const int size = transport_->size();
   const int64_t nbytes = num_elements * DataTypeSize(dtype);
   if (kind == ReduceKind::ADASUM && !IsFloatType(dtype)) {
@@ -530,9 +530,9 @@ Status DataPlane::RingAllgatherv(const void* in,
   return Status::OK();
 }
 
-Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
-                             std::string* out,
-                             std::vector<int64_t>* rank_bytes) {
+Status DataPlane::AllgathervImpl(const void* in, int64_t in_bytes,
+                                 std::string* out,
+                                 std::vector<int64_t>* rank_bytes) {
   const int size = transport_->size();
   // Per-rank sizes ride the star first (8 bytes each): every rank needs
   // them for the output layout, and all ranks must take the same
@@ -568,7 +568,7 @@ Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
   return Status::OK();
 }
 
-Status DataPlane::Bcast(void* buffer, int64_t nbytes, int32_t root) {
+Status DataPlane::BcastImpl(void* buffer, int64_t nbytes, int32_t root) {
   if (transport_->size() > 1 && nbytes >= ring_threshold_) {
     return RingBcast(buffer, nbytes, root);
   }
@@ -727,10 +727,10 @@ Status DataPlane::RingAlltoallv(const void* in,
   return Status::OK();
 }
 
-Status DataPlane::Alltoallv(const void* in,
-                            const std::vector<int64_t>& send_bytes,
-                            std::string* out,
-                            std::vector<int64_t>* recv_bytes) {
+Status DataPlane::AlltoallvImpl(const void* in,
+                                const std::vector<int64_t>& send_bytes,
+                                std::string* out,
+                                std::vector<int64_t>* recv_bytes) {
   const int size = transport_->size();
   const int rank = transport_->rank();
   // Uniform star-or-ring decision on the global total (per-rank totals
@@ -792,6 +792,66 @@ Status DataPlane::Alltoallv(const void* in,
   out->assign(packet.data() + size * sizeof(int64_t),
               packet.size() - size * sizeof(int64_t));
   return Status::OK();
+}
+
+// --- metric-recording wrappers ---------------------------------------------
+// All data-plane calls run on the single callback thread, so ring_ops_
+// before/after is a race-free way to attribute the op to ring vs star.
+
+void DataPlane::RecordOp(std::atomic<int64_t> MetricsStore::*bytes_member,
+                         int64_t nbytes, int64_t ring_ops_before) {
+  if (metrics_ == nullptr) return;
+  (metrics_->*bytes_member).fetch_add(nbytes, std::memory_order_relaxed);
+  if (ring_ops_ > ring_ops_before) {
+    metrics_->data_ring_ops.fetch_add(1, std::memory_order_relaxed);
+  } else {
+    metrics_->data_star_ops.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+Status DataPlane::Allreduce(void* buffer, int64_t num_elements,
+                            DataType dtype, ReduceKind kind, double prescale,
+                            double postscale) {
+  int64_t before = ring_ops_;
+  auto st = AllreduceImpl(buffer, num_elements, dtype, kind, prescale,
+                          postscale);
+  if (st.ok()) {
+    RecordOp(&MetricsStore::allreduce_bytes,
+             num_elements * DataTypeSize(dtype), before);
+  }
+  return st;
+}
+
+Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
+                             std::string* out,
+                             std::vector<int64_t>* rank_bytes) {
+  int64_t before = ring_ops_;
+  auto st = AllgathervImpl(in, in_bytes, out, rank_bytes);
+  if (st.ok()) {
+    RecordOp(&MetricsStore::allgather_bytes,
+             static_cast<int64_t>(out->size()), before);
+  }
+  return st;
+}
+
+Status DataPlane::Bcast(void* buffer, int64_t nbytes, int32_t root) {
+  int64_t before = ring_ops_;
+  auto st = BcastImpl(buffer, nbytes, root);
+  if (st.ok()) RecordOp(&MetricsStore::broadcast_bytes, nbytes, before);
+  return st;
+}
+
+Status DataPlane::Alltoallv(const void* in,
+                            const std::vector<int64_t>& send_bytes,
+                            std::string* out,
+                            std::vector<int64_t>* recv_bytes) {
+  int64_t before = ring_ops_;
+  auto st = AlltoallvImpl(in, send_bytes, out, recv_bytes);
+  if (st.ok()) {
+    RecordOp(&MetricsStore::alltoall_bytes,
+             static_cast<int64_t>(out->size()), before);
+  }
+  return st;
 }
 
 }  // namespace hvdtpu

@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "common.h"
+#include "metrics.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -53,6 +54,10 @@ class DataPlane {
   // actually engaged for large payloads).
   int64_t ring_ops() const { return ring_ops_; }
 
+  // Engine metrics sink: per-op payload bytes and ring-vs-star routing
+  // counters (populated from the public entry points below).
+  void set_metrics(MetricsStore* m) { metrics_ = m; }
+
   // In-place allreduce over num_elements of dtype.
   Status Allreduce(void* buffer, int64_t num_elements, DataType dtype,
                    ReduceKind kind, double prescale, double postscale);
@@ -71,6 +76,16 @@ class DataPlane {
                    std::string* out, std::vector<int64_t>* recv_bytes);
 
  private:
+  // The public ops above are thin metric-recording wrappers around these.
+  Status AllreduceImpl(void* buffer, int64_t num_elements, DataType dtype,
+                       ReduceKind kind, double prescale, double postscale);
+  Status AllgathervImpl(const void* in, int64_t in_bytes, std::string* out,
+                        std::vector<int64_t>* rank_bytes);
+  Status BcastImpl(void* buffer, int64_t nbytes, int32_t root);
+  Status AlltoallvImpl(const void* in,
+                       const std::vector<int64_t>& send_bytes,
+                       std::string* out, std::vector<int64_t>* recv_bytes);
+
   // O(bytes)-per-rank ring algorithms for payloads >= ring_threshold_:
   // reduce-scatter + allgather around the ring (allreduce), pipelined
   // chunk relay (bcast), blob rotation (allgatherv), and an entry-relay
@@ -89,7 +104,13 @@ class DataPlane {
   // decision would deadlock the transports).
   Status ExchangeInt64(int64_t mine, std::vector<int64_t>* all);
 
+  // Record one completed collective: payload bytes into `bytes_member`,
+  // plus which path (ring vs star) served it.
+  void RecordOp(std::atomic<int64_t> MetricsStore::*bytes_member,
+                int64_t nbytes, int64_t ring_ops_before);
+
   std::shared_ptr<ControllerTransport> transport_;
+  MetricsStore* metrics_ = nullptr;
   int64_t ring_threshold_;
   int64_t ring_ops_ = 0;
   // Test-only fault injection (HOROVOD_DATA_FAULT_INJECT): corrupt a wire

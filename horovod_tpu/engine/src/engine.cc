@@ -108,13 +108,15 @@ Status Engine::Init() {
     return Status::InvalidArgument("unknown transport: " + tcfg_.kind);
   }
   data_plane_ = std::make_unique<DataPlane>(data_transport);
+  data_plane_->set_metrics(&metrics_);
   // Coordinator-only, like the reference: every worker gets the same
   // HOROVOD_TIMELINE path, and concurrent writers would interleave
   // corrupt JSON into one file.
   if (!opts_.timeline_path.empty() && rank_ == 0) {
     timeline_.Initialize(opts_.timeline_path, opts_.timeline_mark_cycles);
   }
-  controller_ = std::make_unique<Controller>(transport_, opts_, &timeline_);
+  controller_ = std::make_unique<Controller>(transport_, opts_, &timeline_,
+                                             &metrics_);
   background_ = std::thread([this] { BackgroundLoop(); });
   return Status::OK();
 }
@@ -156,6 +158,9 @@ Status Engine::EnqueueTensor(TensorTableEntry entry, int64_t* handle) {
     handles_.MarkDone(*handle, st.reason);
     return st;
   }
+  metrics_.enqueued_total.fetch_add(1, std::memory_order_relaxed);
+  metrics_.queue_depth.store(static_cast<int64_t>(queue_.size()),
+                             std::memory_order_relaxed);
   {
     std::lock_guard<std::mutex> lock(cycle_mu_);
     work_available_ = true;
@@ -258,6 +263,25 @@ std::string Engine::ResponseToJson(const Response& r) {
 void Engine::PerformOperation(const Response& response) {
   // reference: operations.cc:255-334 — fetch entries, execute, fire
   // callbacks. Data execution is delegated to the frontend.
+  {
+    auto bump = [this, &response](std::atomic<int64_t>& c) {
+      c.fetch_add(static_cast<int64_t>(response.tensor_names.size()),
+                  std::memory_order_relaxed);
+    };
+    switch (response.type) {
+      case Response::Type::ALLREDUCE: bump(metrics_.allreduce_ops); break;
+      case Response::Type::ALLGATHER: bump(metrics_.allgather_ops); break;
+      case Response::Type::BROADCAST: bump(metrics_.broadcast_ops); break;
+      case Response::Type::ALLTOALL: bump(metrics_.alltoall_ops); break;
+      case Response::Type::BARRIER: bump(metrics_.barrier_ops); break;
+      case Response::Type::JOIN:
+        metrics_.join_ops.fetch_add(1, std::memory_order_relaxed);
+        break;
+      case Response::Type::ERROR:
+        metrics_.error_responses.fetch_add(1, std::memory_order_relaxed);
+        break;
+    }
+  }
   std::string err = response.error_message;
   int32_t rc = 0;
   if (response.type == Response::Type::ERROR) {
@@ -277,7 +301,11 @@ void Engine::PerformOperation(const Response& response) {
     }
     if (execute_fn_ != nullptr) {
       std::string json = ResponseToJson(response);
+      auto t0 = std::chrono::steady_clock::now();
       rc = execute_fn_(json.c_str(), execute_user_data_);
+      metrics_.exec_us.Observe(
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              std::chrono::steady_clock::now() - t0).count());
       if (rc != 0) {
         err = "data plane execution failed (rc=" + std::to_string(rc) + ")";
       }
@@ -318,9 +346,12 @@ void Engine::BackgroundLoopImpl() {
       work_available_ = false;
     }
     timeline_.MarkCycleStart();
+    auto cycle_t0 = std::chrono::steady_clock::now();
 
     Controller::CycleInput in;
     queue_.PopMessagesFromQueue(&in.messages);
+    metrics_.queue_depth.store(static_cast<int64_t>(queue_.size()),
+                               std::memory_order_relaxed);
     for (const auto& msg : in.messages) {
       // QUEUE -> NEGOTIATE: the request enters this cycle's negotiation
       timeline_.ActivityEnd(msg.tensor_name);
@@ -340,6 +371,10 @@ void Engine::BackgroundLoopImpl() {
     for (const auto& response : out.responses.responses) {
       PerformOperation(response);
     }
+    metrics_.cycles_total.fetch_add(1, std::memory_order_relaxed);
+    metrics_.cycle_us.Observe(
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            std::chrono::steady_clock::now() - cycle_t0).count());
     if (out.tuned_cycle_time_ms > 0) {
       opts_.cycle_time_ms = out.tuned_cycle_time_ms;  // autotuner pacing
     }

@@ -27,6 +27,7 @@
 #include "controller.h"
 #include "data_plane.h"
 #include "message.h"
+#include "metrics.h"
 #include "tensor_queue.h"
 #include "timeline.h"
 #include "transport.h"
@@ -99,6 +100,16 @@ class Engine {
 
   Timeline& timeline() { return timeline_; }
   Controller& controller() { return *controller_; }
+  MetricsStore& metrics() { return metrics_; }
+
+  // JSON snapshot of all runtime counters/gauges/histograms (the payload
+  // behind hvdtpu_metrics_snapshot). Safe from any thread.
+  std::string MetricsSnapshotJson() { return metrics_.SnapshotJson(rank_); }
+  // Last stall report observed by this rank ("" before the first); the
+  // coordinator's report is broadcast to every rank (controller.cc).
+  std::string LastStallReport() {
+    return controller_ ? controller_->stall_inspector().last_report() : "";
+  }
 
   // Host data plane. ONLY safe from within the execute callback (which runs
   // on the background thread, in lockstep response order across ranks) —
@@ -121,6 +132,7 @@ class Engine {
   TensorQueue queue_;
   HandleManager handles_;
   Timeline timeline_;
+  MetricsStore metrics_;
 
   std::thread background_;
   std::atomic<bool> shutdown_requested_{false};

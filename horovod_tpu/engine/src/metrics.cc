@@ -1,0 +1,118 @@
+#include "metrics.h"
+
+#include <cstdio>
+
+namespace hvdtpu {
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"':
+      case '\\':
+        out += '\\';
+        out += c;
+        break;
+      case '\n':
+        out += "\\n";
+        break;
+      case '\t':
+        out += "\\t";
+        break;
+      case '\r':
+        out += "\\r";
+        break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+namespace {
+
+void AppendKV(std::string* out, const char* key, int64_t v, bool* first) {
+  if (!*first) *out += ",";
+  *first = false;
+  *out += "\"";
+  *out += key;
+  *out += "\":";
+  *out += std::to_string(v);
+}
+
+}  // namespace
+
+void Histogram::AppendJson(std::string* out) const {
+  *out += "{\"bounds\":[";
+  for (size_t i = 0; i < bounds_.size(); ++i) {
+    if (i) *out += ",";
+    *out += std::to_string(bounds_[i]);
+  }
+  *out += "],\"counts\":[";
+  for (size_t i = 0; i < counts_.size(); ++i) {
+    if (i) *out += ",";
+    *out += std::to_string(counts_[i].load(std::memory_order_relaxed));
+  }
+  *out += "],\"sum\":";
+  *out += std::to_string(sum_.load(std::memory_order_relaxed));
+  *out += ",\"count\":";
+  *out += std::to_string(count_.load(std::memory_order_relaxed));
+  *out += "}";
+}
+
+std::string MetricsStore::SnapshotJson(int rank) const {
+  auto v = [](const std::atomic<int64_t>& a) {
+    return a.load(std::memory_order_relaxed);
+  };
+  std::string out;
+  out.reserve(2048);
+  out += "{\"rank\":" + std::to_string(rank) + ",\"counters\":{";
+  bool first = true;
+  AppendKV(&out, "enqueued", v(enqueued_total), &first);
+  AppendKV(&out, "allreduce_ops", v(allreduce_ops), &first);
+  AppendKV(&out, "allgather_ops", v(allgather_ops), &first);
+  AppendKV(&out, "broadcast_ops", v(broadcast_ops), &first);
+  AppendKV(&out, "alltoall_ops", v(alltoall_ops), &first);
+  AppendKV(&out, "barrier_ops", v(barrier_ops), &first);
+  AppendKV(&out, "join_ops", v(join_ops), &first);
+  AppendKV(&out, "error_responses", v(error_responses), &first);
+  AppendKV(&out, "allreduce_bytes", v(allreduce_bytes), &first);
+  AppendKV(&out, "allgather_bytes", v(allgather_bytes), &first);
+  AppendKV(&out, "broadcast_bytes", v(broadcast_bytes), &first);
+  AppendKV(&out, "alltoall_bytes", v(alltoall_bytes), &first);
+  AppendKV(&out, "cache_hits", v(cache_hits), &first);
+  AppendKV(&out, "cache_misses", v(cache_misses), &first);
+  AppendKV(&out, "cache_invalidations", v(cache_invalidations), &first);
+  AppendKV(&out, "cache_evictions", v(cache_evictions), &first);
+  AppendKV(&out, "cycles", v(cycles_total), &first);
+  AppendKV(&out, "responses", v(responses_total), &first);
+  AppendKV(&out, "fused_responses", v(fused_responses), &first);
+  AppendKV(&out, "fused_tensors", v(fused_tensors), &first);
+  AppendKV(&out, "stall_warnings", v(stall_warnings), &first);
+  AppendKV(&out, "stalled_tensors", v(stalled_tensors), &first);
+  AppendKV(&out, "data_ring_ops", v(data_ring_ops), &first);
+  AppendKV(&out, "data_star_ops", v(data_star_ops), &first);
+  out += "},\"gauges\":{";
+  first = true;
+  AppendKV(&out, "queue_depth", v(queue_depth), &first);
+  AppendKV(&out, "cache_size", v(cache_size), &first);
+  out += "},\"histograms\":{\"fusion_batch_tensors\":";
+  fusion_batch_tensors.AppendJson(&out);
+  out += ",\"response_bytes\":";
+  response_bytes.AppendJson(&out);
+  out += ",\"cycle_us\":";
+  cycle_us.AppendJson(&out);
+  out += ",\"exec_us\":";
+  exec_us.AppendJson(&out);
+  out += "}}";
+  return out;
+}
+
+}  // namespace hvdtpu

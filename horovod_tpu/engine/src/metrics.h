@@ -1,0 +1,107 @@
+// Lock-cheap runtime metrics for the coordination engine.
+//
+// The reference has no metrics surface at all — its observability ends at
+// log lines and the timeline file (SURVEY §5.5 "No Prometheus/metrics
+// endpoint"). This store is the engine half of the monitoring layer: every
+// hot-path component (controller, tensor_queue, response_cache, data_plane,
+// stall_inspector) bumps relaxed atomics here, and the C API exposes one
+// JSON snapshot (hvdtpu_metrics_snapshot) that the Python registry converts
+// into Prometheus families.
+//
+// Concurrency contract: writers are the background cycle thread and the
+// frontend enqueue threads; the snapshot reader is whatever thread calls
+// the C API. Everything is a relaxed atomic — a snapshot is a consistent
+// *set of monotonic counters*, not a transactionally consistent frame,
+// which is exactly the Prometheus scrape model.
+
+#ifndef HVD_TPU_METRICS_H
+#define HVD_TPU_METRICS_H
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Escape a string for embedding in a JSON string literal.
+std::string JsonEscape(const std::string& s);
+
+// Fixed-bucket histogram over int64 observations (microseconds for
+// latencies, counts/bytes for sizes). Buckets are per-bucket (NOT
+// cumulative) in the snapshot; the Python exporter accumulates them into
+// Prometheus `le` form.
+class Histogram {
+ public:
+  explicit Histogram(std::vector<int64_t> bounds)
+      : bounds_(std::move(bounds)), counts_(bounds_.size() + 1) {}
+
+  void Observe(int64_t v) {
+    size_t i = 0;
+    while (i < bounds_.size() && v > bounds_[i]) ++i;
+    counts_[i].fetch_add(1, std::memory_order_relaxed);
+    sum_.fetch_add(v, std::memory_order_relaxed);
+    count_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  int64_t count() const { return count_.load(std::memory_order_relaxed); }
+
+  // {"bounds":[...],"counts":[...],"sum":N,"count":N}
+  void AppendJson(std::string* out) const;
+
+ private:
+  std::vector<int64_t> bounds_;
+  std::vector<std::atomic<int64_t>> counts_;  // bounds.size() + 1 (overflow)
+  std::atomic<int64_t> sum_{0};
+  std::atomic<int64_t> count_{0};
+};
+
+struct MetricsStore {
+  // -- counters (monotonic) -------------------------------------------------
+  std::atomic<int64_t> enqueued_total{0};       // frontend EnqueueTensor calls
+  std::atomic<int64_t> allreduce_ops{0};        // completed, by response type
+  std::atomic<int64_t> allgather_ops{0};
+  std::atomic<int64_t> broadcast_ops{0};
+  std::atomic<int64_t> alltoall_ops{0};
+  std::atomic<int64_t> barrier_ops{0};
+  std::atomic<int64_t> join_ops{0};
+  std::atomic<int64_t> error_responses{0};
+  std::atomic<int64_t> allreduce_bytes{0};      // logical payload bytes
+  std::atomic<int64_t> allgather_bytes{0};
+  std::atomic<int64_t> broadcast_bytes{0};
+  std::atomic<int64_t> alltoall_bytes{0};
+  std::atomic<int64_t> cache_hits{0};           // response-cache classification
+  std::atomic<int64_t> cache_misses{0};
+  std::atomic<int64_t> cache_invalidations{0};
+  std::atomic<int64_t> cache_evictions{0};
+  std::atomic<int64_t> cycles_total{0};         // negotiation cycles run
+  std::atomic<int64_t> responses_total{0};      // responses executed
+  std::atomic<int64_t> fused_responses{0};      // responses carrying >1 tensor
+  std::atomic<int64_t> fused_tensors{0};        // tensors that rode any response
+  std::atomic<int64_t> stall_warnings{0};       // warning scans that fired
+  std::atomic<int64_t> stalled_tensors{0};      // tensors named across scans
+  std::atomic<int64_t> data_ring_ops{0};        // host data plane ring path
+  std::atomic<int64_t> data_star_ops{0};        // host data plane star path
+
+  // -- gauges ---------------------------------------------------------------
+  std::atomic<int64_t> queue_depth{0};          // staged, not yet negotiated
+  std::atomic<int64_t> cache_size{0};           // live response-cache entries
+
+  // -- histograms -----------------------------------------------------------
+  Histogram fusion_batch_tensors{{1, 2, 4, 8, 16, 32, 64, 128}};
+  Histogram response_bytes{{1 << 10, 16 << 10, 256 << 10, 1 << 20, 16 << 20,
+                            64 << 20, 256 << 20}};
+  Histogram cycle_us{{50, 100, 250, 500, 1000, 2500, 5000, 10000, 50000,
+                      100000, 1000000}};
+  Histogram exec_us{{50, 100, 250, 500, 1000, 2500, 5000, 10000, 50000,
+                     100000, 1000000}};
+
+  // One JSON object: {"rank":R,"counters":{...},"gauges":{...},
+  // "histograms":{...}}. Counter keys are stable API — the Python engine
+  // collector turns "<key>" into "hvd_engine_<key>_total".
+  std::string SnapshotJson(int rank) const;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_METRICS_H

@@ -34,6 +34,9 @@ void ResponseCache::Put(const Response& response, const Request& params) {
     // Evict LRU — identical decision on every rank.
     const std::string victim = lru_.back();
     Erase(victim);
+    if (metrics_ != nullptr) {
+      metrics_->cache_evictions.fetch_add(1, std::memory_order_relaxed);
+    }
   }
   // Claim the lowest free slot for a stable bit position.
   uint32_t pos = 0;

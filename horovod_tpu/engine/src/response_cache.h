@@ -20,6 +20,7 @@
 
 #include "common.h"
 #include "message.h"
+#include "metrics.h"
 
 namespace hvdtpu {
 
@@ -28,6 +29,7 @@ class ResponseCache {
   enum class CacheState { MISS = 0, HIT = 1, INVALID = 2 };
 
   void set_capacity(uint32_t capacity) { capacity_ = capacity; }
+  void set_metrics(MetricsStore* m) { metrics_ = m; }
   uint32_t capacity() const { return capacity_; }
   size_t num_active_bits() const { return cache_.size(); }
   // Bit-vector domain: includes freed slots (stable positions).
@@ -60,6 +62,7 @@ class ResponseCache {
 
   void TouchLRU(const std::string& name);
 
+  MetricsStore* metrics_ = nullptr;
   uint32_t capacity_ = 1024;
   // name -> entry; positions are stable indices into a slot table so the
   // coordination bit vector is consistent across ranks.

@@ -29,7 +29,9 @@ bool StallInspector::CheckForStalledTensors(int32_t global_size) {
   bool should_shut_down = false;
   auto now = Clock::now();
   std::ostringstream warn;
-  int n_stalled = 0;
+  std::ostringstream report;  // machine-readable mirror of this scan
+  int n_stalled = 0;          // newly warned this scan (log/report trigger)
+  int n_current = 0;          // all currently-stalled tensors (report body)
   for (auto& kv : uncached_) {
     auto& info = kv.second;
     double waited =
@@ -38,9 +40,6 @@ bool StallInspector::CheckForStalledTensors(int32_t global_size) {
     if (shutdown_time_sec_ > 0 && waited > shutdown_time_sec_) {
       should_shut_down = true;
     }
-    if (info.warned) continue;
-    info.warned = true;
-    ++n_stalled;
     std::vector<int32_t> missing;
     std::vector<int32_t> ready = info.ranks;
     std::sort(ready.begin(), ready.end());
@@ -49,6 +48,19 @@ bool StallInspector::CheckForStalledTensors(int32_t global_size) {
         missing.push_back(r);
       }
     }
+    if (n_current++) report << ",";
+    report << "{\"tensor\":\"" << JsonEscape(kv.first) << "\",\"ready\":[";
+    for (size_t i = 0; i < ready.size(); ++i) {
+      report << (i ? "," : "") << ready[i];
+    }
+    report << "],\"missing\":[";
+    for (size_t i = 0; i < missing.size(); ++i) {
+      report << (i ? "," : "") << missing[i];
+    }
+    report << "],\"waited_sec\":" << static_cast<int64_t>(waited) << "}";
+    if (info.warned) continue;
+    info.warned = true;
+    ++n_stalled;
     warn << "  " << kv.first << " [ready ranks:";
     for (auto r : ready) warn << " " << r;
     warn << "] [missing ranks:";
@@ -69,8 +81,37 @@ bool StallInspector::CheckForStalledTensors(int32_t global_size) {
     } else {
       std::fprintf(stderr, "[hvdtpu] WARNING: %s", msg.c_str());
     }
+    if (metrics_ != nullptr) {
+      metrics_->stall_warnings.fetch_add(1, std::memory_order_relaxed);
+      metrics_->stalled_tensors.fetch_add(n_stalled,
+                                          std::memory_order_relaxed);
+    }
+    std::string json = "{\"stalled\":[" + report.str() +
+                       "],\"warning_sec\":" +
+                       std::to_string(static_cast<int>(warning_time_sec_)) +
+                       "}";
+    std::lock_guard<std::mutex> lock(report_mu_);
+    last_report_ = std::move(json);
+    new_report_ = true;
   }
   return should_shut_down;
+}
+
+std::string StallInspector::ConsumeNewReport() {
+  std::lock_guard<std::mutex> lock(report_mu_);
+  if (!new_report_) return "";
+  new_report_ = false;
+  return last_report_;
+}
+
+void StallInspector::SetLastReport(const std::string& json) {
+  std::lock_guard<std::mutex> lock(report_mu_);
+  last_report_ = json;
+}
+
+std::string StallInspector::last_report() const {
+  std::lock_guard<std::mutex> lock(report_mu_);
+  return last_report_;
 }
 
 void StallInspector::Clear() { uncached_.clear(); }

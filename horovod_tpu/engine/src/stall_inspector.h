@@ -5,6 +5,12 @@
 // longer than the warning interval, naming ready vs missing ranks; can
 // optionally shut the job down after a longer deadline. Worker ranks track
 // their own uncompleted tensors for reporting.
+//
+// Beyond the reference: each warning scan also produces a machine-readable
+// JSON report ({"stalled":[{"tensor","ready","missing"}...]}) which the
+// controller broadcasts to every rank, so hvdtpu_last_stall_report /
+// Session.stall_report() can name the missing ranks from ANY rank — the
+// reference only ever logs this on the coordinator.
 
 #ifndef HVD_TPU_STALL_INSPECTOR_H
 #define HVD_TPU_STALL_INSPECTOR_H
@@ -12,11 +18,13 @@
 #include <chrono>
 #include <cstdint>
 #include <functional>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
 
 #include "common.h"
+#include "metrics.h"
 
 namespace hvdtpu {
 
@@ -29,6 +37,7 @@ class StallInspector {
   void set_shutdown_time_sec(double t) { shutdown_time_sec_ = t; }
   void set_disabled(bool d) { disabled_ = d; }
   void set_log_fn(LogFn fn) { log_fn_ = std::move(fn); }
+  void set_metrics(MetricsStore* m) { metrics_ = m; }
 
   // Rank 0: record that `rank` reported `name` ready.
   void RecordUncachedTensorRank(const std::string& name, int32_t rank);
@@ -40,6 +49,15 @@ class StallInspector {
   // (reference: stall_inspector.h:74-80 → engine aborts).
   bool CheckForStalledTensors(int32_t global_size);
 
+  // Rank 0 (controller cycle): the JSON report produced by the latest scan
+  // that fired a warning, or "" when nothing new since the last consume.
+  // The controller broadcasts a non-empty result to all ranks.
+  std::string ConsumeNewReport();
+  // Non-coordinator ranks: store the broadcast report.
+  void SetLastReport(const std::string& json);
+  // Any rank, any thread: the last report observed ("" before the first).
+  std::string last_report() const;
+
   void Clear();
 
  private:
@@ -47,6 +65,7 @@ class StallInspector {
   double shutdown_time_sec_ = 0.0;  // 0 = never shut down
   bool disabled_ = false;
   LogFn log_fn_;
+  MetricsStore* metrics_ = nullptr;
 
   struct Info {
     std::vector<int32_t> ranks;
@@ -54,6 +73,12 @@ class StallInspector {
     bool warned = false;
   };
   std::unordered_map<std::string, Info> uncached_;
+
+  // Written by the background thread (scan / SetLastReport), read from the
+  // C API thread — the one piece of this class that needs a lock.
+  mutable std::mutex report_mu_;
+  std::string last_report_;
+  bool new_report_ = false;
 };
 
 }  // namespace hvdtpu

@@ -8,6 +8,7 @@ backend shims the reference needed for tf1/tf2 duality.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import keras
@@ -15,6 +16,30 @@ import numpy as np
 
 from horovod_tpu.common import basics
 from horovod_tpu.common import eager as _eager
+
+# Log keys that must NOT be cross-rank averaged: the learning rate is a
+# schedule output identical on every rank (averaging a per-rank-perturbed
+# lr would silently corrupt LR-schedule callbacks that read it back).
+_NON_AVERAGED_KEYS = frozenset({"lr", "learning_rate"})
+
+
+def _averageable_keys(logs: dict) -> list:
+    """Sorted log keys that should be cross-rank averaged: numeric scalars
+    only (``np.isscalar`` alone also passes strings, which the old code
+    would crash on), excluding lr-style schedule outputs and booleans."""
+    keys = []
+    for k, v in logs.items():
+        if k in _NON_AVERAGED_KEYS or k.endswith("_lr") or \
+                k.startswith("lr_"):
+            continue
+        if isinstance(v, bool) or isinstance(v, str):
+            continue
+        if isinstance(v, (int, float, np.integer, np.floating)):
+            keys.append(k)
+        elif getattr(v, "ndim", None) == 0 and \
+                np.issubdtype(np.asarray(v).dtype, np.number):
+            keys.append(k)
+    return sorted(keys)
 
 
 class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
@@ -39,13 +64,17 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
 class MetricAverageCallback(keras.callbacks.Callback):
     """Average epoch metrics over ranks before other callbacks (checkpoint,
     early stopping, lr schedules) read them (reference:
-    _keras/callbacks.py:48-88)."""
+    _keras/callbacks.py:48-88).
+
+    All averageable entries travel as ONE grouped vector through the same
+    engine allreduce path every rank takes (a per-key loop could interleave
+    with other collectives differently per rank); non-numeric entries and
+    lr-style schedule outputs are passed through untouched."""
 
     def on_epoch_end(self, epoch, logs=None):
         if logs is None or basics.size() == 1:
             return
-        keys = sorted(k for k, v in logs.items()
-                      if np.isscalar(v) or getattr(v, "ndim", 1) == 0)
+        keys = _averageable_keys(logs)
         if not keys:
             return
         vals = np.asarray([float(logs[k]) for k in keys], np.float64)
@@ -53,6 +82,43 @@ class MetricAverageCallback(keras.callbacks.Callback):
             vals, op=_eager.Average, name=f"metric_avg.e{epoch}"))
         for k, v in zip(keys, np.asarray(avg)):
             logs[k] = float(v)
+
+
+class MetricsCallback(keras.callbacks.Callback):
+    """Feed per-batch step durations and epoch metrics into the process
+    metrics registry (horovod_tpu.metrics) — served by the Prometheus
+    exporter when ``HOROVOD_METRICS_PORT`` is set, and consumed by the
+    elastic driver's straggler detection via the shared
+    ``hvd_frontend_step_seconds`` histogram."""
+
+    def __init__(self, registry=None):
+        super().__init__()
+        from horovod_tpu import metrics as _metrics
+        self._registry = registry if registry is not None \
+            else _metrics.get_registry()
+        self._hist = self._registry.histogram(_metrics.STEP_SECONDS,
+                                              framework="keras")
+        self._steps = self._registry.counter(_metrics.STEPS_TOTAL,
+                                             framework="keras")
+        self._epochs = self._registry.counter(
+            "hvd_frontend_epochs_total", framework="keras")
+        self._t0 = None
+
+    def on_train_batch_begin(self, batch, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, batch, logs=None):
+        if self._t0 is not None:
+            self._hist.observe(time.perf_counter() - self._t0)
+            self._t0 = None
+        self._steps.inc()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epochs.inc()
+        for k in _averageable_keys(logs or {}):
+            self._registry.gauge("hvd_frontend_epoch_metric",
+                                 framework="keras",
+                                 metric=k).set(float(logs[k]))
 
 
 class LearningRateScheduleCallback(keras.callbacks.Callback):

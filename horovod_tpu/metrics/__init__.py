@@ -1,0 +1,138 @@
+"""Runtime metrics & cluster health telemetry.
+
+The *monitoring* layer of the observability stack (the PR-2 profiler is
+the *attribution* layer): live numeric telemetry from the running engine
+and the Python hot paths, exported per worker in Prometheus text format
+and aggregated by the elastic driver into straggler events.
+
+Data flow (docs/DESIGN.md "Observability"):
+
+    C++ MetricsStore ──hvdtpu_metrics_snapshot──▶ Session.metrics()
+                                                     │ engine_collector
+    Python hot paths ──registry instruments──▶ MetricsRegistry
+                                                     │ prom.render
+                         HOROVOD_METRICS_PORT ──▶ /metrics (per worker)
+                                                     │ heartbeat scrape
+                         elastic driver ──▶ step-time skew ──▶ straggler
+                                                               events
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from horovod_tpu.metrics.exporter import (  # noqa: F401
+    MetricsExporter,
+    start_exporter_from_env,
+)
+from horovod_tpu.metrics.registry import (  # noqa: F401
+    DEFAULT_SIZE_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    HistogramValue,
+    Metric,
+    MetricsRegistry,
+    engine_collector,
+    get_registry,
+)
+from horovod_tpu.metrics.straggler import StragglerDetector  # noqa: F401
+
+# Family names shared by every frontend step timer (keras callback, torch
+# optimizer, the jax make_train_step wrapper) — the driver's straggler
+# detection sums across frameworks, so they must agree.
+STEP_SECONDS = "hvd_frontend_step_seconds"
+STEPS_TOTAL = "hvd_frontend_steps_total"
+
+
+class _TimedStep:
+    """Wraps a (jitted) step callable: records wall time per invocation
+    into the shared step-time histogram while forwarding everything else
+    (``.lower``, AOT attributes) to the wrapped function."""
+
+    def __init__(self, fn, framework: str):
+        self._fn = fn
+        self._hist = get_registry().histogram(STEP_SECONDS,
+                                              framework=framework)
+        self._steps = get_registry().counter(STEPS_TOTAL,
+                                             framework=framework)
+
+    def __call__(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        self._hist.observe(time.perf_counter() - t0)
+        self._steps.inc()
+        return out
+
+    def __getattr__(self, item):
+        # Never forward private/dunder probes: pickle and copy interrogate
+        # __setstate__/__reduce__ before __init__ has run, and forwarding
+        # would re-enter this method on the missing _fn (RecursionError).
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return getattr(object.__getattribute__(self, "_fn"), item)
+
+
+def timed_step(fn, framework: str):
+    """Instrument a train-step callable with the shared step timer.
+
+    Note the async-dispatch caveat: under jax the recorded time is the
+    dispatch+donation wall time of the call, which converges to the true
+    step time in any steady-state loop (the next dispatch blocks on the
+    previous step's donated buffers)."""
+    return _TimedStep(fn, framework)
+
+
+def record_step(framework: str, seconds: float,
+                registry: Optional[MetricsRegistry] = None):
+    """Record one frontend step duration (used by frontends that own their
+    own timing, e.g. the torch optimizer and the keras callback)."""
+    reg = registry if registry is not None else get_registry()
+    reg.histogram(STEP_SECONDS, framework=framework).observe(seconds)
+    reg.counter(STEPS_TOTAL, framework=framework).inc()
+
+
+def step_stats(snapshot: dict) -> Optional[tuple]:
+    """(count, sum_seconds) of the step-time histogram across frameworks
+    from a ``/metrics.json`` snapshot — what the driver diffs per window.
+    None when the worker has recorded no steps yet."""
+    total_count, total_sum = 0, 0.0
+    for m in snapshot.get("metrics", []):
+        if m.get("name") != STEP_SECONDS:
+            continue
+        for s in m.get("samples", []):
+            total_count += int(s.get("count", 0))
+            total_sum += float(s.get("sum", 0.0))
+    return (total_count, total_sum) if total_count else None
+
+
+def bench_snapshot() -> dict:
+    """Compact engine + frontend telemetry for the BENCH json
+    (``engine_metrics`` field): the perf trajectory records cache hit
+    rate and fusion efficiency alongside img/s, not instead of them."""
+    out: dict = {"engine": None}
+    reg_snap = get_registry().snapshot()
+    st = step_stats(reg_snap)
+    if st:
+        out["frontend_steps"] = st[0]
+        out["frontend_step_seconds_mean"] = round(st[1] / st[0], 6)
+    try:
+        from horovod_tpu.common import basics
+        engine = basics._context().engine
+    except Exception:  # noqa: BLE001
+        engine = None
+    if engine is not None:
+        snap = engine.metrics()
+        c = snap.get("counters", {})
+        hits, misses = c.get("cache_hits", 0), c.get("cache_misses", 0)
+        resp, tensors = c.get("responses", 0), c.get("fused_tensors", 0)
+        out["engine"] = {
+            "counters": c,
+            "cache_hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else None,
+            "fusion_mean_tensors_per_response": round(tensors / resp, 3)
+            if resp else None,
+        }
+    return out

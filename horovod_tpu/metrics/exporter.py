@@ -1,0 +1,147 @@
+"""Per-worker Prometheus HTTP endpoint.
+
+Follows the ``runner/http_kv.py`` stdlib-server pattern: a daemonized
+ThreadingHTTPServer, port 0 for ephemeral binding in tests. Two routes:
+
+- ``GET /metrics``       — Prometheus text format (scrape target);
+- ``GET /metrics.json``  — the registry's JSON snapshot (what the elastic
+  driver polls on its heartbeat for straggler detection — structured,
+  so the driver doesn't re-parse the text format).
+
+Off by default: nothing binds unless ``HOROVOD_METRICS_PORT`` is set (see
+``start_exporter_from_env``). Multiple workers per host offset the base
+port by ``HOROVOD_LOCAL_RANK`` so one env value serves the whole host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from horovod_tpu.metrics import prom
+from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+
+
+class MetricsExporter:
+    """Threaded HTTP exporter over one registry."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 port: int = 0, addr: str = "0.0.0.0",
+                 labels: Optional[Dict[str, str]] = None):
+        self.registry = registry if registry is not None else get_registry()
+        self.labels = dict(labels or {})
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    body = prom.render(exporter.registry.collect(),
+                                       exporter.labels).encode()
+                    ctype = prom.CONTENT_TYPE
+                elif path == "/metrics.json":
+                    snap = exporter.registry.snapshot()
+                    snap["labels"] = exporter.labels
+                    body = json.dumps(snap).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((addr, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsExporter":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def start_exporter_from_env(registry: Optional[MetricsRegistry] = None,
+                            rank: Optional[int] = None,
+                            engine=None) -> Optional[MetricsExporter]:
+    """Boot the per-worker endpoint when ``HOROVOD_METRICS_PORT`` is set
+    (off by default). Called by ``hvd.init()``.
+
+    - actual port = base + ``HOROVOD_LOCAL_RANK`` (base > 0), or ephemeral
+      when the base itself is 0 (tests);
+    - constant labels: ``rank`` and ``job`` (``HOROVOD_JOB_NAME``);
+    - when an engine session is given, its ``hvd_engine_*`` collector is
+      (re-)registered under the fixed name "engine" so elastic re-inits
+      replace rather than stack collectors;
+    - in an elastic job the endpoint address is published to the rendezvous
+      KV under ``metrics_addr/<host>/<local_rank>`` for the driver's
+      heartbeat scrape.
+
+    Failure to bind logs a warning and returns None: observability must
+    never take down training.
+    """
+    port_env = os.environ.get("HOROVOD_METRICS_PORT", "")
+    if port_env == "":
+        return None
+    from horovod_tpu.common.hvd_logging import get_logger
+    log = get_logger("metrics")
+    try:
+        base = int(port_env.strip())
+        local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", "0") or 0)
+    except ValueError:
+        # a malformed telemetry env var must not take down training
+        log.warning("ignoring malformed HOROVOD_METRICS_PORT=%r", port_env)
+        return None
+    port = base + local_rank if base > 0 else 0
+    reg = registry if registry is not None else get_registry()
+    if engine is not None:
+        from horovod_tpu.metrics.registry import engine_collector
+        reg.register_collector(engine_collector(engine), name="engine")
+    labels = {"rank": str(rank if rank is not None else
+                          os.environ.get("HOROVOD_RANK", "0")),
+              "job": os.environ.get("HOROVOD_JOB_NAME", "default")}
+    try:
+        exporter = MetricsExporter(reg, port=port, labels=labels).start()
+    except OSError as e:
+        log.warning("metrics exporter could not bind port %s: %s", port, e)
+        return None
+    log.info("metrics endpoint on :%d/metrics", exporter.port)
+    _publish_endpoint(exporter, log)
+    return exporter
+
+
+def _publish_endpoint(exporter: MetricsExporter, log):
+    """Elastic jobs: tell the driver where to scrape this worker."""
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+    kv_port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+    if not addr or not kv_port:
+        return
+    try:
+        from horovod_tpu.runner.http_kv import KVClient
+        host = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
+        local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+        scrape_addr = "127.0.0.1" if host == "localhost" else host
+        KVClient(addr, int(kv_port)).put_json(
+            f"metrics_addr/{host}/{local_rank}",
+            {"addr": scrape_addr, "port": exporter.port,
+             "rank": int(os.environ.get("HOROVOD_RANK", "0"))},
+            timeout=5.0)
+    except Exception as e:  # noqa: BLE001 — best-effort publication
+        log.warning("could not publish metrics endpoint: %s", e)

@@ -1,0 +1,127 @@
+"""Prometheus text exposition format (version 0.0.4) rendering.
+
+Kept dependency-free: the wire format is a handful of escaping rules and
+the cumulative-``le`` histogram convention, not worth a client library.
+Constant labels (``rank``, ``job``) are merged into every sample so a
+cluster-level Prometheus can aggregate per-worker scrapes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Tuple
+
+from horovod_tpu.metrics.registry import HistogramValue, Metric
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n") \
+        .replace('"', '\\"')
+
+
+def _fmt_labels(pairs: Iterable[Tuple[str, str]]) -> str:
+    items = [f'{k}="{_escape_label(v)}"' for k, v in pairs]
+    return "{" + ",".join(items) + "}" if items else ""
+
+
+def _fmt_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _merged(sample_labels, const_labels: Dict[str, str],
+            extra: Dict[str, str] = None) -> List[Tuple[str, str]]:
+    merged = dict(const_labels)
+    merged.update(dict(sample_labels))
+    if extra:
+        merged.update(extra)
+    return sorted(merged.items())
+
+
+def render(metrics: Iterable[Metric],
+           const_labels: Dict[str, str] = None) -> str:
+    """Render families into the text format. Histogram buckets are emitted
+    cumulatively with the ``le`` label plus the required ``+Inf`` bucket,
+    ``_sum`` and ``_count`` series."""
+    const_labels = const_labels or {}
+    lines: List[str] = []
+    for m in metrics:
+        if not m.samples:
+            continue
+        if m.help:
+            lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        for sample_labels, value in m.samples:
+            if isinstance(value, HistogramValue):
+                cum = 0
+                for bound, count in zip(value.bounds, value.counts):
+                    cum += count
+                    labels = _merged(sample_labels, const_labels,
+                                     {"le": _fmt_value(bound)})
+                    lines.append(
+                        f"{m.name}_bucket{_fmt_labels(labels)} {cum}")
+                labels = _merged(sample_labels, const_labels,
+                                 {"le": "+Inf"})
+                lines.append(
+                    f"{m.name}_bucket{_fmt_labels(labels)} {value.count}")
+                base = _fmt_labels(_merged(sample_labels, const_labels))
+                lines.append(f"{m.name}_sum{base} {_fmt_value(value.sum)}")
+                lines.append(f"{m.name}_count{base} {value.count}")
+            else:
+                labels = _fmt_labels(_merged(sample_labels, const_labels))
+                lines.append(f"{m.name}{labels} {_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_samples(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...],
+                                               float]]:
+    """Minimal parser for tests/diagnostics: {name: {labels_tuple: value}}.
+    Handles the subset render() emits (no exemplars, no timestamps)."""
+    out: Dict[str, Dict[tuple, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        labels: List[Tuple[str, str]] = []
+        if "{" in name_part:
+            name, _, rest = name_part.partition("{")
+            body = rest.rstrip("}")
+            # label values render() emits never contain unescaped commas
+            # inside quotes beyond these simple cases
+            for item in _split_labels(body):
+                k, _, v = item.partition("=")
+                labels.append((k, v.strip('"').replace('\\"', '"')
+                               .replace("\\n", "\n").replace("\\\\", "\\")))
+        else:
+            name = name_part
+        value = float("inf") if value_part == "+Inf" else float(value_part)
+        out.setdefault(name, {})[tuple(sorted(labels))] = value
+    return out
+
+
+def _split_labels(body: str) -> List[str]:
+    items, cur, in_q, esc = [], [], False, False
+    for ch in body:
+        if esc:
+            cur.append(ch)
+            esc = False
+        elif ch == "\\":
+            cur.append(ch)
+            esc = True
+        elif ch == '"':
+            cur.append(ch)
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            items.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        items.append("".join(cur))
+    return items

@@ -1,0 +1,265 @@
+"""Lock-cheap process-local metrics registry.
+
+The monitoring half of the observability stack (the profiler subsystem is
+the post-hoc half, docs/DESIGN.md): counters, gauges and fixed-bucket
+histograms fed from the Python hot paths (eager executor phases, frontend
+step timers, traced in-jit collectives), plus pluggable *collectors* that
+pull external sources at scrape time — chiefly the native engine's
+``Session.metrics()`` JSON snapshot.
+
+Design constraints:
+- recording must be cheap enough to sit on the eager hot path: one
+  ``threading.Lock`` acquire + an int add (~100ns) — no string formatting,
+  no allocation on the hot path after the first call;
+- metric identity is (name, sorted labels), Prometheus-style, so the
+  exporter can render families directly;
+- no third-party deps (the container bakes nothing in for this).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, \
+    Sequence, Tuple
+
+# Default buckets, in seconds, spanning eager-collective latencies (100us)
+# through slow multi-second steps.
+DEFAULT_TIME_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+# Size-ish buckets (bytes, tensor counts).
+DEFAULT_SIZE_BUCKETS = (
+    1, 2, 4, 8, 16, 32, 64, 128, 1024, 16384, 262144, 1 << 20, 16 << 20,
+    64 << 20,
+)
+
+
+class HistogramValue(NamedTuple):
+    """Snapshot of a histogram: per-bucket (NOT cumulative) counts;
+    ``counts`` has len(bounds)+1 entries (last = overflow)."""
+    bounds: Tuple[float, ...]
+    counts: Tuple[int, ...]
+    sum: float
+    count: int
+
+
+class Metric(NamedTuple):
+    """One family ready for rendering. ``samples`` maps a labels tuple
+    (sorted (k, v) pairs) to a float (counter/gauge) or HistogramValue."""
+    name: str
+    kind: str  # "counter" | "gauge" | "histogram"
+    help: str
+    samples: List[Tuple[Tuple[Tuple[str, str], ...], object]]
+
+
+class Counter:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    __slots__ = ("_lock", "bounds", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS):
+        self._lock = threading.Lock()
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float):
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> HistogramValue:
+        with self._lock:
+            return HistogramValue(self.bounds, tuple(self._counts),
+                                  self._sum, self._count)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Family:
+    def __init__(self, kind: str, help: str):
+        self.kind = kind
+        self.help = help
+        self.children: Dict[tuple, object] = {}
+
+
+class MetricsRegistry:
+    """Create-or-get instruments by (name, labels); collect families."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        self._collectors: Dict[str, Callable[[], Iterable[Metric]]] = {}
+
+    def _get(self, name: str, kind: str, help: str, labels: dict, factory):
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(kind, help)
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name} already registered as {fam.kind}")
+            child = fam.children.get(key)
+            if child is None:
+                child = fam.children[key] = factory()
+            return child
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._get(name, "counter", help, labels, Counter)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._get(name, "gauge", help, labels, Gauge)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+                  **labels) -> Histogram:
+        return self._get(name, "histogram", help, labels,
+                         lambda: Histogram(buckets))
+
+    def register_collector(self, fn: Callable[[], Iterable[Metric]],
+                           name: str = ""):
+        """Attach a pull-source invoked at collect() time. Re-registering
+        under the same ``name`` replaces the previous one (elastic re-init
+        swaps the engine session without leaking a dead collector)."""
+        with self._lock:
+            self._collectors[name or f"_anon{len(self._collectors)}"] = fn
+
+    def collect(self) -> List[Metric]:
+        with self._lock:
+            fams = {n: (f.kind, f.help, dict(f.children))
+                    for n, f in self._families.items()}
+            collectors = list(self._collectors.values())
+        out: List[Metric] = []
+        for name, (kind, help, children) in sorted(fams.items()):
+            samples = []
+            for key, child in sorted(children.items()):
+                if kind == "histogram":
+                    samples.append((key, child.snapshot()))
+                else:
+                    samples.append((key, child.value))
+            out.append(Metric(name, kind, help, samples))
+        for fn in collectors:
+            try:
+                out.extend(fn())
+            except Exception:  # noqa: BLE001 — a dead source must not
+                pass           # poison the scrape of everything else
+        return out
+
+    def snapshot(self) -> dict:
+        """JSON-able view (the /metrics.json endpoint the elastic driver
+        scrapes)."""
+        metrics = []
+        for m in self.collect():
+            samples = []
+            for key, v in m.samples:
+                entry = {"labels": dict(key)}
+                if isinstance(v, HistogramValue):
+                    entry.update(bounds=list(v.bounds),
+                                 counts=list(v.counts),
+                                 sum=v.sum, count=v.count)
+                else:
+                    entry["value"] = v
+                samples.append(entry)
+            metrics.append({"name": m.name, "kind": m.kind,
+                            "samples": samples})
+        return {"metrics": metrics}
+
+
+# ---------------------------------------------------------------------------
+# engine bridge
+
+# Engine histogram names carrying microsecond units, converted to seconds
+# on export (Prometheus convention).
+_US_HISTOGRAMS = {"cycle_us": "cycle_seconds", "exec_us": "exec_seconds"}
+
+
+def engine_collector(session) -> Callable[[], List[Metric]]:
+    """Collector pulling ``session.metrics()`` (the C++ MetricsStore
+    snapshot) into ``hvd_engine_*`` families at scrape time."""
+
+    def collect() -> List[Metric]:
+        try:
+            snap = session.metrics()
+        except Exception:  # noqa: BLE001 — session shut down mid-scrape
+            return []
+        if not snap:
+            return []
+        out: List[Metric] = []
+        for k, v in sorted(snap.get("counters", {}).items()):
+            out.append(Metric(f"hvd_engine_{k}_total", "counter", "",
+                              [((), float(v))]))
+        for k, v in sorted(snap.get("gauges", {}).items()):
+            out.append(Metric(f"hvd_engine_{k}", "gauge", "",
+                              [((), float(v))]))
+        for k, h in sorted(snap.get("histograms", {}).items()):
+            name, scale = k, 1.0
+            if k in _US_HISTOGRAMS:
+                name, scale = _US_HISTOGRAMS[k], 1e-6
+            hv = HistogramValue(
+                tuple(b * scale for b in h["bounds"]),
+                tuple(h["counts"]), h["sum"] * scale, h["count"])
+            out.append(Metric(f"hvd_engine_{name}", "histogram", "",
+                              [((), hv)]))
+        return out
+
+    return collect
+
+
+# ---------------------------------------------------------------------------
+# process-global default registry
+
+_default_registry: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry()
+        return _default_registry

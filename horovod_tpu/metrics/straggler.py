@@ -1,0 +1,74 @@
+"""Per-rank step-time skew analysis (straggler detection).
+
+The elastic driver scrapes every worker's ``/metrics.json`` on its
+discovery heartbeat, turns the ``hvd_frontend_step_seconds`` histogram
+deltas into a mean step time per rank per window, and feeds the windows
+here. A rank is flagged when its step time exceeds
+``median + k * sigma`` of its *peers* (leave-one-out — with small worlds
+the straggler itself would otherwise inflate the median and sigma it is
+judged against) for ``windows`` consecutive heartbeats.
+
+``sigma`` is floored at ``min_rel_skew * median`` so a perfectly uniform
+fleet (sigma → 0) doesn't flag micro-jitter, and a rank is only re-flagged
+after it recovers (one structured event per slow episode, not one per
+heartbeat).
+
+Pure logic, no I/O — unit-testable without processes; the driver owns the
+scraping and the structured-event logging.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Dict, List
+
+
+class StragglerDetector:
+    def __init__(self, k: float = 3.0, windows: int = 3,
+                 min_rel_skew: float = 0.05):
+        self.k = float(k)
+        self.windows = int(windows)
+        self.min_rel_skew = float(min_rel_skew)
+        self._streak: Dict[int, int] = {}
+        self._flagged: set = set()
+
+    def update(self, step_times: Dict[int, float]) -> List[dict]:
+        """Feed one window of per-rank mean step times; returns the
+        structured straggler events that fired on this window."""
+        events: List[dict] = []
+        # ranks that disappeared (scrape failure / rescale) lose their state
+        for r in list(self._streak):
+            if r not in step_times:
+                self._streak.pop(r, None)
+                self._flagged.discard(r)
+        if len(step_times) < 2:
+            return events
+        for r, t in step_times.items():
+            others = [v for o, v in step_times.items() if o != r]
+            med = statistics.median(others)
+            sigma = statistics.pstdev(others) if len(others) > 1 else 0.0
+            sigma = max(sigma, self.min_rel_skew * med)
+            threshold = med + self.k * sigma
+            if med > 0 and t > threshold:
+                self._streak[r] = self._streak.get(r, 0) + 1
+            else:
+                self._streak.pop(r, None)
+                self._flagged.discard(r)
+                continue
+            if self._streak[r] >= self.windows and r not in self._flagged:
+                self._flagged.add(r)
+                events.append({
+                    "event": "straggler",
+                    "rank": r,
+                    "step_time_sec": t,
+                    "median_sec": med,
+                    "sigma_sec": sigma,
+                    "threshold_sec": threshold,
+                    "consecutive_windows": self._streak[r],
+                })
+        return events
+
+    @property
+    def flagged(self) -> set:
+        """Ranks currently in a flagged episode."""
+        return set(self._flagged)

@@ -34,6 +34,17 @@ from horovod_tpu.profiler.annotate import collective_scope
 DEFAULT_AXIS = "data"
 
 
+def _count_trace(kind: str):
+    """Monitoring: count collective *insertions* at trace time. In-jit
+    collectives execute inside the compiled program where no Python runs,
+    so the honest live signal is how many of each kind each (re)trace
+    emits — a retrace storm or an unexpected collective mix shows up here
+    (runtime bytes/latency live in the device trace, profiler layer)."""
+    from horovod_tpu.metrics.registry import get_registry
+    get_registry().counter("hvd_injit_collective_traces_total",
+                           kind=kind).inc()
+
+
 def _scale(x, factor):
     if factor is None or factor == 1.0:
         return x
@@ -78,6 +89,7 @@ def allreduce(x: jax.Array,
     ``accumulate_in_fp32=False`` keeps low-precision inputs in their dtype on
     the wire — the point of fp16/bf16 compression (half the ICI bytes);
     compressed paths set it."""
+    _count_trace(f"allreduce_{op.value}")
     with collective_scope(f"hvd_allreduce_{op.value}"):
         return _allreduce(x, op, axis, prescale_factor, postscale_factor,
                           accumulate_in_fp32)
@@ -172,6 +184,7 @@ def hierarchical_allreduce(x: jax.Array,
                          prescale_factor=prescale_factor,
                          postscale_factor=postscale_factor,
                          accumulate_in_fp32=accumulate_in_fp32)
+    _count_trace(f"hierarchical_allreduce_{op.value}")
     with collective_scope(f"hvd_hierarchical_allreduce_{op.value}"):
         return _hierarchical_allreduce(
             x, op, outer_axis, inner_axis, prescale_factor,
@@ -212,6 +225,7 @@ def allgather(x: jax.Array, axis=DEFAULT_AXIS) -> jax.Array:
     per-rank sizes) are handled by the eager engine path via padding
     (horovod_tpu.jax.mpi_ops).
     """
+    _count_trace("allgather")
     with collective_scope("hvd_allgather"):
         return lax.all_gather(x, axis, axis=0, tiled=True)
 
@@ -220,6 +234,7 @@ def broadcast(x: jax.Array, root_rank: int, axis=DEFAULT_AXIS) -> jax.Array:
     """Every rank receives root's value (reference: EnqueueTensorBroadcast,
     operations.cc:1062). Implemented as a masked psum — a single collective,
     no gather of all shards."""
+    _count_trace("broadcast")
     with collective_scope("hvd_broadcast"):
         idx = axis_rank(axis)
         orig_dtype = x.dtype
@@ -237,6 +252,7 @@ def alltoall(x: jax.Array,
     """Scatter equal slices of ``x`` to every rank and gather their slices
     (reference: EnqueueTensorAlltoall, operations.cc:1101; even-split case of
     MPI_Alltoallv). Ragged splits go through the eager engine path."""
+    _count_trace("alltoall")
     with collective_scope("hvd_alltoall"):
         return lax.all_to_all(x, axis, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
@@ -249,6 +265,7 @@ def reducescatter(x: jax.Array, op: Op = Average, axis=DEFAULT_AXIS) -> jax.Arra
     psum_scatter is the natural TPU gradient-sharding primitive."""
     if op not in (Average, Sum):
         raise ValueError(f"reducescatter supports Sum/Average, got {op}")
+    _count_trace(f"reducescatter_{op.value}")
     with collective_scope(f"hvd_reducescatter_{op.value}"):
         out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
         if op is Average:
@@ -274,6 +291,7 @@ def quantized_reducescatter(x: jax.Array,
     if op not in (Average, Sum):
         raise ValueError(f"quantized_reducescatter supports Sum/Average, "
                          f"got {op}")
+    _count_trace(f"quantized_reducescatter_{op.value}")
     with collective_scope(f"hvd_quantized_reducescatter_{op.value}"):
         n = axis_size(axis)
         rows = x.reshape(n, -1)
@@ -297,6 +315,7 @@ def quantized_allgather(x: jax.Array,
     fp32 scales; returns the concatenated fp32 array (rank order, dim 0)."""
     from horovod_tpu.jax.compression import (block_dequantize_rows,
                                              block_quantize_rows)
+    _count_trace("quantized_allgather")
     with collective_scope("hvd_quantized_allgather"):
         payload, scales = block_quantize_rows(x.reshape(1, -1), block_size)
         payload = lax.all_gather(payload, axis, axis=0, tiled=False)

@@ -224,7 +224,12 @@ def make_train_step(loss_fn: Callable,
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(mapped, donate_argnums=donate_argnums)
+    # Step-timer wrapper (metrics monitoring layer): records wall time per
+    # invocation into the shared hvd_frontend_step_seconds histogram while
+    # forwarding .lower()/AOT attributes to the jitted function.
+    from horovod_tpu.metrics import timed_step
+    return timed_step(jax.jit(mapped, donate_argnums=donate_argnums),
+                      framework="jax")
 
 
 def make_stateful_train_step(loss_fn: Callable,
@@ -288,7 +293,9 @@ def make_stateful_train_step(loss_fn: Callable,
         out_specs=StatefulTrainStepOutput(P(), opt_spec, P(), P(), P()),
         check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
-    return jax.jit(mapped, donate_argnums=donate_argnums)
+    from horovod_tpu.metrics import timed_step
+    return timed_step(jax.jit(mapped, donate_argnums=donate_argnums),
+                      framework="jax")
 
 
 def make_eval_step(apply_fn: Callable, mesh: Mesh,

@@ -12,14 +12,32 @@ Workers learn about the change either by a collective failure
 (HorovodInternalError) or the notify key (polled inside the training
 process, reference: WorkerNotificationService, runner/elastic/worker.py),
 then reset: shutdown engine → re-query topology → re-init.
+
+Cluster health (observability layer): workers running with
+``HOROVOD_METRICS_PORT`` publish their metrics endpoint to the rendezvous
+KV (``metrics_addr/<host>/<slot>``); the discovery heartbeat scrapes each
+worker's ``/metrics.json``, diffs the shared step-time histogram into a
+per-rank mean step time per window, and flags stragglers (> k sigma slower
+than the peer median for M consecutive windows — HOROVOD_STRAGGLER_STDDEVS
+/ HOROVOD_STRAGGLER_WINDOWS) as structured JSON events: logged, kept in
+``straggler_events``, and published under ``straggler/g<N>/<rank>`` so
+schedulers can act on them the way the stall-inspector report is actionable
+inside the engine.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
+from urllib import request as urlrequest
+
+from horovod_tpu.common.hvd_logging import get_logger
+from horovod_tpu.metrics import step_stats
+from horovod_tpu.metrics.straggler import StragglerDetector
 
 from horovod_tpu.runner import hosts as hosts_lib
 from horovod_tpu.runner.elastic.discovery import HostDiscovery, HostManager
@@ -76,6 +94,14 @@ class ElasticDriver:
         self._expected_slots: List[Tuple[str, int]] = []
         self._go_deadline: float = 0.0
         self._go_published: set = set()
+        self._logger = get_logger("elastic.driver")
+        # straggler detection over scraped worker step times
+        self._straggler = StragglerDetector(
+            k=float(os.environ.get("HOROVOD_STRAGGLER_STDDEVS", "3.0")),
+            windows=int(os.environ.get("HOROVOD_STRAGGLER_WINDOWS", "3")))
+        # (host, slot) -> last (step_count, step_seconds_sum) observed
+        self._metrics_prev: Dict[Tuple[str, int], Tuple[int, float]] = {}
+        self.straggler_events: List[dict] = []
         self._lock = threading.Lock()
         self._rebalance_needed = threading.Event()
         self._shutdown = threading.Event()
@@ -142,6 +168,10 @@ class ElasticDriver:
                 self._log(f"discovery error: {e}")
                 continue
             self._reap_workers()
+            try:
+                self._scrape_worker_metrics()
+            except Exception as e:  # noqa: BLE001 — telemetry must never
+                self._log(f"metrics scrape error: {e!r}")  # kill the driver
             if changed or self._rebalance_needed.is_set():
                 available = sum(self._hosts.current.values())
                 if available >= self._min_np:
@@ -255,6 +285,7 @@ class ElasticDriver:
                 # trailing "/" so g1 can't swallow g10's keys
                 self._kv.delete_prefix(f"rank_and_size/g{old}/")
                 self._kv.delete_prefix(f"worker_state/g{old}/")
+                self._kv.delete_prefix(f"straggler/g{old}/")
                 self._kv.delete(f"go/g{old}")
                 self._kv.delete(f"reset_request/g{old}")
                 self._go_published.discard(old)
@@ -306,6 +337,57 @@ class ElasticDriver:
                 # discovery view, which raced with the discovery thread
                 self._rebalance_needed.set()
 
+    # -- cluster health (metrics scrape + straggler detection) --------------
+
+    def _scrape_worker_metrics(self):
+        """One heartbeat window: pull every expected slot's /metrics.json
+        (endpoint published by the worker's exporter under
+        ``metrics_addr/<host>/<slot>``), diff the step-time histogram, and
+        feed the per-rank window means to the straggler detector. Workers
+        without an exporter (metrics off) are simply absent."""
+        with self._lock:
+            slots = list(self._expected_slots)
+        times: Dict[int, float] = {}
+        for host, local_rank in slots:
+            info = self._kv.get_json(f"metrics_addr/{host}/{local_rank}")
+            if not info:
+                continue
+            try:
+                url = f"http://{info['addr']}:{info['port']}/metrics.json"
+                with urlrequest.urlopen(url, timeout=2.0) as resp:
+                    snap = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 — worker mid-restart
+                continue
+            stats = step_stats(snap)
+            if stats is None:
+                continue
+            key = (host, local_rank)
+            prev = self._metrics_prev.get(key)
+            self._metrics_prev[key] = stats
+            if prev is not None and stats[0] > prev[0]:
+                times[int(info.get("rank", -1))] = \
+                    (stats[1] - prev[1]) / (stats[0] - prev[0])
+        if times:
+            self._ingest_step_times(times)
+
+    def _ingest_step_times(self, step_times: Dict[int, float]):
+        """Feed one window of per-rank mean step times; log/publish the
+        structured events that fire. Split from the scraper so tests can
+        drive the detection without processes or HTTP."""
+        for event in self._straggler.update(step_times):
+            with self._lock:
+                event["generation"] = self._generation
+            self.straggler_events.append(event)
+            self._logger.warning("straggler detected: %s",
+                                 json.dumps(event))
+            self._log(f"straggler event: {json.dumps(event)}")
+            try:
+                self._kv.put_json(
+                    f"straggler/g{event['generation']}/{event['rank']}",
+                    event)
+            except Exception:  # noqa: BLE001
+                pass
+
     def _wait_for_completion(self) -> int:
         while not self._shutdown.is_set():
             time.sleep(0.2)
@@ -320,6 +402,9 @@ class ElasticDriver:
         return self._result if self._result is not None else 1
 
     def _log(self, msg: str):
+        # route through the HOROVOD_LOG_LEVEL-configured logger; --verbose
+        # keeps the historical always-on stderr stream for the launcher UX
+        self._logger.info(msg)
         if self._verbose:
             sys.stderr.write(f"[elastic-driver] {msg}\n")
             sys.stderr.flush()

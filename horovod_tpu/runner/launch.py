@@ -503,6 +503,11 @@ def run_elastic(args) -> int:
 
 
 def run_commandline(argv: Optional[List[str]] = None) -> int:
+    # Launcher-side logging honors the same HOROVOD_LOG_LEVEL /
+    # HOROVOD_LOG_TIMESTAMP knobs as the engine and workers (satellite:
+    # one knob set for the whole stack — table in docs/DESIGN.md).
+    from horovod_tpu.common.hvd_logging import setup_python_logging
+    setup_python_logging()
     parser = make_parser()
     args = parser.parse_args(argv)
     if args.check_build:

@@ -16,6 +16,7 @@ are still in backward.
 from __future__ import annotations
 
 import contextlib
+import time as _time
 from typing import Iterator, Optional
 
 import torch
@@ -23,6 +24,22 @@ import torch
 from horovod_tpu.common import basics
 from horovod_tpu.torch import mpi_ops
 from horovod_tpu.torch.compression import Compression
+
+# Step-timer instruments, resolved once (registry-lock + label-key cost is
+# per process, not per optimizer step).
+_step_instruments = None
+
+
+def _record_torch_step(seconds: float):
+    global _step_instruments
+    if _step_instruments is None:
+        from horovod_tpu import metrics as _metrics
+        reg = _metrics.get_registry()
+        _step_instruments = (
+            reg.histogram(_metrics.STEP_SECONDS, framework="torch"),
+            reg.counter(_metrics.STEPS_TOTAL, framework="torch"))
+    _step_instruments[0].observe(seconds)
+    _step_instruments[1].inc()
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):
@@ -183,19 +200,27 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return loss
 
     def step(self, closure=None):
-        if self._should_synchronize:
-            if self._synchronized:
-                import warnings
-                warnings.warn(
-                    "optimizer.step() called without a preceding backward; "
-                    "gradients were already synchronized")
-            self.synchronize()
-        self._synchronized = False
-        if self._sharded and basics._context().size > 1:
-            if not self._owner:
-                self._compute_owners()
-            return self._sharded_step(closure)
-        return super(self.__class__, self).step(closure)
+        # Step timer (metrics monitoring layer): covers grad synchronize +
+        # the optimizer update — the torch analog of the jax train-step
+        # wrapper, feeding the same hvd_frontend_step_seconds histogram the
+        # elastic driver's straggler detection reads.
+        t0 = _time.perf_counter()
+        try:
+            if self._should_synchronize:
+                if self._synchronized:
+                    import warnings
+                    warnings.warn(
+                        "optimizer.step() called without a preceding "
+                        "backward; gradients were already synchronized")
+                self.synchronize()
+            self._synchronized = False
+            if self._sharded and basics._context().size > 1:
+                if not self._owner:
+                    self._compute_owners()
+                return self._sharded_step(closure)
+            return super(self.__class__, self).step(closure)
+        finally:
+            _record_torch_step(_time.perf_counter() - t0)
 
     def zero_grad(self, set_to_none: bool = True):
         if self._handles:

@@ -1,0 +1,388 @@
+"""Runtime metrics & telemetry: registry semantics, Prometheus text
+rendering, the per-worker HTTP exporter (in-process scrape — the
+acceptance path for the curl-able endpoint), engine counter export over a
+live 2-rank loopback run, and straggler detection (detector unit +
+elastic-driver structured events).
+
+All network tests bind port 0 and poll — no fixed ports, no sleep loops.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from horovod_tpu.metrics import (
+    MetricsExporter,
+    MetricsRegistry,
+    StragglerDetector,
+    engine_collector,
+    record_step,
+    step_stats,
+)
+from horovod_tpu.metrics import prom
+
+
+def scrape(port: int, path: str = "/metrics") -> str:
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5).read().decode()
+
+
+# ---------------------------------------------------------------------------
+# registry + text format
+
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("ops_total", type="allreduce")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    # same (name, labels) -> same instrument; new labels -> new child
+    assert reg.counter("ops_total", type="allreduce") is c
+    assert reg.counter("ops_total", type="allgather") is not c
+    g = reg.gauge("depth")
+    g.set(4)
+    g.dec()
+    assert g.value == 3
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap.count == 5
+    assert snap.counts == (1, 2, 1, 1)  # per-bucket, last = overflow
+    assert snap.sum == pytest.approx(5.605)
+
+
+def test_registry_rejects_kind_conflicts():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x")
+
+
+def test_prometheus_render_and_cumulative_buckets():
+    reg = MetricsRegistry()
+    reg.counter("hvd_ops_total", type="allreduce").inc(7)
+    h = reg.histogram("hvd_lat_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 2.0):
+        h.observe(v)
+    text = prom.render(reg.collect(), {"rank": "3", "job": "bench"})
+    assert "# TYPE hvd_ops_total counter" in text
+    assert "# TYPE hvd_lat_seconds histogram" in text
+    samples = prom.parse_samples(text)
+    labels = {"rank": "3", "job": "bench"}
+    key = tuple(sorted({**labels, "type": "allreduce"}.items()))
+    assert samples["hvd_ops_total"][key] == 7
+    # le buckets are CUMULATIVE and +Inf equals _count
+    def bkey(le):
+        return tuple(sorted({**labels, "le": le}.items()))
+    buckets = samples["hvd_lat_seconds_bucket"]
+    assert buckets[bkey("0.1")] == 1
+    assert buckets[bkey("1")] == 3
+    assert buckets[bkey("+Inf")] == 4
+    base = tuple(sorted(labels.items()))
+    assert samples["hvd_lat_seconds_count"][base] == 4
+    assert samples["hvd_lat_seconds_sum"][base] == pytest.approx(3.05)
+
+
+def test_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("c_total", path='a"b\\c\nd').inc()
+    text = prom.render(reg.collect())
+    assert 'path="a\\"b\\\\c\\nd"' in text
+
+
+# ---------------------------------------------------------------------------
+# exporter
+
+
+def test_exporter_scrape_and_monotonic_counters():
+    reg = MetricsRegistry()
+    c = reg.counter("hvd_steps_total")
+    exporter = MetricsExporter(reg, port=0,
+                               labels={"rank": "0", "job": "t"}).start()
+    try:
+        c.inc(3)
+        v1 = prom.parse_samples(scrape(exporter.port))[
+            "hvd_steps_total"][(("job", "t"), ("rank", "0"))]
+        c.inc(2)
+        v2 = prom.parse_samples(scrape(exporter.port))[
+            "hvd_steps_total"][(("job", "t"), ("rank", "0"))]
+        assert v1 == 3 and v2 == 5 and v2 >= v1  # monotonic across steps
+        # JSON view for the driver
+        snap = json.loads(scrape(exporter.port, "/metrics.json"))
+        assert snap["labels"] == {"rank": "0", "job": "t"}
+        names = {m["name"] for m in snap["metrics"]}
+        assert "hvd_steps_total" in names
+        # unknown route is a 404, not a crash
+        with pytest.raises(urllib.error.HTTPError):
+            scrape(exporter.port, "/nope")
+    finally:
+        exporter.stop()
+
+
+def test_registry_concurrency_smoke():
+    """Threads hammer a counter + histogram while snapshots are taken;
+    final totals must be exact (per-instrument locking, no lost updates)."""
+    reg = MetricsRegistry()
+    c = reg.counter("n_total")
+    h = reg.histogram("h_seconds", buckets=(0.5,))
+    stop = threading.Event()
+
+    def snapshotter():
+        while not stop.is_set():
+            reg.collect()
+            reg.snapshot()
+
+    snap_threads = [threading.Thread(target=snapshotter) for _ in range(2)]
+    for t in snap_threads:
+        t.start()
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.1)
+
+    workers = [threading.Thread(target=worker) for _ in range(8)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    stop.set()
+    for t in snap_threads:
+        t.join()
+    assert c.value == 8000
+    assert h.snapshot().count == 8000
+
+
+# ---------------------------------------------------------------------------
+# engine counters over a live 2-rank loopback run, scraped via HTTP
+
+
+def test_engine_metrics_prometheus_scrape_2rank():
+    from horovod_tpu.common.eager import EagerExecutor
+    from horovod_tpu.engine import OP_ALLREDUCE, EngineSession
+
+    n = 2
+    group = f"metrics-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=n, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(n)]
+    executors = [EagerExecutor(s) for s in sessions]
+    exporters = []
+    try:
+        def run_rank(r):
+            ex = executors[r]
+            for it in range(4):  # same name re-negotiated -> cache hits
+                h = ex.submit("grad", OP_ALLREDUCE,
+                              np.full((256,), float(r), np.float32))
+                ex.session.wait(h, timeout=15.0)
+                ex.take_result("grad")
+
+        threads = [threading.Thread(target=run_rank, args=(r,))
+                   for r in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        for r in range(n):
+            reg = MetricsRegistry()
+            reg.register_collector(engine_collector(sessions[r]),
+                                   name="engine")
+            exporters.append(MetricsExporter(
+                reg, port=0, labels={"rank": str(r), "job": "t"}).start())
+
+        for r, exporter in enumerate(exporters):
+            text = scrape(exporter.port)
+            samples = prom.parse_samples(text)
+            base = (("job", "t"), ("rank", str(r)))
+            # the acceptance-criteria counter set, all with rank labels
+            assert samples["hvd_engine_allreduce_ops_total"][base] == 4
+            assert samples["hvd_engine_allreduce_bytes_total"][base] == \
+                4 * 256 * 4
+            hits = samples["hvd_engine_cache_hits_total"][base]
+            misses = samples["hvd_engine_cache_misses_total"][base]
+            assert misses >= 1 and hits >= 2  # steady state rode the cache
+            assert "hvd_engine_queue_depth" in samples
+            assert samples["hvd_engine_stall_warnings_total"][base] == 0
+            # histograms: fusion batch sizes + engine latencies in seconds
+            assert samples["hvd_engine_fusion_batch_tensors_count"][base] \
+                == 4
+            assert samples["hvd_engine_exec_seconds_count"][base] >= 4
+            assert "hvd_engine_cycle_seconds_bucket" in text
+    finally:
+        for exporter in exporters:
+            exporter.stop()
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+
+def test_eager_phase_histograms_recorded():
+    """The eager executor feeds enqueue/exec/wait phase latencies into the
+    process registry (the 'phase-latency histograms' half of the endpoint
+    acceptance criterion)."""
+    from horovod_tpu.common import eager
+    from horovod_tpu.common.eager import EagerExecutor
+    from horovod_tpu.engine import OP_ALLREDUCE, EngineSession
+    from horovod_tpu.metrics import get_registry
+
+    def phase_count(phase):
+        h = get_registry().histogram("hvd_eager_phase_seconds", phase=phase)
+        return h.snapshot().count
+
+    before = {p: phase_count(p) for p in ("enqueue", "exec", "wait")}
+    group = f"phases-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=2, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(2)]
+    executors = [EagerExecutor(s) for s in sessions]
+    try:
+        handles = [ex.submit("p", OP_ALLREDUCE,
+                             np.ones((8,), np.float32)) for ex in executors]
+
+        def wait_rank(r):
+            from horovod_tpu.common.eager import Handle
+            eager.synchronize(Handle(executors[r], handles[r], "p"))
+
+        threads = [threading.Thread(target=wait_rank, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for p in ("enqueue", "exec", "wait"):
+            assert phase_count(p) > before[p], p
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+
+
+def test_straggler_detector_flags_consistent_outlier():
+    d = StragglerDetector(k=3.0, windows=3)
+    events = []
+    for _ in range(2):
+        events += d.update({0: 0.10, 1: 0.11, 2: 0.50, 3: 0.10})
+    assert events == []  # below the consecutive-window threshold
+    events += d.update({0: 0.10, 1: 0.11, 2: 0.50, 3: 0.10})
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["event"] == "straggler" and ev["rank"] == 2
+    assert ev["step_time_sec"] > ev["threshold_sec"]
+    assert ev["consecutive_windows"] == 3
+    # still slow: no duplicate event for the same episode
+    assert d.update({0: 0.10, 1: 0.11, 2: 0.50, 3: 0.10}) == []
+    # recovery clears the flag; a relapse re-fires after M fresh windows
+    d.update({0: 0.10, 1: 0.11, 2: 0.10, 3: 0.10})
+    assert d.flagged == set()
+    relapse = []
+    for _ in range(3):
+        relapse += d.update({0: 0.10, 1: 0.11, 2: 0.50, 3: 0.10})
+    assert len(relapse) == 1
+
+
+def test_straggler_detector_uniform_fleet_never_flags():
+    d = StragglerDetector(k=3.0, windows=2)
+    for _ in range(10):
+        assert d.update({0: 0.100, 1: 0.101, 2: 0.099, 3: 0.1}) == []
+
+
+def test_driver_logs_structured_straggler_event():
+    """An injected-slow worker exceeding the skew threshold for M windows
+    produces a structured event on the elastic driver (acceptance
+    criterion) — driven through the same ingest path the heartbeat scrape
+    feeds, without spawning processes."""
+    from horovod_tpu.runner.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    driver = ElasticDriver(FixedHostDiscovery({"localhost": 3}),
+                           min_np=3, max_np=3, command=["true"])
+    try:
+        driver._straggler = StragglerDetector(k=3.0, windows=2)
+        for _ in range(2):
+            driver._ingest_step_times({0: 0.1, 1: 0.1, 2: 0.9})
+        assert len(driver.straggler_events) == 1
+        ev = driver.straggler_events[0]
+        assert ev["event"] == "straggler" and ev["rank"] == 2
+        assert ev["generation"] == driver.generation
+        # published to the rendezvous KV for schedulers
+        key = f"straggler/g{ev['generation']}/2"
+        assert driver._kv.get_json(key)["rank"] == 2
+    finally:
+        driver._kv.stop()
+
+
+def test_driver_scrapes_worker_endpoint():
+    """End-to-end heartbeat path: a worker-side exporter publishes its
+    endpoint to the KV; the driver scrape turns step-histogram deltas into
+    per-rank step times."""
+    from horovod_tpu.runner.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    driver = ElasticDriver(FixedHostDiscovery({"localhost": 2}),
+                           min_np=2, max_np=2, command=["true"])
+    regs = [MetricsRegistry() for _ in range(2)]
+    exporters = [MetricsExporter(regs[r], port=0).start() for r in range(2)]
+    try:
+        driver._expected_slots = [("localhost", 0), ("localhost", 1)]
+        for r in range(2):
+            driver._kv.put_json(f"metrics_addr/localhost/{r}",
+                                {"addr": "127.0.0.1",
+                                 "port": exporters[r].port, "rank": r})
+        ingested = []
+        driver._ingest_step_times = lambda t: ingested.append(t)
+        for r in range(2):
+            record_step("jax", 0.1, registry=regs[r])
+        driver._scrape_worker_metrics()  # baseline window (no deltas yet)
+        record_step("jax", 0.2, registry=regs[0])
+        record_step("jax", 0.6, registry=regs[1])
+        driver._scrape_worker_metrics()
+        assert ingested, "second scrape should produce a window"
+        window = ingested[-1]
+        assert window[0] == pytest.approx(0.2)
+        assert window[1] == pytest.approx(0.6)
+    finally:
+        for e in exporters:
+            e.stop()
+        driver._kv.stop()
+
+
+def test_step_stats_extraction():
+    reg = MetricsRegistry()
+    record_step("jax", 0.25, registry=reg)
+    record_step("torch", 0.75, registry=reg)
+    assert step_stats(reg.snapshot()) == (2, pytest.approx(1.0))
+    assert step_stats(MetricsRegistry().snapshot()) is None
+
+
+def test_timed_step_wrapper_forwards_attributes():
+    from horovod_tpu.metrics import get_registry, timed_step
+
+    calls = []
+
+    def fn(x):
+        calls.append(x)
+        return x * 2
+
+    fn.lower = lambda: "lowered"
+    before = get_registry().histogram(
+        "hvd_frontend_step_seconds", framework="jax").snapshot().count
+    wrapped = timed_step(fn, framework="jax")
+    assert wrapped(3) == 6
+    assert wrapped.lower() == "lowered"  # AOT surface survives wrapping
+    after = get_registry().histogram(
+        "hvd_frontend_step_seconds", framework="jax").snapshot().count
+    assert after == before + 1

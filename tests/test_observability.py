@@ -1,0 +1,196 @@
+"""Observability satellites: machine-readable stall reports on every rank
+(fault-injection: one rank withholds a tensor), the ABI-5 guard, the
+unified HOROVOD_LOG_LEVEL knob for the Python layers, and the
+MetricAverageCallback cross-rank mean (2-rank subprocess run)."""
+
+import importlib.util
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import uuid
+
+import pytest
+
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.engine import OP_ALLREDUCE, EngineSession, bindings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# stall report fault injection
+
+
+def test_stall_report_names_missing_rank_on_all_ranks():
+    """Rank 3 withholds a tensor the other ranks submitted: every rank —
+    not just the coordinator — observes a machine-readable report naming
+    rank 3 as missing (reference test_stall.py only ever sees rank-0 log
+    text; the report here is broadcast)."""
+    n = 4
+    group = f"stall-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=n, transport="loopback",
+                              group=group, cycle_time_ms=1.0,
+                              stall_warning_sec=0.3)
+                for r in range(n)]
+    try:
+        handles = [s.enqueue("withheld", OP_ALLREDUCE, "float32", [4])
+                   for s in sessions[:3]]
+        deadline = time.monotonic() + 10.0
+        reports = {}
+        while time.monotonic() < deadline and len(reports) < n:
+            for r, s in enumerate(sessions):
+                if r not in reports:
+                    rep = s.stall_report()
+                    if rep:
+                        reports[r] = rep
+            time.sleep(0.05)
+        assert len(reports) == n, f"ranks with a report: {sorted(reports)}"
+        for r, rep in reports.items():
+            stalled = {e["tensor"]: e for e in rep["stalled"]}
+            assert "withheld" in stalled, (r, rep)
+            assert stalled["withheld"]["missing"] == [3], (r, rep)
+            assert stalled["withheld"]["ready"] == [0, 1, 2], (r, rep)
+        # engine counters observed the stall (coordinator-side scan)
+        c = sessions[0].metrics()["counters"]
+        assert c["stall_warnings"] >= 1
+        assert c["stalled_tensors"] >= 1
+        # unblock: the withholding rank finally submits; everyone completes
+        handles.append(sessions[3].enqueue("withheld", OP_ALLREDUCE,
+                                           "float32", [4]))
+        for s, h in zip(sessions[:3] + sessions[3:], handles):
+            s.wait(h, timeout=10.0)
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+
+def test_stall_report_empty_before_any_warning():
+    group = f"nostall-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=2, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(2)]
+    try:
+        assert sessions[0].stall_report() is None
+        assert sessions[1].stall_report() is None
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+
+# ---------------------------------------------------------------------------
+# ABI guard
+
+
+def test_abi_version_is_5():
+    lib = bindings.load_library()
+    assert bindings.ABI_VERSION == 5
+    assert lib.hvdtpu_abi_version() == 5
+
+
+def test_stale_library_refused(monkeypatch):
+    """bindings must refuse a .so whose ABI doesn't match — simulated by
+    bumping the expected version and forcing a fresh load."""
+    monkeypatch.setattr(bindings, "ABI_VERSION", 999)
+    monkeypatch.setattr(bindings, "_lib", None)
+    with pytest.raises(HorovodInternalError, match="ABI"):
+        bindings.load_library()
+    # monkeypatch teardown restores the real _lib and version
+
+
+# ---------------------------------------------------------------------------
+# unified logging knob
+
+
+def test_python_logging_honors_horovod_log_level(monkeypatch):
+    import logging
+
+    from horovod_tpu.common import hvd_logging
+
+    monkeypatch.setenv("HOROVOD_LOG_LEVEL", "debug")
+    logger = hvd_logging.setup_python_logging(force=True)
+    assert logger.level == logging.DEBUG
+    monkeypatch.setenv("HOROVOD_LOG_LEVEL", "error")
+    assert hvd_logging.setup_python_logging(force=True).level == \
+        logging.ERROR
+    monkeypatch.delenv("HOROVOD_LOG_LEVEL")
+    assert hvd_logging.setup_python_logging(force=True).level == \
+        logging.WARNING
+    # timestamp knob switches the formatter
+    monkeypatch.setenv("HOROVOD_LOG_TIMESTAMP", "1")
+    logger = hvd_logging.setup_python_logging(force=True)
+    assert "%(asctime)s" in logger.handlers[0].formatter._fmt
+    monkeypatch.setenv("HOROVOD_LOG_TIMESTAMP", "0")
+    hvd_logging.setup_python_logging(force=True)
+
+
+# ---------------------------------------------------------------------------
+# MetricAverageCallback: true cross-rank mean on 2 ranks
+
+
+_AVG_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    assert hvd.size() == 2
+
+    from horovod_tpu.keras.callbacks import (MetricAverageCallback,
+                                             _averageable_keys)
+
+    # filtering contract: numeric scalars in, lr/strings/bools out
+    logs = {{"loss": 1.0 + rank, "acc": np.float32(rank),
+             "lr": 0.1 * (rank + 1), "wd_lr": 0.5, "note": "text",
+             "flag": True, "vec": np.ones(3)}}
+    assert _averageable_keys(logs) == ["acc", "loss"], \\
+        _averageable_keys(logs)
+
+    cb = MetricAverageCallback()
+    cb.on_epoch_end(0, logs)
+    # true cross-rank means: loss = (1.0 + 2.0)/2, acc = (0 + 1)/2
+    assert abs(logs["loss"] - 1.5) < 1e-6, logs
+    assert abs(logs["acc"] - 0.5) < 1e-6, logs
+    # untouched: lr-style, strings, bools, non-scalars
+    assert logs["lr"] == 0.1 * (rank + 1), logs
+    assert logs["wd_lr"] == 0.5 and logs["note"] == "text"
+    assert logs["flag"] is True and logs["vec"].shape == (3,)
+
+    hvd.shutdown()
+    print(f"metric-avg worker {{rank}} OK")
+""")
+
+
+@pytest.mark.skipif(importlib.util.find_spec("keras") is None,
+                    reason="keras not installed")
+def test_metric_average_callback_two_ranks(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "avg_worker.py"
+    script.write_text(_AVG_WORKER.format(repo=REPO))
+    procs = []
+    for r in range(2):
+        env = dict(os.environ,
+                   HOROVOD_RANK=str(r), HOROVOD_SIZE="2",
+                   HOROVOD_LOCAL_RANK=str(r), HOROVOD_LOCAL_SIZE="2",
+                   HOROVOD_CONTROLLER_ADDR="127.0.0.1",
+                   HOROVOD_CONTROLLER_PORT=str(port),
+                   JAX_PLATFORMS="cpu", KERAS_BACKEND="jax")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen([sys.executable, str(script)],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=180)[0].decode() for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"metric-avg worker {r} OK" in out
