@@ -35,6 +35,17 @@ class HorovodShapeMismatchError(HorovodInternalError):
     """
 
 
+class HorovodCorruptedError(HorovodInternalError):
+    """A framing checksum (CRC32C) rejected a wire frame mid-collective.
+
+    The engine verifies every control and ring frame; a mismatch surfaces
+    as ``Status::Corrupted`` with the affected tensor names instead of
+    silently handing garbage to the reduction. A subclass of
+    HorovodInternalError so elastic retry loops recover from it the same
+    way as from a connection loss.
+    """
+
+
 class WaitTimeout(RuntimeError):
     """A bounded ``wait``/``synchronize`` elapsed before the op completed.
 

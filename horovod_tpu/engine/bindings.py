@@ -50,8 +50,9 @@ _lib_lock = threading.Lock()
 
 # Must match hvdtpu_abi_version() in src/c_api.cc; bumped together with any
 # semantic ABI change so a stale prebuilt .so is rejected at load time.
-# 5: hvdtpu_metrics_snapshot + hvdtpu_last_stall_report.
-ABI_VERSION = 5
+# 6: hvdtpu_abort + hvdtpu_set_fault_spec; hvdtpu_wait can return
+#    StatusType::CORRUPTED (6) -> HorovodCorruptedError.
+ABI_VERSION = 6
 
 
 def _lib_path() -> Path:
@@ -59,6 +60,11 @@ def _lib_path() -> Path:
 
 
 def build_library(force: bool = False) -> Path:
+    # Explicit library override (e.g. the TSan build in build-tsan/): trust
+    # the caller, skip make — the ABI check below still rejects stale ones.
+    override = os.environ.get("HOROVOD_ENGINE_LIB")
+    if override:
+        return Path(override)
     # Run make when a toolchain is present: its dependency tracking makes a
     # fresh build a no-op, and it protects against a stale prebuilt .so
     # missing newly added symbols (the .so is gitignored and survives
@@ -176,8 +182,24 @@ def load_library():
         lib.hvdtpu_last_stall_report.restype = ctypes.c_int64
         lib.hvdtpu_last_stall_report.argtypes = [
             ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        lib.hvdtpu_abort.restype = ctypes.c_int32
+        lib.hvdtpu_abort.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+        lib.hvdtpu_set_fault_spec.restype = ctypes.c_int32
+        lib.hvdtpu_set_fault_spec.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_uint64]
         _lib = lib
         return _lib
+
+
+def set_fault_spec(spec: str, seed: int = 0):
+    """(Re)install a fault-injection spec for this process (the
+    HOROVOD_FAULT_SPEC grammar — see engine/src/fault_injector.h). An empty
+    spec disables injection; a malformed one raises so tests can't silently
+    run without their faults."""
+    lib = load_library()
+    rc = lib.hvdtpu_set_fault_spec((spec or "").encode(), seed)
+    if rc != 0:
+        raise ValueError(lib.hvdtpu_last_error().decode())
 
 
 def bench_combine(dtype_name: str, num_elements: int, iters: int,
@@ -273,6 +295,15 @@ class EngineSession:
         if not self._destroyed:
             self._lib.hvdtpu_shutdown(self._session)
             self.destroy()
+
+    def abort(self, reason: str = ""):
+        """Fast abort: fail every pending and future collective on EVERY
+        rank within one coordination cycle (the abort flag + reason ride the
+        next cycle's coordination exchange). Pending ``wait`` calls raise
+        HorovodInternalError carrying ``reason``; the session is unusable
+        afterwards — elastic recovery tears it down and re-inits."""
+        if not self._destroyed:
+            self._lib.hvdtpu_abort(self._session, reason.encode())
 
     def destroy(self):
         if not self._destroyed:
@@ -407,6 +438,10 @@ class EngineSession:
         if rc == 5:  # StatusType::IN_PROGRESS
             from horovod_tpu.common.exceptions import WaitTimeout
             raise WaitTimeout(buf.value.decode() or "wait timed out")
+        if rc == 6:  # StatusType::CORRUPTED — CRC-detected wire corruption
+            from horovod_tpu.common.exceptions import HorovodCorruptedError
+            raise HorovodCorruptedError(buf.value.decode() or
+                                        "corrupted frame")
         if rc != 0:
             raise HorovodInternalError(buf.value.decode() or
                                        "collective failed")

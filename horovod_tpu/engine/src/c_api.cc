@@ -15,6 +15,7 @@
 #include <string>
 
 #include "engine.h"
+#include "fault_injector.h"
 
 using namespace hvdtpu;
 
@@ -41,7 +42,9 @@ extern "C" {
 // changed return-code contracts). bindings.py refuses a prebuilt .so
 // whose version doesn't match, so a stale library fails loudly instead
 // of silently changing behavior.
-int32_t hvdtpu_abi_version() { return 5; }
+// 6: hvdtpu_abort + hvdtpu_set_fault_spec; hvdtpu_wait can return
+//    StatusType::CORRUPTED (6) for CRC-detected wire corruption.
+int32_t hvdtpu_abi_version() { return 6; }
 
 namespace {
 
@@ -182,6 +185,30 @@ int32_t hvdtpu_shutdown(int64_t session) {
   Engine* e = GetSession(session);
   if (!e) return -1;
   e->RequestShutdown();
+  return 0;
+}
+
+// Fast abort: fail every pending and future collective on EVERY rank
+// within one coordination cycle (the abort flag + reason ride the next
+// cycle's coordination exchange — same mechanism as the stall report).
+// The session is unusable afterwards; elastic recovery re-inits.
+int32_t hvdtpu_abort(int64_t session, const char* reason) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  e->Abort(reason ? reason : "");
+  return 0;
+}
+
+// (Re)install a fault-injection spec (HOROVOD_FAULT_SPEC grammar — see
+// fault_injector.h) for this process. Empty/NULL disables. Returns 0, or
+// nonzero on a malformed spec (message via hvdtpu_last_error). Exposed so
+// in-process loopback tests can switch specs without re-exec.
+int32_t hvdtpu_set_fault_spec(const char* spec, uint64_t seed) {
+  auto st = FaultInjector::Global().Configure(spec ? spec : "", seed);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return static_cast<int32_t>(st.type);
+  }
   return 0;
 }
 

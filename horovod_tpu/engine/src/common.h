@@ -10,8 +10,11 @@
 #define HVD_TPU_COMMON_H
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <functional>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -24,6 +27,10 @@ enum class StatusType : int32_t {
   ABORTED = 3,
   INVALID_ARGUMENT = 4,
   IN_PROGRESS = 5,
+  // A framing checksum (CRC32C) mismatch: the bytes arrived but are not the
+  // bytes the peer sent. Distinct from ABORTED/UNKNOWN so callers can tell
+  // "wire corruption detected" from "connection torn down".
+  CORRUPTED = 6,
 };
 
 struct Status {
@@ -44,9 +51,41 @@ struct Status {
     return Status{StatusType::INVALID_ARGUMENT, std::move(msg)};
   }
   static Status InProgress() { return Status{StatusType::IN_PROGRESS, ""}; }
+  static Status Corrupted(std::string msg) {
+    return Status{StatusType::CORRUPTED, std::move(msg)};
+  }
   bool ok() const { return type == StatusType::OK; }
   bool in_progress() const { return type == StatusType::IN_PROGRESS; }
 };
+
+// CRC32C (Castagnoli) over a byte range — the framing checksum on control
+// and ring frames. Software table implementation; frames are small relative
+// to the payloads they guard, and the data plane's large tensors ride the
+// same framed transfers, where memcpy/combine dominates anyway.
+uint32_t Crc32c(const void* data, size_t len);
+
+// Timed condition-variable wait — every timed wait in the engine goes
+// through here. Production builds use the plain steady-clock wait_for
+// (immune to wall-clock adjustments). The TSan build substitutes a
+// system_clock wait_until: libstdc++ then waits with the TSan-intercepted
+// pthread_cond_timedwait instead of pthread_cond_clockwait, which gcc 10's
+// libtsan does not model — a plain wait_for produces bogus "double lock of
+// a mutex" reports there (verified), so `make tsan` would drown real races.
+template <typename Pred>
+bool CvWaitFor(std::condition_variable& cv,
+               std::unique_lock<std::mutex>& lock, double seconds,
+               Pred pred) {
+#if defined(__SANITIZE_THREAD__)
+  return cv.wait_until(
+      lock,
+      std::chrono::system_clock::now() +
+          std::chrono::duration_cast<std::chrono::system_clock::duration>(
+              std::chrono::duration<double>(seconds)),
+      pred);
+#else
+  return cv.wait_for(lock, std::chrono::duration<double>(seconds), pred);
+#endif
+}
 
 // Wire dtype ids (reference: common/message.h DataType). The engine only
 // needs element sizes for fusion planning.

@@ -16,6 +16,12 @@ constexpr uint64_t kFlagJoin = 1ull << 2;
 // extra Bcast this cycle so the machine-readable report reaches all ranks
 // (Session.stall_report() works anywhere, not just rank 0).
 constexpr uint64_t kFlagStallReport = 1ull << 3;
+// Some rank is aborting the session (failed collective / hvdtpu_abort):
+// one extra Gather+Bcast carries the reason to every rank, then RunCycle
+// returns ABORTED everywhere — all peers raise HorovodInternalError within
+// this coordination cycle (fast abort), not after the 30s transport
+// timeout.
+constexpr uint64_t kFlagAbort = 1ull << 4;
 
 Response::Type OpToResponseType(OpType t) {
   switch (t) {
@@ -286,6 +292,15 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
       (metrics_->*member).fetch_add(1, std::memory_order_relaxed);
     }
   };
+  // Any control-plane transport failure tears the session down everywhere.
+  // Announce it to directly connected peers first (abort frames / hub
+  // abort) so their blocking receives fail within milliseconds instead of
+  // waiting out HOROVOD_CONTROLLER_TIMEOUT_SECONDS; the reference has no
+  // such path — a dead peer stalls every survivor to the timeout.
+  auto fail_fast = [this](const Status& s) {
+    transport_->AbortPeers(s.reason);
+    return s;
+  };
   std::vector<uint32_t> my_invalid;
   for (const auto& msg : in.messages) {
     switch (cache_.Cached(msg)) {
@@ -316,6 +331,7 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
   if (!uncached_pending_.empty()) flags |= kFlagUncached;
   if (in.shutdown_requested) flags |= kFlagShutdown;
   if (in.join_requested) flags |= kFlagJoin;
+  if (in.abort_requested) flags |= kFlagAbort;
   // Stall scan every cycle on the coordinator (reference: controller.cc
   // invokes the inspector from ComputeResponseList each cycle); a shutdown
   // verdict rides the OR'd flags so every rank stops together, and a fresh
@@ -347,7 +363,7 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
     bits[1 + slot_words + pos / 64] &= ~(1ull << (pos % 64));
   }
   auto st = transport_->BitAllreduce(&bits, /*is_and=*/true);
-  if (!st.ok()) return st;
+  if (!st.ok()) return fail_fast(st);
   uint64_t or_flags = ~bits[0];
   bool any_uncached = or_flags & kFlagUncached;
   bool any_shutdown = or_flags & kFlagShutdown;
@@ -357,8 +373,33 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
   // join this Bcast in the same cycle (same mechanism as shutdown).
   if (or_flags & kFlagStallReport) {
     st = transport_->Bcast(&stall_report_payload);
-    if (!st.ok()) return st;
+    if (!st.ok()) return fail_fast(st);
     if (rank() != 0) stall_.SetLastReport(stall_report_payload);
+  }
+
+  // Fast abort: some rank failed a collective (or called hvdtpu_abort).
+  // One Gather+Bcast round carries the first reporter's reason to every
+  // rank, then the cycle fails with ABORTED on all of them together.
+  if (or_flags & kFlagAbort) {
+    std::string mine = in.abort_requested ? in.abort_reason : std::string();
+    if (in.abort_requested && mine.empty()) mine = "abort requested";
+    std::vector<std::string> all;
+    std::string reason;
+    auto ast = transport_->Gather(mine, rank() == 0 ? &all : nullptr);
+    if (ast.ok() && rank() == 0) {
+      for (int r = 0; r < size(); ++r) {
+        if (!all[r].empty()) {
+          reason = "rank " + std::to_string(r) + ": " + all[r];
+          break;
+        }
+      }
+    }
+    if (ast.ok()) ast = transport_->Bcast(&reason);
+    if (!ast.ok()) transport_->AbortPeers("abort fan-out failed");
+    if (reason.empty()) {
+      reason = in.abort_requested ? mine : "abort requested by a peer";
+    }
+    return Status::Aborted("fast abort: " + reason);
   }
 
   // Apply coordinated invalidations: evict and re-announce anything we had
@@ -433,7 +474,7 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
     if (rank() == 0) {
       std::vector<std::string> all;
       st = transport_->Gather(payload, &all);
-      if (!st.ok()) return st;
+      if (!st.ok()) return fail_fast(st);
       for (int r = 0; r < size(); ++r) {
         RequestList list = RequestList::Deserialize(all[r]);
         if (list.join && joined_ranks_.insert(r).second) {
@@ -491,12 +532,12 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
       rlist.responses = std::move(slow);
       rlist.SerializeTo(&response_payload);
       st = transport_->Bcast(&response_payload);
-      if (!st.ok()) return st;
+      if (!st.ok()) return fail_fast(st);
     } else {
       st = transport_->Gather(payload, nullptr);
-      if (!st.ok()) return st;
+      if (!st.ok()) return fail_fast(st);
       st = transport_->Bcast(&response_payload);
-      if (!st.ok()) return st;
+      if (!st.ok()) return fail_fast(st);
     }
     ResponseList rlist = ResponseList::Deserialize(response_payload);
     any_shutdown = any_shutdown || rlist.shutdown;

@@ -52,6 +52,13 @@ class Controller {
     std::vector<Request> messages;
     bool shutdown_requested = false;
     bool join_requested = false;  // this rank sits in hvd.join()
+    // Fast abort: this rank wants the whole session torn down (a collective
+    // failed locally, or hvdtpu_abort was called). The flag rides the same
+    // OR'd word-0 mechanism as shutdown/stall, so every rank learns of the
+    // failure in THIS cycle and fails its pending handles immediately
+    // instead of hanging to the transport timeout.
+    bool abort_requested = false;
+    std::string abort_reason;
   };
 
   struct CycleOutput {

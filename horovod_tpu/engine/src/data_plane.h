@@ -58,6 +58,14 @@ class DataPlane {
   // counters (populated from the public entry points below).
   void set_metrics(MetricsStore* m) { metrics_ = m; }
 
+  // Fast-abort fan-out on the data channel: best-effort abort frames to
+  // every connected peer so a rank blocked in a data-plane receive fails
+  // now instead of at the recv timeout (see
+  // ControllerTransport::AbortPeers).
+  void AbortPeers(const std::string& reason) {
+    transport_->AbortPeers(reason);
+  }
+
   // In-place allreduce over num_elements of dtype.
   Status Allreduce(void* buffer, int64_t num_elements, DataType dtype,
                    ReduceKind kind, double prescale, double postscale);

@@ -3,6 +3,8 @@
 #include <chrono>
 #include <sstream>
 
+#include "fault_injector.h"
+
 namespace hvdtpu {
 
 // ---------------------------------------------------------------------------
@@ -15,12 +17,14 @@ int64_t HandleManager::Allocate() {
   return h;
 }
 
-void HandleManager::MarkDone(int64_t handle, const std::string& error) {
+void HandleManager::MarkDone(int64_t handle, const std::string& error,
+                             StatusType code) {
   std::lock_guard<std::mutex> lock(mu_);
   auto it = results_.find(handle);
-  if (it == results_.end()) return;
+  if (it == results_.end() || it->second.done) return;
   it->second.done = true;
   it->second.error = error;
+  it->second.code = error.empty() ? StatusType::OK : code;
   cv_.notify_all();
 }
 
@@ -43,8 +47,7 @@ Status HandleManager::Wait(int64_t handle, double timeout_sec) {
   }
   auto pred = [&] { return results_[handle].done; };
   if (timeout_sec > 0) {
-    if (!cv_.wait_for(lock, std::chrono::duration<double>(timeout_sec),
-                      pred)) {
+    if (!CvWaitFor(cv_, lock, timeout_sec, pred)) {
       // IN_PROGRESS, not an error: the op is still pending and the handle
       // stays live — callers may wait again. Distinguishable at the C ABI
       // from a real collective failure (UNKNOWN_ERROR).
@@ -55,8 +58,12 @@ Status HandleManager::Wait(int64_t handle, double timeout_sec) {
     cv_.wait(lock, pred);
   }
   std::string err = results_[handle].error;
+  StatusType code = results_[handle].code;
   results_.erase(handle);
-  if (!err.empty()) return Status::Unknown(err);
+  if (!err.empty()) {
+    return Status{code == StatusType::OK ? StatusType::UNKNOWN_ERROR : code,
+                  err};
+  }
   return Status::OK();
 }
 
@@ -84,15 +91,24 @@ Engine::~Engine() { Finalize(); }
 Status Engine::Init() {
   // Two channels: control (cycle negotiation) and data (eager host
   // collectives), so data frames never interleave with cycle frames.
+  // (Re)load the fault-injection spec before any transport traffic: env
+  // changes between sessions in one process (tests) must take effect, and
+  // a malformed spec must refuse to start rather than silently not inject.
+  auto fst = FaultInjector::Global().ConfigureFromEnv();
+  if (!fst.ok()) return fst;
   std::shared_ptr<ControllerTransport> data_transport;
   if (tcfg_.kind == "loopback") {
     auto hub = GetOrCreateLoopbackHub(tcfg_.group, size_);
     transport_ = std::make_shared<LoopbackTransport>(hub, rank_);
     auto data_hub = GetOrCreateLoopbackHub(tcfg_.group + "/data", size_);
     data_transport = std::make_shared<LoopbackTransport>(data_hub, rank_);
+    transport_->set_metrics(&metrics_);
+    data_transport->set_metrics(&metrics_);
+    data_transport->set_channel("data");
   } else if (tcfg_.kind == "tcp") {
     auto tcp = std::make_shared<TcpTransport>(rank_, size_, tcfg_.addr,
                                               tcfg_.port, tcfg_.timeout_sec);
+    tcp->set_metrics(&metrics_);
     auto st = tcp->Init();
     if (!st.ok()) return st;
     transport_ = tcp;
@@ -101,6 +117,8 @@ Status Engine::Init() {
     int dport = tcfg_.data_port > 0 ? tcfg_.data_port : tcfg_.port + 1;
     auto data_tcp = std::make_shared<TcpTransport>(
         rank_, size_, tcfg_.addr, dport, tcfg_.timeout_sec);
+    data_tcp->set_metrics(&metrics_);
+    data_tcp->set_channel("data");
     st = data_tcp->Init();
     if (!st.ok()) return st;
     data_transport = data_tcp;
@@ -197,6 +215,28 @@ void Engine::RequestShutdown() {
   cycle_cv_.notify_one();
 }
 
+void Engine::Abort(const std::string& reason) {
+  std::string current;
+  {
+    std::lock_guard<std::mutex> lock(abort_mu_);
+    if (abort_reason_.empty()) {
+      abort_reason_ = reason.empty() ? "abort requested" : reason;
+    }
+    current = abort_reason_;
+  }
+  // count the teardown once, however many failures pile onto it
+  if (!abort_requested_.exchange(true)) {
+    metrics_.aborts_total.fetch_add(1, std::memory_order_relaxed);
+  }
+  // Unblock peers stuck inside a data-plane collective right now — the
+  // coordinated abort flag only reaches ranks that make it back to the
+  // cycle loop. Best effort; the control-plane flag is the guaranteed path.
+  if (data_plane_ != nullptr) data_plane_->AbortPeers(current);
+  std::lock_guard<std::mutex> lock(cycle_mu_);
+  work_available_ = true;
+  cycle_cv_.notify_one();
+}
+
 void Engine::Finalize() {
   RequestShutdown();
   if (background_.joinable()) background_.join();
@@ -283,6 +323,7 @@ void Engine::PerformOperation(const Response& response) {
     }
   }
   std::string err = response.error_message;
+  StatusType err_code = StatusType::UNKNOWN_ERROR;
   int32_t rc = 0;
   if (response.type == Response::Type::ERROR) {
     // close the NEGOTIATE spans of locally-enqueued tensors — an error
@@ -307,7 +348,25 @@ void Engine::PerformOperation(const Response& response) {
           std::chrono::duration_cast<std::chrono::microseconds>(
               std::chrono::steady_clock::now() - t0).count());
       if (rc != 0) {
-        err = "data plane execution failed (rc=" + std::to_string(rc) + ")";
+        std::string names;
+        for (const auto& n : response.tensor_names) {
+          if (!names.empty()) names += ", ";
+          names += n;
+        }
+        if (rc == static_cast<int32_t>(StatusType::CORRUPTED)) {
+          err_code = StatusType::CORRUPTED;
+          err = "corrupted frame (CRC32C mismatch) detected by the data "
+                "plane on tensor(s) [" + names + "]";
+        } else {
+          err = "data plane execution failed (rc=" + std::to_string(rc) +
+                ") on tensor(s) [" + names + "]";
+        }
+        // rc==2 (PRECONDITION) marks a local input-validation failure:
+        // only this op fails and the session stays usable. Everything
+        // else means peers may be mid-collective waiting on this rank —
+        // fast-abort the session so they fail within one cycle instead
+        // of hanging to the transport timeout.
+        if (rc != 2) Abort(err);
       }
     }
     for (const auto& name : response.tensor_names) {
@@ -318,7 +377,7 @@ void Engine::PerformOperation(const Response& response) {
     TensorTableEntry entry;
     auto st = queue_.GetTensorEntry(name, &entry);
     if (!st.ok()) continue;  // joined rank: no local entry
-    handles_.MarkDone(entry.handle, err);
+    handles_.MarkDone(entry.handle, err, err_code);
   }
 }
 
@@ -339,10 +398,8 @@ void Engine::BackgroundLoopImpl() {
   while (true) {
     {
       std::unique_lock<std::mutex> lock(cycle_mu_);
-      cycle_cv_.wait_for(
-          lock,
-          std::chrono::duration<double>(opts_.cycle_time_ms / 1000.0),
-          [&] { return work_available_; });
+      CvWaitFor(cycle_cv_, lock, opts_.cycle_time_ms / 1000.0,
+                [&] { return work_available_; });
       work_available_ = false;
     }
     timeline_.MarkCycleStart();
@@ -359,11 +416,23 @@ void Engine::BackgroundLoopImpl() {
     }
     in.shutdown_requested = shutdown_requested_.load();
     in.join_requested = join_pending_.load();
+    in.abort_requested = abort_requested_.load();
+    if (in.abort_requested) {
+      std::lock_guard<std::mutex> lock(abort_mu_);
+      in.abort_reason = abort_reason_;
+    }
 
     Controller::CycleOutput out;
     auto st = controller_->RunCycle(in, &out);
     if (!st.ok()) {
       healthy_.store(false);
+      if (st.type == StatusType::ABORTED &&
+          !abort_requested_.exchange(true)) {
+        // teardown initiated elsewhere (peer abort / peer death) — count
+        // it on this rank too; the exchange keeps one teardown = one
+        // count even when a local Abort() raced this cycle
+        metrics_.aborts_total.fetch_add(1, std::memory_order_relaxed);
+      }
       handles_.FailAll("coordination failure: " + st.reason +
                        " (HorovodInternalError)");
       break;

@@ -39,7 +39,11 @@ namespace hvdtpu {
 class HandleManager {
  public:
   int64_t Allocate();
-  void MarkDone(int64_t handle, const std::string& error);
+  // `code` preserves the failure class across the handle boundary (e.g.
+  // CORRUPTED survives to the C ABI so callers can tell wire corruption
+  // from a generic collective failure).
+  void MarkDone(int64_t handle, const std::string& error,
+                StatusType code = StatusType::UNKNOWN_ERROR);
   // done=false if still in flight. Unknown handles error.
   Status Poll(int64_t handle, bool* done, std::string* error);
   // Blocks; timeout_sec<=0 waits forever. Returns op status.
@@ -50,6 +54,7 @@ class HandleManager {
   struct Result {
     bool done = false;
     std::string error;
+    StatusType code = StatusType::UNKNOWN_ERROR;
   };
   std::mutex mu_;
   std::condition_variable cv_;
@@ -95,6 +100,12 @@ class Engine {
   Status WaitHandle(int64_t handle, double timeout_sec);
 
   void RequestShutdown();
+  // Fast abort: fail every pending and future collective on every rank
+  // within one coordination cycle (the abort flag rides the next cycle's
+  // bit-allreduce; peers blocked in data-plane receives are unblocked by
+  // best-effort abort frames). The session is unusable afterwards —
+  // elastic recovery tears it down and re-inits.
+  void Abort(const std::string& reason);
   void Finalize();  // join background thread (idempotent)
   bool healthy() const { return healthy_.load(); }
 
@@ -135,6 +146,9 @@ class Engine {
   MetricsStore metrics_;
 
   std::thread background_;
+  std::atomic<bool> abort_requested_{false};
+  std::mutex abort_mu_;
+  std::string abort_reason_;
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> stopped_{false};
   std::atomic<bool> healthy_{true};

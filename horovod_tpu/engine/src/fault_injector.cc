@@ -1,0 +1,219 @@
+#include "fault_injector.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+
+namespace hvdtpu {
+
+namespace {
+
+bool ParseInt64(const std::string& s, int64_t* out) {
+  if (s.empty()) return false;
+  char* end = nullptr;
+  long long v = std::strtoll(s.c_str(), &end, 10);
+  if (end == nullptr || *end != '\0') return false;
+  *out = static_cast<int64_t>(v);
+  return true;
+}
+
+bool ParseDouble(const std::string& s, double* out) {
+  if (s.empty()) return false;
+  char* end = nullptr;
+  double v = std::strtod(s.c_str(), &end);
+  if (end == nullptr || *end != '\0') return false;
+  *out = v;
+  return true;
+}
+
+bool ValidPoint(const std::string& p) {
+  return p == "send" || p == "recv" || p == "ring_send" ||
+         p == "ring_recv" || p == "connect" || p == "frame";
+}
+
+std::vector<std::string> Split(const std::string& s, char sep) {
+  std::vector<std::string> out;
+  size_t pos = 0;
+  while (pos <= s.size()) {
+    size_t next = s.find(sep, pos);
+    if (next == std::string::npos) next = s.size();
+    out.push_back(s.substr(pos, next - pos));
+    pos = next + 1;
+  }
+  return out;
+}
+
+}  // namespace
+
+FaultInjector& FaultInjector::Global() {
+  static FaultInjector* g = new FaultInjector();
+  return *g;
+}
+
+Status FaultInjector::Configure(const std::string& spec, uint64_t seed) {
+  std::vector<std::unique_ptr<Rule>> rules;
+  for (const auto& raw : Split(spec, ';')) {
+    std::string text = raw;
+    // tolerate stray whitespace around rules
+    while (!text.empty() && (text.front() == ' ' || text.front() == '\n')) {
+      text.erase(text.begin());
+    }
+    while (!text.empty() && (text.back() == ' ' || text.back() == '\n')) {
+      text.pop_back();
+    }
+    if (text.empty()) continue;
+    auto bad = [&](const std::string& why) {
+      return Status::InvalidArgument("bad HOROVOD_FAULT_SPEC rule '" + text +
+                                     "': " + why);
+    };
+    const size_t colon = text.find(':');
+    if (colon == std::string::npos) return bad("missing ':'");
+    auto rule = std::make_unique<Rule>();
+    std::string point = text.substr(0, colon);
+    const size_t dot = point.find('.');
+    if (dot != std::string::npos) {
+      rule->channel = point.substr(0, dot);
+      point = point.substr(dot + 1);
+      if (rule->channel != "control" && rule->channel != "data") {
+        return bad("channel must be 'control' or 'data'");
+      }
+    }
+    if (!ValidPoint(point)) return bad("unknown injection point '" + point +
+                                       "'");
+    rule->point = point;
+    std::string action = text.substr(colon + 1);
+    std::string conds;
+    const size_t at = action.find('@');
+    if (at != std::string::npos) {
+      conds = action.substr(at + 1);
+      action = action.substr(0, at);
+    }
+    if (action == "drop") {
+      rule->action = Rule::Action::DROP;
+    } else if (action == "corrupt") {
+      rule->action = Rule::Action::CORRUPT;
+    } else if (action == "die") {
+      rule->action = Rule::Action::DIE;
+    } else if (action == "fail") {
+      rule->action = Rule::Action::FAIL;
+    } else if (action.rfind("delay_ms=", 0) == 0) {
+      rule->action = Rule::Action::DELAY;
+      if (!ParseInt64(action.substr(9), &rule->delay_ms) ||
+          rule->delay_ms < 0) {
+        return bad("delay_ms needs a non-negative integer");
+      }
+    } else {
+      return bad("unknown action '" + action + "'");
+    }
+    for (const auto& c : Split(conds, ',')) {
+      if (c.empty()) continue;
+      if (c.rfind("frame=", 0) == 0) {
+        if (!ParseInt64(c.substr(6), &rule->frame) || rule->frame < 0) {
+          return bad("frame= needs a non-negative integer");
+        }
+      } else if (c.rfind("count=", 0) == 0) {
+        if (!ParseInt64(c.substr(6), &rule->count) || rule->count < 0) {
+          return bad("count= needs a non-negative integer");
+        }
+      } else if (c.rfind("prob=", 0) == 0) {
+        if (!ParseDouble(c.substr(5), &rule->prob) || rule->prob < 0.0 ||
+            rule->prob > 1.0) {
+          return bad("prob= needs a probability in [0, 1]");
+        }
+      } else if (c.rfind("rank=", 0) == 0) {
+        int64_t r;
+        if (!ParseInt64(c.substr(5), &r) || r < 0) {
+          return bad("rank= needs a non-negative integer");
+        }
+        rule->rank = static_cast<int>(r);
+      } else {
+        return bad("unknown condition '" + c + "'");
+      }
+    }
+    rules.push_back(std::move(rule));
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  rules_ = std::move(rules);
+  for (size_t i = 0; i < rules_.size(); ++i) {
+    rules_[i]->rng.seed(seed + 0x9E3779B97F4A7C15ull * (i + 1));
+  }
+  injected_.store(0, std::memory_order_relaxed);
+  enabled_.store(!rules_.empty(), std::memory_order_relaxed);
+  return Status::OK();
+}
+
+Status FaultInjector::ConfigureFromEnv() {
+  const char* spec = std::getenv("HOROVOD_FAULT_SPEC");
+  // Env absent: keep whatever was installed programmatically
+  // (hvdtpu_set_fault_spec) — only an explicitly set variable overrides.
+  if (spec == nullptr) return Status::OK();
+  uint64_t seed = 0;
+  if (const char* s = std::getenv("HOROVOD_FAULT_SEED")) {
+    seed = std::strtoull(s, nullptr, 10);
+  }
+  return Configure(spec, seed);
+}
+
+Status FaultInjector::OnEvent(const char* channel, const char* point,
+                              int rank, bool* corrupt_frame, bool* fired) {
+  if (corrupt_frame != nullptr) *corrupt_frame = false;
+  if (fired != nullptr) *fired = false;
+  if (!enabled()) return Status::OK();
+  int64_t delay_ms = 0;
+  Status result = Status::OK();
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& rp : rules_) {
+      Rule& r = *rp;
+      const bool point_match =
+          r.point == point || (r.point == "frame" &&
+                               (std::strcmp(point, "send") == 0 ||
+                                std::strcmp(point, "ring_send") == 0));
+      if (!point_match) continue;
+      if (!r.channel.empty() && r.channel != channel) continue;
+      if (r.rank >= 0 && r.rank != rank) continue;
+      const int64_t n = r.hits++;
+      bool fire = true;
+      if (r.frame >= 0 && n != r.frame) fire = false;
+      if (r.count >= 0 && n >= r.count) fire = false;
+      if (fire && r.prob >= 0.0) {
+        fire = std::uniform_real_distribution<double>(0.0, 1.0)(r.rng) <
+               r.prob;
+      }
+      if (!fire) continue;
+      injected_.fetch_add(1, std::memory_order_relaxed);
+      if (fired != nullptr) *fired = true;
+      const std::string where = std::string("injected fault (") + channel +
+                                "." + point + ", event " + std::to_string(n) +
+                                ", rank " + std::to_string(rank) + ")";
+      switch (r.action) {
+        case Rule::Action::DIE:
+          std::fprintf(stderr, "[hvdtpu] %s: dying\n", where.c_str());
+          std::_Exit(137);
+        case Rule::Action::DROP:
+        case Rule::Action::FAIL:
+          if (result.ok()) result = Status::Aborted(where + ": dropped");
+          break;
+        case Rule::Action::CORRUPT:
+          if (corrupt_frame != nullptr) {
+            *corrupt_frame = true;
+          } else if (result.ok()) {
+            result = Status::Corrupted(where + ": corrupted");
+          }
+          break;
+        case Rule::Action::DELAY:
+          delay_ms = std::max(delay_ms, r.delay_ms);
+          break;
+      }
+    }
+  }
+  if (delay_ms > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+  }
+  return result;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,104 @@
+// Deterministic fault injection for the transport and data plane.
+//
+// The reference has no fault-injection surface at all — its recovery code
+// (elastic resets, gloo timeouts) is exercised only by real cluster
+// failures. This injector makes every failure mode a one-line env var:
+//
+//   HOROVOD_FAULT_SPEC="ring_send:drop@frame=7;recv:delay_ms=500@prob=0.1;
+//                       frame:corrupt@frame=12"
+//
+// Grammar (';'-separated rules):
+//   rule    := [channel '.'] point ':' action ['@' cond (',' cond)*]
+//   channel := 'control' | 'data'            (default: any channel)
+//   point   := 'send' | 'recv' | 'ring_send' | 'ring_recv' | 'connect'
+//            | 'frame'                        ('frame' = any framed send)
+//   action  := 'drop'        fail the op with Status::Aborted (and tear the
+//                            link down, like a peer death)
+//            | 'corrupt'     flip the frame's CRC so the receiver detects
+//                            Status::Corrupted (loopback: return Corrupted
+//                            directly — it has no wire to corrupt)
+//            | 'die'         std::_Exit(137) — a real process death at an
+//                            exact frame boundary
+//            | 'fail'        connect points: count the attempt as failed
+//            | 'delay_ms=N'  sleep N ms, then proceed
+//   cond    := 'frame=N'     fire exactly on the Nth matching event (0-based)
+//            | 'count=N'     fire on the first N matching events
+//            | 'prob=P'      fire with probability P (seeded RNG —
+//                            HOROVOD_FAULT_SEED — so runs are reproducible)
+//            | 'rank=R'      only on engine rank R (loopback tests host all
+//                            ranks in one process)
+//
+// Conditions AND together; a rule with no condition always fires. Event
+// counters are per-rule and count only events that pass the channel /
+// point / rank filters, so frame indices are deterministic per channel.
+
+#ifndef HVD_TPU_FAULT_INJECTOR_H
+#define HVD_TPU_FAULT_INJECTOR_H
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class FaultInjector {
+ public:
+  // Process-wide instance (the spec is a process-level env contract; rank
+  // conditions scope rules when several engine ranks share a process).
+  static FaultInjector& Global();
+
+  // Parse and install a spec; "" disables injection. Resets all rule
+  // counters and reseeds the RNGs. Returns InvalidArgument on a malformed
+  // spec (the engine refuses to start rather than silently not injecting).
+  Status Configure(const std::string& spec, uint64_t seed);
+  // HOROVOD_FAULT_SPEC / HOROVOD_FAULT_SEED (called per session creation so
+  // env changes between in-process test sessions take effect).
+  Status ConfigureFromEnv();
+
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  // Evaluate one injection point. May sleep (delay rules). Returns:
+  //   OK         — proceed normally
+  //   Aborted    — drop/fail fired: the caller fails the op / the attempt
+  //   Corrupted  — corrupt fired on a transport with no wire (loopback)
+  // *corrupt_frame is set when the caller owns a real frame and should
+  // invalidate its CRC instead (TCP). *fired reports whether ANY rule
+  // fired — including delay rules, whose return is OK — so callers can
+  // count every injection in metrics. May not return at all ('die').
+  Status OnEvent(const char* channel, const char* point, int rank,
+                 bool* corrupt_frame, bool* fired = nullptr);
+
+  // Total faults fired since the last Configure (all rules).
+  int64_t injected() const {
+    return injected_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  struct Rule {
+    std::string channel;  // "" = any
+    std::string point;
+    enum class Action { DROP, CORRUPT, DIE, FAIL, DELAY } action;
+    int64_t delay_ms = 0;
+    int64_t frame = -1;
+    int64_t count = -1;
+    double prob = -1.0;
+    int rank = -1;
+    int64_t hits = 0;  // matching events so far (guarded by mu_)
+    std::mt19937_64 rng;
+  };
+
+  std::atomic<bool> enabled_{false};
+  std::atomic<int64_t> injected_{0};
+  mutable std::mutex mu_;
+  std::vector<std::unique_ptr<Rule>> rules_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_FAULT_INJECTOR_H

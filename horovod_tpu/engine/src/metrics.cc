@@ -99,6 +99,10 @@ std::string MetricsStore::SnapshotJson(int rank) const {
   AppendKV(&out, "stalled_tensors", v(stalled_tensors), &first);
   AppendKV(&out, "data_ring_ops", v(data_ring_ops), &first);
   AppendKV(&out, "data_star_ops", v(data_star_ops), &first);
+  AppendKV(&out, "aborts", v(aborts_total), &first);
+  AppendKV(&out, "connect_retries", v(connect_retries), &first);
+  AppendKV(&out, "crc_failures", v(crc_failures), &first);
+  AppendKV(&out, "faults_injected", v(faults_injected), &first);
   out += "},\"gauges\":{";
   first = true;
   AppendKV(&out, "queue_depth", v(queue_depth), &first);

@@ -82,6 +82,10 @@ struct MetricsStore {
   std::atomic<int64_t> stalled_tensors{0};      // tensors named across scans
   std::atomic<int64_t> data_ring_ops{0};        // host data plane ring path
   std::atomic<int64_t> data_star_ops{0};        // host data plane star path
+  std::atomic<int64_t> aborts_total{0};         // fast-abort teardowns
+  std::atomic<int64_t> connect_retries{0};      // failed connect attempts
+  std::atomic<int64_t> crc_failures{0};         // frames rejected by CRC32C
+  std::atomic<int64_t> faults_injected{0};      // HOROVOD_FAULT_SPEC firings
 
   // -- gauges ---------------------------------------------------------------
   std::atomic<int64_t> queue_depth{0};          // staged, not yet negotiated

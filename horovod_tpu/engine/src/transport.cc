@@ -9,11 +9,14 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cerrno>
 #include <cstring>
 #include <thread>
 #include <unordered_map>
+
+#include "fault_injector.h"
 
 namespace hvdtpu {
 
@@ -46,8 +49,29 @@ LoopbackTransport::LoopbackTransport(std::shared_ptr<LoopbackHub> hub,
                                      int rank)
     : hub_(std::move(hub)), rank_(rank) {}
 
+void LoopbackTransport::AbortPeers(const std::string& reason) {
+  (void)reason;
+  hub_->Abort();
+}
+
+Status LoopbackTransport::Inject(const char* point) {
+  auto& inj = FaultInjector::Global();
+  if (!inj.enabled()) return Status::OK();
+  bool fired = false;
+  auto st = inj.OnEvent(channel_, point, rank_, nullptr, &fired);
+  if (fired) CountMetric(&MetricsStore::faults_injected);
+  if (!st.ok()) {
+    // A vanished loopback rank must unblock its peers the way a closed
+    // socket does — abort the hub so their barrier waits fail too.
+    hub_->Abort();
+  }
+  return st;
+}
+
 Status LoopbackTransport::Gather(const std::string& mine,
                                  std::vector<std::string>* out) {
+  auto ist = Inject("send");
+  if (!ist.ok()) return ist;
   {
     std::lock_guard<std::mutex> lock(hub_->mu);
     if (hub_->aborted) return Status::Aborted("loopback hub aborted");
@@ -129,6 +153,8 @@ Status LoopbackTransport::Barrier() {
 }
 
 Status LoopbackTransport::RingSend(const std::string& payload) {
+  auto ist = Inject("ring_send");
+  if (!ist.ok()) return ist;
   std::unique_lock<std::mutex> lock(hub_->mu);
   hub_->cv.wait(lock,
                 [&] { return !hub_->ring_full[rank_] || hub_->aborted; });
@@ -140,6 +166,8 @@ Status LoopbackTransport::RingSend(const std::string& payload) {
 }
 
 Status LoopbackTransport::RingRecv(std::string* payload) {
+  auto ist = Inject("ring_recv");
+  if (!ist.ok()) return ist;
   const int prev = (rank_ - 1 + hub_->size) % hub_->size;
   std::unique_lock<std::mutex> lock(hub_->mu);
   hub_->cv.wait(lock,
@@ -170,7 +198,11 @@ std::shared_ptr<LoopbackHub> GetOrCreateLoopbackHub(const std::string& group,
                                                     int size) {
   std::lock_guard<std::mutex> lock(g_hub_mu);
   auto it = g_hubs.find(group);
-  if (it != g_hubs.end()) return it->second;
+  // An aborted hub is a torn-down session; sessions re-initializing under
+  // the same group (in-process elastic recovery) must get a fresh hub, not
+  // inherit the poison — old sessions keep their shared_ptr to the dead
+  // one, so the swap can't resurrect them.
+  if (it != g_hubs.end() && !it->second->aborted) return it->second;
   auto hub = std::make_shared<LoopbackHub>(size);
   g_hubs[group] = hub;
   return hub;
@@ -216,16 +248,28 @@ Status WriteAll(int fd, const char* data, size_t len) {
 // not a multi-GB allocation. Controller payloads are small; the ring data
 // plane chunks large tensors, so even a full fusion buffer stays far
 // below this. Overridable for tests via HOROVOD_MAX_FRAME_BYTES.
+// Bit 31 of the length word is reserved for the abort flag, so ordinary
+// frames top out just below 2 GiB.
+constexpr uint32_t kAbortFrameBit = 0x80000000u;
+
+int64_t EnvInt64(const char* name, int64_t def) {
+  const char* e = std::getenv(name);
+  if (e && *e) {
+    char* end = nullptr;
+    long long parsed = std::strtoll(e, &end, 10);
+    if (end && *end == '\0') return (int64_t)parsed;
+  }
+  return def;
+}
+
 int64_t MaxFrameBytes() {
   static int64_t v = [] {
-    const char* e = std::getenv("HOROVOD_MAX_FRAME_BYTES");
-    int64_t def = int64_t{1} << 31;  // 2 GiB
-    if (e && *e) {
-      char* end = nullptr;
-      long long parsed = std::strtoll(e, &end, 10);
-      if (end && *end == '\0' && parsed > 0) return (int64_t)parsed;
-    }
-    return def;
+    // never above the wire format's ceiling: lengths ride a uint32 whose
+    // bit 31 is the abort flag, so a larger limit would let frames alias
+    // abort frames
+    const int64_t hard_cap = (int64_t{1} << 31) - 1;
+    int64_t parsed = EnvInt64("HOROVOD_MAX_FRAME_BYTES", hard_cap);
+    return parsed > 0 ? std::min(parsed, hard_cap) : hard_cap;
   }();
   return v;
 }
@@ -249,7 +293,8 @@ Status ReadAll(int fd, char* data, size_t len) {
 TcpTransport::TcpTransport(int rank, int size, const std::string& addr,
                            int port, double timeout_sec)
     : rank_(rank), size_(size), addr_(addr), port_(port),
-      timeout_sec_(timeout_sec) {}
+      timeout_sec_(timeout_sec),
+      jitter_rng_(0x5bd1e995u + static_cast<uint32_t>(rank)) {}
 
 TcpTransport::~TcpTransport() {
   if (listen_fd_ >= 0) ::close(listen_fd_);
@@ -279,7 +324,20 @@ Status TcpTransport::Init() {
       return Status::Unknown("listen failed");
     }
     worker_fds_.assign(size_, -1);
+    // Bounded accept: a worker that never arrives (crashed during launch)
+    // must fail the root loudly instead of wedging it in accept() forever.
+    auto accept_deadline = std::chrono::steady_clock::now() +
+                           std::chrono::duration<double>(
+                               timeout_sec_ > 0 ? timeout_sec_ : 60.0);
     for (int i = 0; i < size_ - 1; ++i) {
+      struct pollfd lp = {listen_fd_, POLLIN, 0};
+      auto remain = std::chrono::duration_cast<std::chrono::milliseconds>(
+          accept_deadline - std::chrono::steady_clock::now()).count();
+      if (remain <= 0 || ::poll(&lp, 1, static_cast<int>(remain)) <= 0) {
+        return Status::Unknown(
+            "timed out waiting for " + std::to_string(size_ - 1 - i) +
+            " worker connection(s) on the controller listener");
+      }
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) return Status::Unknown("accept failed");
       int one2 = 1;
@@ -295,71 +353,187 @@ Status TcpTransport::Init() {
       worker_fds_[peer_rank] = fd;
     }
   } else {
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::duration<double>(
-                        timeout_sec_ > 0 ? timeout_sec_ : 60.0);
-    while (true) {
-      root_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-      if (root_fd_ < 0) return Status::Unknown("socket() failed");
-      sockaddr_in sa{};
-      sa.sin_family = AF_INET;
-      sa.sin_port = htons(static_cast<uint16_t>(port_));
-      if (inet_pton(AF_INET, addr_.c_str(), &sa.sin_addr) != 1) {
-        // resolve hostname
-        struct addrinfo hints{};
-        hints.ai_family = AF_INET;
-        hints.ai_socktype = SOCK_STREAM;
-        struct addrinfo* res = nullptr;
-        if (getaddrinfo(addr_.c_str(), nullptr, &hints, &res) != 0 || !res) {
-          ::close(root_fd_);
-          return Status::Unknown("cannot resolve controller address " + addr_);
-        }
-        sa.sin_addr =
-            reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
-        freeaddrinfo(res);
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port_));
+    if (inet_pton(AF_INET, addr_.c_str(), &sa.sin_addr) != 1) {
+      // resolve hostname
+      struct addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      struct addrinfo* res = nullptr;
+      if (getaddrinfo(addr_.c_str(), nullptr, &hints, &res) != 0 || !res) {
+        return Status::Unknown("cannot resolve controller address " + addr_);
       }
-      if (::connect(root_fd_, reinterpret_cast<sockaddr*>(&sa),
-                    sizeof(sa)) == 0) {
-        break;
-      }
-      ::close(root_fd_);
-      root_fd_ = -1;
-      if (std::chrono::steady_clock::now() > deadline) {
-        return Status::Unknown("timed out connecting to controller at " +
-                               addr_ + ":" + std::to_string(port_));
-      }
-      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      sa.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
     }
-    int one = 1;
-    setsockopt(root_fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    SetTimeout(root_fd_, timeout_sec_);
+    auto st = ConnectWithBackoff(
+        sa, "controller at " + addr_ + ":" + std::to_string(port_),
+        timeout_sec_ > 0 ? timeout_sec_ : 60.0, &root_fd_);
+    if (!st.ok()) return st;
     uint32_t my_rank = static_cast<uint32_t>(rank_);
-    auto st = WriteAll(root_fd_, reinterpret_cast<const char*>(&my_rank),
-                       sizeof(my_rank));
+    st = WriteAll(root_fd_, reinterpret_cast<const char*>(&my_rank),
+                  sizeof(my_rank));
     if (!st.ok()) return st;
   }
   return Status::OK();
 }
 
-Status TcpTransport::SendFrame(int fd, const std::string& payload) {
-  uint32_t len = static_cast<uint32_t>(payload.size());
-  auto st = WriteAll(fd, reinterpret_cast<const char*>(&len), sizeof(len));
+Status TcpTransport::ConnectWithBackoff(const ::sockaddr_in& peer,
+                                        const std::string& what,
+                                        double timeout_sec, int* out_fd) {
+  // Bounded reconnect: HOROVOD_CONNECT_RETRIES attempts (0 = bounded only
+  // by the overall deadline, the pre-existing launcher-skew behavior) with
+  // exponential backoff from HOROVOD_CONNECT_BACKOFF_MS, capped, plus
+  // uniform jitter so a restarted controller isn't hit by a synchronized
+  // reconnect storm from every worker at once.
+  const int64_t max_retries = EnvInt64("HOROVOD_CONNECT_RETRIES", 0);
+  const int64_t backoff_ms =
+      std::max<int64_t>(1, EnvInt64("HOROVOD_CONNECT_BACKOFF_MS", 50));
+  const int64_t backoff_cap_ms = std::max<int64_t>(
+      backoff_ms, EnvInt64("HOROVOD_CONNECT_BACKOFF_CAP_MS", 2000));
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_sec);
+  int64_t attempt = 0;
+  std::string last_error;
+  while (true) {
+    int fd = -1;
+    Status ist = Inject("connect");
+    if (!ist.ok()) {
+      last_error = ist.reason;
+    } else {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) return Status::Unknown("socket() failed");
+      if (::connect(fd, reinterpret_cast<const sockaddr*>(&peer),
+                    sizeof(peer)) == 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        SetTimeout(fd, timeout_sec_);
+        *out_fd = fd;
+        return Status::OK();
+      }
+      last_error = strerror(errno);
+      ::close(fd);
+    }
+    ++attempt;
+    CountMetric(&MetricsStore::connect_retries);
+    if (max_retries > 0 && attempt >= max_retries) {
+      return Status::Unknown(
+          "exhausted " + std::to_string(max_retries) +
+          " connect attempts (HOROVOD_CONNECT_RETRIES) to " + what +
+          ": " + last_error);
+    }
+    if (std::chrono::steady_clock::now() > deadline) {
+      return Status::Unknown("timed out connecting to " + what + ": " +
+                             last_error);
+    }
+    const int64_t base = std::min<int64_t>(
+        backoff_cap_ms, backoff_ms << std::min<int64_t>(attempt - 1, 20));
+    const int64_t jittered = base / 2 + static_cast<int64_t>(
+        std::uniform_int_distribution<int64_t>(0, base / 2 + 1)(jitter_rng_));
+    std::this_thread::sleep_for(std::chrono::milliseconds(jittered));
+  }
+}
+
+Status TcpTransport::Inject(const char* point, bool* corrupt) {
+  if (corrupt != nullptr) *corrupt = false;
+  auto& inj = FaultInjector::Global();
+  if (!inj.enabled()) return Status::OK();
+  bool fired = false;
+  auto st = inj.OnEvent(channel_, point, rank_, corrupt, &fired);
+  if (fired) CountMetric(&MetricsStore::faults_injected);
+  return st;
+}
+
+Status TcpTransport::SendFrame(int fd, const std::string& payload,
+                               const char* point) {
+  bool corrupt = false;
+  auto ist = Inject(point, &corrupt);
+  if (!ist.ok()) return ist;
+  if (static_cast<int64_t>(payload.size()) > MaxFrameBytes()) {
+    // reject on the send side too: a length with bit 31 set would be
+    // misread by the receiver as an abort frame
+    return Status::InvalidArgument(
+        "frame payload of " + std::to_string(payload.size()) +
+        " bytes exceeds HOROVOD_MAX_FRAME_BYTES");
+  }
+  uint32_t hdr[2];
+  hdr[0] = static_cast<uint32_t>(payload.size());
+  hdr[1] = Crc32c(payload.data(), payload.size());
+  // Injected corruption: invalidate the checksum so the receiver's CRC
+  // check — the code under test — does the detecting.
+  if (corrupt) hdr[1] ^= 0xDEADBEEFu;
+  auto st = WriteAll(fd, reinterpret_cast<const char*>(hdr), sizeof(hdr));
   if (!st.ok()) return st;
   return WriteAll(fd, payload.data(), payload.size());
 }
 
-Status TcpTransport::RecvFrame(int fd, std::string* payload) {
-  uint32_t len = 0;
-  auto st = ReadAll(fd, reinterpret_cast<char*>(&len), sizeof(len));
+Status TcpTransport::RecvFrame(int fd, std::string* payload,
+                               const char* point) {
+  auto ist = Inject(point);
+  if (!ist.ok()) return ist;
+  uint32_t hdr[2] = {0, 0};
+  auto st = ReadAll(fd, reinterpret_cast<char*>(hdr), sizeof(hdr));
   if (!st.ok()) return st;
+  uint32_t len = hdr[0];
+  if (len & kAbortFrameBit) {
+    // Fast-abort announcement from the peer: a short reason payload
+    // follows. Surface ABORTED immediately — within one socket round trip
+    // of the failure, not after the recv timeout.
+    len &= ~kAbortFrameBit;
+    std::string reason;
+    if (len > 0 && len <= 65536) {
+      reason.resize(len);
+      ReadAll(fd, reason.data(), len);  // best effort; peer may be gone
+    }
+    return Status::Aborted("fast abort from peer: " +
+                           (reason.empty() ? "(no reason)" : reason));
+  }
   if (static_cast<int64_t>(len) > MaxFrameBytes()) {
     return Status::Unknown("frame header advertises " + std::to_string(len) +
                            " bytes, above HOROVOD_MAX_FRAME_BYTES — "
                            "corrupted or mismatched peer");
   }
   payload->resize(len);
-  if (len > 0) return ReadAll(fd, payload->data(), len);
+  if (len > 0) {
+    st = ReadAll(fd, payload->data(), len);
+    if (!st.ok()) return st;
+  }
+  const uint32_t crc = Crc32c(payload->data(), payload->size());
+  if (crc != hdr[1]) {
+    CountMetric(&MetricsStore::crc_failures);
+    return Status::Corrupted(
+        "frame CRC32C mismatch (" + std::to_string(len) + " bytes, got " +
+        std::to_string(crc) + ", header says " + std::to_string(hdr[1]) +
+        ") — wire corruption detected");
+  }
   return Status::OK();
+}
+
+void TcpTransport::AbortPeers(const std::string& reason) {
+  // Best effort, once: interleaving with an in-flight frame on the same fd
+  // is acceptable — the peer then sees a CRC/header error instead of the
+  // abort frame, either way a prompt failure. The session is being torn
+  // down; nothing sends after this.
+  if (abort_sent_.exchange(true)) return;
+  std::string r = reason.substr(0, 4096);
+  uint32_t hdr[2];
+  hdr[0] = kAbortFrameBit | static_cast<uint32_t>(r.size());
+  hdr[1] = Crc32c(r.data(), r.size());
+  auto send_to = [&](int fd) {
+    if (fd < 0) return;
+    if (WriteAll(fd, reinterpret_cast<const char*>(hdr), sizeof(hdr)).ok()) {
+      WriteAll(fd, r.data(), r.size());
+    }
+  };
+  if (rank_ == 0) {
+    for (int fd : worker_fds_) send_to(fd);
+  } else {
+    send_to(root_fd_);
+  }
+  send_to(ring_next_fd_);
+  send_to(ring_prev_fd_);
 }
 
 Status TcpTransport::Gather(const std::string& mine,
@@ -369,37 +543,37 @@ Status TcpTransport::Gather(const std::string& mine,
       out->assign(size_, std::string());
       (*out)[0] = mine;
       for (int r = 1; r < size_; ++r) {
-        auto st = RecvFrame(worker_fds_[r], &(*out)[r]);
+        auto st = RecvFrame(worker_fds_[r], &(*out)[r], "recv");
         if (!st.ok()) return st;
       }
     }
     return Status::OK();
   }
-  return SendFrame(root_fd_, mine);
+  return SendFrame(root_fd_, mine, "send");
 }
 
 Status TcpTransport::Bcast(std::string* payload) {
   if (rank_ == 0) {
     for (int r = 1; r < size_; ++r) {
-      auto st = SendFrame(worker_fds_[r], *payload);
+      auto st = SendFrame(worker_fds_[r], *payload, "send");
       if (!st.ok()) return st;
     }
     return Status::OK();
   }
-  return RecvFrame(root_fd_, payload);
+  return RecvFrame(root_fd_, payload, "recv");
 }
 
 Status TcpTransport::Scatter(const std::vector<std::string>* payloads,
                              std::string* mine) {
   if (rank_ == 0) {
     for (int r = 1; r < size_; ++r) {
-      auto st = SendFrame(worker_fds_[r], (*payloads)[r]);
+      auto st = SendFrame(worker_fds_[r], (*payloads)[r], "send");
       if (!st.ok()) return st;
     }
     *mine = (*payloads)[0];
     return Status::OK();
   }
-  return RecvFrame(root_fd_, mine);
+  return RecvFrame(root_fd_, mine, "recv");
 }
 
 Status TcpTransport::BitAllreduce(std::vector<uint64_t>* bits, bool is_and) {
@@ -516,54 +690,44 @@ Status TcpTransport::EnsureRing() {
   const size_t colon = next.rfind(':');
   const std::string next_ip = next.substr(0, colon);
   const int next_port = std::stoi(next.substr(colon + 1));
-  auto deadline = std::chrono::steady_clock::now() +
-                  std::chrono::duration<double>(
-                      timeout_sec_ > 0 ? timeout_sec_ : 60.0);
-  while (true) {
-    ring_next_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (ring_next_fd_ < 0) return fail("ring socket() failed");
-    sockaddr_in peer{};
-    peer.sin_family = AF_INET;
-    peer.sin_port = htons(static_cast<uint16_t>(next_port));
-    if (inet_pton(AF_INET, next_ip.c_str(), &peer.sin_addr) != 1) {
-      return fail("bad ring peer address " + next_ip);
-    }
-    if (::connect(ring_next_fd_, reinterpret_cast<sockaddr*>(&peer),
-                  sizeof(peer)) == 0) {
-      break;
-    }
-    ::close(ring_next_fd_);
-    ring_next_fd_ = -1;
-    if (std::chrono::steady_clock::now() > deadline) {
-      return fail("timed out connecting ring successor " + next);
-    }
-    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  sockaddr_in peer{};
+  peer.sin_family = AF_INET;
+  peer.sin_port = htons(static_cast<uint16_t>(next_port));
+  if (inet_pton(AF_INET, next_ip.c_str(), &peer.sin_addr) != 1) {
+    return fail("bad ring peer address " + next_ip);
   }
-  setsockopt(ring_next_fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  SetTimeout(ring_next_fd_, timeout_sec_);
+  int next_fd = -1;
+  st = ConnectWithBackoff(peer, "ring successor " + next,
+                          timeout_sec_ > 0 ? timeout_sec_ : 60.0, &next_fd);
+  if (!st.ok()) {
+    fail("");
+    return st;
+  }
+  ring_next_fd_ = next_fd;
   // bounded accept: a predecessor that died after the address exchange must
   // fail this rank loudly, not hang it
   struct pollfd lp = {ring_listen_fd_, POLLIN, 0};
   int prc = ::poll(&lp, 1, static_cast<int>(
       (timeout_sec_ > 0 ? timeout_sec_ : 60.0) * 1000));
   if (prc <= 0) return fail("timed out waiting for ring predecessor");
-  ring_prev_fd_ = ::accept(ring_listen_fd_, nullptr, nullptr);
-  if (ring_prev_fd_ < 0) return fail("ring accept failed");
-  setsockopt(ring_prev_fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  SetTimeout(ring_prev_fd_, timeout_sec_);
+  int prev_fd = ::accept(ring_listen_fd_, nullptr, nullptr);
+  if (prev_fd < 0) return fail("ring accept failed");
+  setsockopt(prev_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  SetTimeout(prev_fd, timeout_sec_);
+  ring_prev_fd_ = prev_fd;
   return Status::OK();
 }
 
 Status TcpTransport::RingSend(const std::string& payload) {
   auto st = EnsureRing();
   if (!st.ok()) return st;
-  return SendFrame(ring_next_fd_, payload);
+  return SendFrame(ring_next_fd_.load(), payload, "ring_send");
 }
 
 Status TcpTransport::RingRecv(std::string* payload) {
   auto st = EnsureRing();
   if (!st.ok()) return st;
-  return RecvFrame(ring_prev_fd_, payload);
+  return RecvFrame(ring_prev_fd_.load(), payload, "ring_recv");
 }
 
 Status TcpTransport::RingExchange(const void* send, int64_t send_len,
@@ -576,16 +740,32 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
   // and recvs use MSG_DONTWAIT — poll() only guarantees *some* progress is
   // possible, and a blocking send of a frame larger than the socket buffer
   // would stall the receive side and re-create the deadlock.
-  // Same uint32 framing as SendFrame/RecvFrame, so RingSend/RingRecv and
+  // Same [len|crc] framing as SendFrame/RecvFrame, so RingSend/RingRecv and
   // RingExchange can be mixed across (lockstep) collectives. The payload is
   // streamed straight from the caller's buffer (header kept separately) —
-  // no staging copy.
+  // no staging copy; the CRC is computed in one pass up front.
+  bool corrupt = false;
+  auto ist = Inject("ring_send", &corrupt);
+  if (!ist.ok()) return ist;
+  ist = Inject("ring_recv");
+  if (!ist.ok()) return ist;
+  if (send_len > MaxFrameBytes()) {
+    return Status::InvalidArgument(
+        "ring frame payload of " + std::to_string(send_len) +
+        " bytes exceeds HOROVOD_MAX_FRAME_BYTES");
+  }
+  const int next_fd = ring_next_fd_.load();
+  const int prev_fd = ring_prev_fd_.load();
   const char* send_data = static_cast<const char*>(send);
-  uint32_t send_hdr = static_cast<uint32_t>(send_len);
+  uint32_t send_hdr[2];
+  send_hdr[0] = static_cast<uint32_t>(send_len);
+  send_hdr[1] = Crc32c(send_data, static_cast<size_t>(send_len));
+  if (corrupt) send_hdr[1] ^= 0xDEADBEEFu;
   size_t hdr_sent = 0;
-  int64_t sent = 0;
+  uint32_t recv_hdr_buf[2] = {0, 0};
   uint32_t recv_len = 0;
   size_t recv_hdr = 0;
+  int64_t sent = 0;
   int64_t recvd = 0;
   bool recv_hdr_done = false;
   while (hdr_sent < sizeof(send_hdr) || sent < send_len || !recv_hdr_done ||
@@ -594,11 +774,11 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
     int n = 0;
     int send_idx = -1, recv_idx = -1;
     if (hdr_sent < sizeof(send_hdr) || sent < send_len) {
-      fds[n] = {ring_next_fd_, POLLOUT, 0};
+      fds[n] = {next_fd, POLLOUT, 0};
       send_idx = n++;
     }
     if (!recv_hdr_done || recvd < static_cast<int64_t>(recv_len)) {
-      fds[n] = {ring_prev_fd_, POLLIN, 0};
+      fds[n] = {prev_fd, POLLIN, 0};
       recv_idx = n++;
     }
     int rc = ::poll(fds, n, static_cast<int>(
@@ -612,13 +792,13 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
     if (send_idx >= 0 && (fds[send_idx].revents & (POLLOUT | POLLERR))) {
       ssize_t w;
       if (hdr_sent < sizeof(send_hdr)) {
-        w = ::send(ring_next_fd_,
-                   reinterpret_cast<const char*>(&send_hdr) + hdr_sent,
+        w = ::send(next_fd,
+                   reinterpret_cast<const char*>(send_hdr) + hdr_sent,
                    sizeof(send_hdr) - hdr_sent,
                    MSG_NOSIGNAL | MSG_DONTWAIT);
         if (w > 0) hdr_sent += static_cast<size_t>(w);
       } else {
-        w = ::send(ring_next_fd_, send_data + sent, send_len - sent,
+        w = ::send(next_fd, send_data + sent, send_len - sent,
                    MSG_NOSIGNAL | MSG_DONTWAIT);
         if (w > 0) sent += w;
       }
@@ -632,11 +812,17 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
         (fds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
       ssize_t r;
       if (!recv_hdr_done) {
-        char* hdr = reinterpret_cast<char*>(&recv_len);
-        r = ::recv(ring_prev_fd_, hdr + recv_hdr,
-                   sizeof(recv_len) - recv_hdr, MSG_DONTWAIT);
+        char* hdr = reinterpret_cast<char*>(recv_hdr_buf);
+        r = ::recv(prev_fd, hdr + recv_hdr,
+                   sizeof(recv_hdr_buf) - recv_hdr, MSG_DONTWAIT);
         if (r > 0) recv_hdr += static_cast<size_t>(r);
-        if (recv_hdr == sizeof(recv_len)) {
+        if (recv_hdr == sizeof(recv_hdr_buf)) {
+          recv_len = recv_hdr_buf[0];
+          if (recv_len & kAbortFrameBit) {
+            return Status::Aborted(
+                "fast abort from ring peer (teardown announced "
+                "mid-exchange)");
+          }
           if (static_cast<int64_t>(recv_len) > MaxFrameBytes()) {
             return Status::Unknown(
                 "ring frame header advertises " + std::to_string(recv_len) +
@@ -647,7 +833,7 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
           recv->resize(recv_len);
         }
       } else {
-        r = ::recv(ring_prev_fd_, recv->data() + recvd, recv_len - recvd,
+        r = ::recv(prev_fd, recv->data() + recvd, recv_len - recvd,
                    MSG_DONTWAIT);
         if (r > 0) recvd += r;
       }
@@ -658,6 +844,13 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
                                strerror(errno));
       }
     }
+  }
+  const uint32_t crc = Crc32c(recv->data(), recv->size());
+  if (crc != recv_hdr_buf[1]) {
+    CountMetric(&MetricsStore::crc_failures);
+    return Status::Corrupted(
+        "ring frame CRC32C mismatch (" + std::to_string(recv_len) +
+        " bytes) — wire corruption detected");
   }
   return Status::OK();
 }

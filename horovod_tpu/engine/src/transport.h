@@ -16,14 +16,19 @@
 #ifndef HVD_TPU_TRANSPORT_H
 #define HVD_TPU_TRANSPORT_H
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <memory>
 #include <mutex>
+#include <random>
 #include <string>
 #include <vector>
 
 #include "common.h"
+#include "metrics.h"
+
+struct sockaddr_in;  // <netinet/in.h>; kept out of this header
 
 namespace hvdtpu {
 
@@ -33,6 +38,20 @@ class ControllerTransport {
 
   virtual int rank() const = 0;
   virtual int size() const = 0;
+
+  // Engine metrics sink (connect retries, CRC failures, injected faults)
+  // and the channel label fault-injection rules filter on ("control" or
+  // "data"). Set by the engine right after construction.
+  void set_metrics(MetricsStore* m) { metrics_ = m; }
+  void set_channel(const char* c) { channel_ = c; }
+
+  // Fast-abort fan-out: best-effort notification of every directly
+  // connected peer that this rank is tearing the session down, so their
+  // blocking receives fail within milliseconds instead of waiting out
+  // HOROVOD_CONTROLLER_TIMEOUT_SECONDS. TCP sends a flagged abort frame on
+  // every live socket; loopback aborts the shared hub. Never throws or
+  // blocks longer than the socket send timeout; idempotent.
+  virtual void AbortPeers(const std::string& reason) { (void)reason; }
 
   // Root receives every rank's payload (out->size() == size, index = rank);
   // non-roots contribute and get an empty out.
@@ -63,6 +82,17 @@ class ControllerTransport {
   virtual Status RingRecv(std::string* payload) = 0;
   virtual Status RingExchange(const void* send, int64_t send_len,
                               std::string* recv) = 0;
+
+ protected:
+  MetricsStore* metrics_ = nullptr;
+  const char* channel_ = "control";
+
+  void CountMetric(std::atomic<int64_t> MetricsStore::*member,
+                   int64_t n = 1) {
+    if (metrics_ != nullptr) {
+      (metrics_->*member).fetch_add(n, std::memory_order_relaxed);
+    }
+  }
 };
 
 // ---------------------------------------------------------------------------
@@ -80,7 +110,10 @@ struct LoopbackHub {
   int bits_arrived = 0;
   int arrived = 0;
   uint64_t generation = 0;
-  bool aborted = false;
+  // atomic: checked both under `mu` (cv predicates) and lock-free at the
+  // end of completed collectives / by GetOrCreateLoopbackHub's
+  // poisoned-hub replacement
+  std::atomic<bool> aborted{false};
   // ring mailboxes: slot r is written by rank r, consumed by rank (r+1)%size
   std::vector<std::string> ring_slots;
   std::vector<bool> ring_full;
@@ -106,8 +139,14 @@ class LoopbackTransport : public ControllerTransport {
   Status RingRecv(std::string* payload) override;
   Status RingExchange(const void* send, int64_t send_len,
                       std::string* recv) override;
+  void AbortPeers(const std::string& reason) override;
 
  private:
+  // Evaluate the fault injector at `point`; a fired drop/corrupt also
+  // aborts the hub — a loopback rank that vanishes mid-collective must
+  // unblock its peers the way a closed TCP socket does.
+  Status Inject(const char* point);
+
   std::shared_ptr<LoopbackHub> hub_;
   int rank_;
 };
@@ -143,10 +182,26 @@ class TcpTransport : public ControllerTransport {
   Status RingRecv(std::string* payload) override;
   Status RingExchange(const void* send, int64_t send_len,
                       std::string* recv) override;
+  void AbortPeers(const std::string& reason) override;
 
  private:
-  Status SendFrame(int fd, const std::string& payload);
-  Status RecvFrame(int fd, std::string* payload);
+  // Fault-injection prologue shared by every TCP event site; counts every
+  // firing (including delay rules) in faults_injected. *corrupt is set
+  // when the caller owns a frame and should invalidate its CRC.
+  Status Inject(const char* point, bool* corrupt = nullptr);
+  // Framing: [u32 len | u32 crc32c(payload) | payload]. Bit 31 of len marks
+  // an abort frame (payload = reason) — recognized at ANY receive point, so
+  // a peer announcing teardown unblocks this rank immediately. `point` is
+  // the fault-injection label ("send" / "ring_send" / ...).
+  Status SendFrame(int fd, const std::string& payload, const char* point);
+  Status RecvFrame(int fd, std::string* payload, const char* point);
+  // Bounded connect with exponential backoff + jitter
+  // (HOROVOD_CONNECT_RETRIES / HOROVOD_CONNECT_BACKOFF_MS); also the
+  // injection point for connect-storm tests. *out_fd receives a connected
+  // socket on success.
+  Status ConnectWithBackoff(const ::sockaddr_in& peer,
+                            const std::string& what, double timeout_sec,
+                            int* out_fd);
   // Lazily builds neighbor links: every rank binds an ephemeral listener,
   // addresses ride a Gather+Bcast on the star, then each rank connects to
   // its successor and accepts from its predecessor.
@@ -161,8 +216,14 @@ class TcpTransport : public ControllerTransport {
   int root_fd_ = -1;                 // worker→root socket (workers)
   std::vector<int> worker_fds_;      // root's sockets indexed by rank
   int ring_listen_fd_ = -1;
-  int ring_next_fd_ = -1;            // to (rank+1)%size
-  int ring_prev_fd_ = -1;            // from (rank-1+size)%size
+  // Ring fds are atomic: they are assigned lazily by EnsureRing on the
+  // background thread while AbortPeers may read them from the thread that
+  // called hvdtpu_abort. root/worker fds are set in Init before the
+  // background thread exists, so plain ints are fine there.
+  std::atomic<int> ring_next_fd_{-1};  // to (rank+1)%size
+  std::atomic<int> ring_prev_fd_{-1};  // from (rank-1+size)%size
+  std::atomic<bool> abort_sent_{false};
+  std::mt19937 jitter_rng_;          // backoff jitter (seeded by rank)
 };
 
 }  // namespace hvdtpu

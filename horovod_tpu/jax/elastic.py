@@ -16,6 +16,7 @@ semantics carried over exactly:
 from __future__ import annotations
 
 import copy
+import os
 import queue
 from typing import Any, Callable, Dict
 
@@ -54,6 +55,10 @@ def _check_host_updates():
             gen, s = _notification_queue.get_nowait()
         except queue.Empty:
             break
+        # generation=None means "always newer" (a caller without generation
+        # tracking forcing a re-rendezvous) — it must never enter the
+        # integer comparison below, only explicit generations are
+        # staleness-filtered.
         if gen is not None and gen <= cur:
             continue  # stale: we already rendezvoused past this generation
         updated = True
@@ -145,6 +150,12 @@ def _is_pytree_of_arrays(v) -> bool:
     return False
 
 
+# Failures further apart than this are independent incidents, not one
+# unhealed outage: the retry counter resets so HOROVOD_ELASTIC_MAX_RETRIES
+# bounds *consecutive* recoveries rather than a long job's lifetime total.
+_RETRY_WINDOW_SECONDS = 600.0
+
+
 def run(func: Callable) -> Callable:
     """Elastic retry wrapper (reference: common/elastic.py:147-168).
 
@@ -152,10 +163,29 @@ def run(func: Callable) -> Callable:
     committed state is restored, the framework re-initialized, state
     re-synced; on HostsUpdatedInterrupt training resumes with current state
     after re-initialization.
+
+    Failure retries are bounded: after HOROVOD_ELASTIC_MAX_RETRIES
+    consecutive HorovodInternalError recoveries (default 100; 0 =
+    unbounded, the reference's behavior; the counter resets after a
+    failure-free ``_RETRY_WINDOW_SECONDS`` stretch) the error propagates
+    instead of looping forever against a cluster that will never heal.
+    Each failed round backs off exponentially (base
+    HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS, default 0.5s, capped at 30s,
+    jittered) so a flapping peer isn't hammered by synchronized re-inits.
+    Host-update interrupts are normal scaling events and are neither
+    counted nor delayed.
     """
 
     def wrapper(state: State, *args, **kwargs):
+        import random
+        import time
         start_notification_poller()
+        max_retries = int(os.environ.get(
+            "HOROVOD_ELASTIC_MAX_RETRIES", "100") or 0)
+        backoff_base = float(os.environ.get(
+            "HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS", "0.5") or 0)
+        failures = 0
+        last_failure = None
         skip_sync = False
         try:
             while True:
@@ -173,6 +203,24 @@ def run(func: Callable) -> Callable:
                     _record_final_state(success=True)
                     return result
                 except HorovodInternalError:
+                    now = time.monotonic()
+                    # a long healthy stretch since the previous failure
+                    # means the cluster recovered — the bound targets
+                    # *consecutive* failures (a job that won't heal), not
+                    # unrelated transients spread over a job's lifetime
+                    if last_failure is not None and \
+                            now - last_failure > _RETRY_WINDOW_SECONDS:
+                        failures = 0
+                    last_failure = now
+                    failures += 1
+                    if max_retries > 0 and failures > max_retries:
+                        _record_final_state(success=False)
+                        raise
+                    if backoff_base > 0:
+                        delay = min(30.0,
+                                    backoff_base * (2 ** min(failures - 1,
+                                                             6)))
+                        time.sleep(delay * (0.5 + random.random() / 2))
                     state.restore()
                     skip_sync = False
                 except HostsUpdatedInterrupt as e:

@@ -8,11 +8,20 @@ the driver polls it every second (reference: driver.py:181-201).
 
 from __future__ import annotations
 
+import os
 import subprocess
 import threading
+import time
 from typing import Dict, List
 
 from horovod_tpu.runner import hosts as hosts_lib
+
+# A blacklisted host becomes eligible again after this long and is
+# re-probed — transient failures (OOM kill, preemption, a flapping NIC)
+# must not permanently shrink the job the way the reference's
+# forever-blacklist does (reference: discovery.py HostManager). 0 or
+# negative restores the permanent behavior.
+DEFAULT_BLACKLIST_COOLDOWN_SECONDS = 300.0
 
 
 class HostDiscovery:
@@ -55,27 +64,51 @@ class FixedHostDiscovery(HostDiscovery):
 
 
 class HostManager:
-    """Tracks current hosts + blacklist (reference: discovery.py
-    HostManager)."""
+    """Tracks current hosts + blacklist with cooldown (reference:
+    discovery.py HostManager, which blacklists forever; here a blacklisted
+    host becomes eligible again after HOROVOD_BLACKLIST_COOLDOWN_SECONDS
+    and is re-probed at the next refresh)."""
 
-    def __init__(self, discovery: HostDiscovery):
+    def __init__(self, discovery: HostDiscovery,
+                 cooldown: float = None):
         self._discovery = discovery
         self._lock = threading.Lock()
-        self._blacklist = set()
+        # hostname -> monotonic timestamp of the (latest) blacklisting
+        self._blacklist: Dict[str, float] = {}
         self.current: Dict[str, int] = {}
+        if cooldown is None:
+            cooldown = float(os.environ.get(
+                "HOROVOD_BLACKLIST_COOLDOWN_SECONDS",
+                str(DEFAULT_BLACKLIST_COOLDOWN_SECONDS)) or 0)
+        self._cooldown = cooldown
 
     def blacklist(self, hostname: str):
         with self._lock:
-            self._blacklist.add(hostname)
+            self._blacklist[hostname] = time.monotonic()
+
+    def _expired(self, ts: float) -> bool:
+        return self._cooldown > 0 and \
+            time.monotonic() - ts >= self._cooldown
 
     def is_blacklisted(self, hostname: str) -> bool:
         with self._lock:
-            return hostname in self._blacklist
+            ts = self._blacklist.get(hostname)
+            if ts is None:
+                return False
+            if self._expired(ts):
+                # cooldown elapsed: forget the entry so the host is
+                # re-probed; a repeat failure re-blacklists it afresh
+                del self._blacklist[hostname]
+                return False
+            return True
 
     def refresh(self) -> bool:
         """Poll discovery; returns True if the usable host set changed."""
         found = self._discovery.find_available_hosts_and_slots()
         with self._lock:
+            for h in [h for h, ts in self._blacklist.items()
+                      if self._expired(ts)]:
+                del self._blacklist[h]
             usable = {h: s for h, s in found.items()
                       if h not in self._blacklist}
         changed = usable != self.current

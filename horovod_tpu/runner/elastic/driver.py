@@ -33,7 +33,6 @@ import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
-from urllib import request as urlrequest
 
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.metrics import step_stats
@@ -48,7 +47,7 @@ from horovod_tpu.runner.elastic.registration import (
     WorkerStateRegistry,
 )
 from horovod_tpu.runner.exec_utils import WorkerProcess
-from horovod_tpu.runner.http_kv import KVServer
+from horovod_tpu.runner.http_kv import KVServer, http_get_with_retry
 from horovod_tpu.runner.launch import (
     free_ports,
     launcher_addr,
@@ -57,6 +56,10 @@ from horovod_tpu.runner.launch import (
 )
 
 DISCOVER_INTERVAL_SECS = 1.0
+# Default for HOROVOD_FAILURES_TO_BLACKLIST: consecutive-ish worker
+# failures on a host before it is blacklisted (until the blacklist
+# cooldown re-admits it — see elastic/discovery.py). A clean generation
+# (every slot READY) clears a host's failure count.
 FAILURES_TO_BLACKLIST = 3
 # Fallback: publish go/g<N> even without full READY after this long, so a
 # worker that dies pre-READY cannot wedge the whole generation (its exit is
@@ -90,6 +93,9 @@ class ElasticDriver:
         self._prev_host_order: List[str] = []
         self._workers: Dict[Tuple[str, int], WorkerProcess] = {}
         self._host_failures: Dict[str, int] = {}
+        self._failures_to_blacklist = int(os.environ.get(
+            "HOROVOD_FAILURES_TO_BLACKLIST",
+            str(FAILURES_TO_BLACKLIST)) or FAILURES_TO_BLACKLIST)
         self._removed_slots: set = set()
         self._expected_slots: List[Tuple[str, int]] = []
         self._go_deadline: float = 0.0
@@ -224,6 +230,12 @@ class ElasticDriver:
             if counts.get(READY, 0) + counts.get(SUCCESS, 0) >= len(expected):
                 self._log(f"all {len(expected)} slots READY at generation "
                           f"{gen}; releasing go barrier")
+                # A clean generation proves its hosts healthy: clear their
+                # failure counts so unrelated failures spread over hours
+                # don't accumulate into a blacklisting.
+                with self._lock:
+                    for host in {h for h, _ in expected}:
+                        self._host_failures.pop(host, None)
             elif time.monotonic() > deadline:
                 self._log(f"go-barrier timeout at generation {gen} "
                           f"({counts}); releasing anyway")
@@ -329,9 +341,11 @@ class ElasticDriver:
                 del self._workers[key]
                 self._host_failures[host] = \
                     self._host_failures.get(host, 0) + 1
-                if self._host_failures[host] >= FAILURES_TO_BLACKLIST:
-                    self._log(f"blacklisting {host}")
+                if self._host_failures[host] >= self._failures_to_blacklist:
+                    self._log(f"blacklisting {host} (cooldown applies — "
+                              f"see HOROVOD_BLACKLIST_COOLDOWN_SECONDS)")
                     self._hosts.blacklist(host)
+                    self._host_failures.pop(host, None)
                 # request an explicit rebalance (respawns the dead slot at a
                 # fresh generation); replaces the prior hack of clearing the
                 # discovery view, which raced with the discovery thread
@@ -353,9 +367,13 @@ class ElasticDriver:
             if not info:
                 continue
             try:
+                # short per-attempt timeout and small backoff: the scrape is
+                # periodic and failure-tolerant (the next heartbeat is the
+                # real retry), so a dead worker must not block the loop for
+                # multiple full timeouts
                 url = f"http://{info['addr']}:{info['port']}/metrics.json"
-                with urlrequest.urlopen(url, timeout=2.0) as resp:
-                    snap = json.loads(resp.read())
+                snap = json.loads(http_get_with_retry(
+                    url, timeout=1.0, attempts=2, backoff=0.05))
             except Exception:  # noqa: BLE001 — worker mid-restart
                 continue
             stats = step_stats(snap)

@@ -9,12 +9,44 @@ like ``rank_and_size/<hostname>/<local_rank>``.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib import error as urlerror
 from urllib import request as urlrequest
+
+
+def _retrying(attempt_fn, attempts: int, backoff: float):
+    """Run ``attempt_fn`` with bounded retries and jittered exponential
+    backoff. Connection-level failures (URLError, reset, refused) are
+    transient and retried; HTTP status errors (404 and friends) mean the
+    server answered and raise immediately. Raises the last connection
+    error once attempts are exhausted."""
+    last: Exception = RuntimeError("no attempts made")
+    for i in range(max(1, attempts)):
+        try:
+            return attempt_fn()
+        except urlerror.HTTPError:
+            raise  # the server answered; retrying won't change its mind
+        except (urlerror.URLError, ConnectionError, OSError) as e:
+            last = e
+        if i + 1 < attempts:
+            time.sleep(backoff * (2 ** i) * (0.5 + random.random() / 2))
+    raise last
+
+
+def http_get_with_retry(url: str, timeout: float = 2.0, attempts: int = 3,
+                        backoff: float = 0.1) -> bytes:
+    """GET with bounded retries — one transient ECONNREFUSED during worker
+    startup must not abort a metrics scrape or fail a rendezvous."""
+
+    def attempt() -> bytes:
+        with urlrequest.urlopen(url, timeout=timeout) as resp:
+            return resp.read()
+
+    return _retrying(attempt, attempts, backoff)
 
 
 class KVServer:
@@ -101,11 +133,19 @@ class KVClient:
     def __init__(self, addr: str, port: int):
         self._base = f"http://{addr}:{port}/"
 
-    def put_json(self, key: str, value: Any, timeout: float = 10.0):
-        req = urlrequest.Request(self._base + key,
-                                 data=json.dumps(value).encode(),
-                                 method="PUT")
-        urlrequest.urlopen(req, timeout=timeout)
+    def put_json(self, key: str, value: Any, timeout: float = 10.0,
+                 attempts: int = 3, backoff: float = 0.1):
+        # Bounded retry on connection-level failures: a worker PUTting its
+        # READY record while the KV restarts (or before its listener is up)
+        # must not fail the whole rendezvous on one ECONNREFUSED.
+        body = json.dumps(value).encode()
+
+        def attempt():
+            req = urlrequest.Request(self._base + key, data=body,
+                                     method="PUT")
+            urlrequest.urlopen(req, timeout=timeout)
+
+        _retrying(attempt, attempts, backoff)
 
     def get_json(self, key: str, timeout: float = 10.0,
                  poll_interval: float = 0.2) -> Optional[Any]:

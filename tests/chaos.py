@@ -1,0 +1,132 @@
+"""Chaos harness for subprocess fault-tolerance tests.
+
+Deterministic building blocks the recovery tests compose: kill a worker by
+command-line pattern, freeze a process (a simulated network partition / KV
+stall — SIGSTOP leaves its sockets open but unresponsive, exactly what a
+partitioned peer looks like), and a flaky HTTP server that refuses the
+first N connections (the retry-path fixture).
+
+Not a test module (no ``test_`` prefix): imported by
+tests/test_fault_tolerance.py and tests/test_elastic_recovery.py. Paired
+with the engine-level injector (``HOROVOD_FAULT_SPEC``, which places faults
+at exact frame boundaries *inside* a rank), this covers the process-level
+failure modes: the injector breaks a rank from within, the harness breaks
+it from outside.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+
+def find_worker_pids(pattern: str) -> List[int]:
+    """PIDs of live processes whose command line matches ``pattern``
+    (pgrep -f semantics)."""
+    out = subprocess.run(["pgrep", "-f", pattern], capture_output=True,
+                         text=True)
+    return [int(p) for p in out.stdout.split()]
+
+
+def kill_workers(pattern: str, sig: int = signal.SIGKILL,
+                 count: Optional[int] = None) -> List[int]:
+    """Kill up to ``count`` (default: all) processes matching ``pattern``.
+    Returns the PIDs actually signalled."""
+    pids = find_worker_pids(pattern)
+    if count is not None:
+        pids = pids[-count:]
+    killed = []
+    for pid in pids:
+        try:
+            os.kill(pid, sig)
+            killed.append(pid)
+        except ProcessLookupError:
+            pass
+    return killed
+
+
+class Partition:
+    """Freeze a process for the scope of the context (SIGSTOP/SIGCONT).
+
+    From its peers' point of view the process is network-partitioned: its
+    sockets stay open but nothing flows — the shape of failure that
+    timeouts and stall detection exist for. Works on a worker (partitioned
+    rank) or on the launcher (stalled rendezvous KV)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def __enter__(self):
+        os.kill(self.pid, signal.SIGSTOP)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            os.kill(self.pid, signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+        return False
+
+
+def stall(pid: int, seconds: float):
+    """Partition a process for a fixed duration, then heal it."""
+    with Partition(pid):
+        time.sleep(seconds)
+
+
+class FlakyHTTPServer:
+    """HTTP server that drops the first ``fail_first`` connections cold
+    (the client sees a reset — the transient-failure class retries must
+    absorb), then serves ``body`` with status 200. ``requests_seen`` counts
+    every attempt, so tests assert the retry actually happened."""
+
+    def __init__(self, fail_first: int, body: bytes = b"{}"):
+        self.fail_first = fail_first
+        self.body = body
+        self.requests_seen = 0
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _handle(self):
+                with server._lock:
+                    server.requests_seen += 1
+                    n = server.requests_seen
+                if n <= server.fail_first:
+                    # slam the connection shut mid-request: the client gets
+                    # a reset/RemoteDisconnected, not an HTTP status
+                    self.connection.close()
+                    return
+                data = server.body
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = _handle
+            do_PUT = _handle
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return False

@@ -1,0 +1,482 @@
+"""Self-healing elastic recovery: blacklist cooldown, bounded retries,
+rendezvous-KV retry paths, and the host-update notification contract.
+
+The driver-level tests run the real ElasticDriver state machine with an
+injected spawn strategy (fake worker handles) — every transition is driven
+explicitly, no subprocesses, no sleeps-as-synchronization (the only waiting
+is a poll for a real cooldown interval to elapse). The subprocess test at
+the end is the full acceptance path: kill a worker, watch the driver
+blacklist its host, the cooldown re-admit it, and the job finish at a later
+generation.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import chaos
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.runner.elastic.discovery import (
+    FixedHostDiscovery,
+    HostManager,
+)
+from horovod_tpu.runner.http_kv import KVClient, http_get_with_retry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# host-update notifications (satellite: generation=None regression)
+
+
+@pytest.fixture
+def _notification_env(monkeypatch):
+    from horovod_tpu.jax import elastic
+    # drain anything a previous test left behind
+    while not elastic._notification_queue.empty():
+        elastic._notification_queue.get_nowait()
+    monkeypatch.setattr(elastic, "_current_generation", lambda: 5)
+    yield elastic
+    while not elastic._notification_queue.empty():
+        elastic._notification_queue.get_nowait()
+
+
+def test_notify_none_generation_always_newer(_notification_env):
+    """generation=None means "always newer": it must fire the interrupt
+    regardless of the worker's current generation, and must never hit the
+    integer staleness comparison."""
+    elastic = _notification_env
+    elastic.notify_hosts_updated(generation=None)
+    with pytest.raises(HostsUpdatedInterrupt):
+        elastic._check_host_updates()
+
+
+def test_notify_stale_generation_filtered(_notification_env):
+    elastic = _notification_env
+    elastic.notify_hosts_updated(generation=3)  # worker is already at 5
+    elastic._check_host_updates()  # no interrupt
+
+
+def test_notify_mixed_none_and_stale(_notification_env):
+    """A stale integer notification and a None notification together: the
+    None one wins (interrupt), the stale one is ignored — and skip_sync
+    aggregates across the accepted updates only."""
+    elastic = _notification_env
+    elastic.notify_hosts_updated(skip_sync=True, generation=3)
+    elastic.notify_hosts_updated(skip_sync=False, generation=None)
+    with pytest.raises(HostsUpdatedInterrupt) as exc:
+        elastic._check_host_updates()
+    assert exc.value.skip_sync is False
+
+
+# ---------------------------------------------------------------------------
+# bounded elastic retries
+
+
+def test_elastic_run_bounded_retries(monkeypatch):
+    """HOROVOD_ELASTIC_MAX_RETRIES bounds the HorovodInternalError retry
+    loop: after N recoveries the error propagates instead of looping
+    forever against a cluster that will never heal."""
+    from horovod_tpu.jax import elastic
+    monkeypatch.setenv("HOROVOD_ELASTIC_MAX_RETRIES", "3")
+    monkeypatch.setenv("HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS", "0.01")
+    calls = {"n": 0, "resets": 0}
+    monkeypatch.setattr(elastic, "_reset", lambda: calls.__setitem__(
+        "resets", calls["resets"] + 1))
+    monkeypatch.setattr(elastic, "start_notification_poller", lambda: None)
+
+    state = elastic.State(step=0)
+    monkeypatch.setattr(state, "sync", lambda: None)
+
+    @elastic.run
+    def always_fails(state):
+        calls["n"] += 1
+        raise HorovodInternalError("peer keeps dying")
+
+    with pytest.raises(HorovodInternalError, match="peer keeps dying"):
+        always_fails(state)
+    # initial attempt + 3 retries, and the 4th failure propagated without
+    # another reset
+    assert calls["n"] == 4, calls
+    assert calls["resets"] == 3, calls
+
+
+def test_elastic_run_recovers_within_budget(monkeypatch):
+    """Failures below the bound still recover exactly as before."""
+    from horovod_tpu.jax import elastic
+    monkeypatch.setenv("HOROVOD_ELASTIC_MAX_RETRIES", "5")
+    monkeypatch.setenv("HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS", "0.01")
+    monkeypatch.setattr(elastic, "_reset", lambda: None)
+    monkeypatch.setattr(elastic, "start_notification_poller", lambda: None)
+    state = elastic.State(step=0)
+    monkeypatch.setattr(state, "sync", lambda: None)
+    attempts = {"n": 0}
+
+    @elastic.run
+    def flaky(state):
+        attempts["n"] += 1
+        if attempts["n"] <= 2:
+            raise HorovodInternalError("transient")
+        return "done"
+
+    assert flaky(state) == "done"
+    assert attempts["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# KV retry paths (satellite: flaky-server tests)
+
+
+def test_http_get_with_retry_flaky_server():
+    """The first two connections are dropped cold; the third succeeds —
+    one transient ECONNRESET/REFUSED must not abort a scrape."""
+    with chaos.FlakyHTTPServer(fail_first=2, body=b'{"ok": true}') as srv:
+        body = http_get_with_retry(
+            f"http://127.0.0.1:{srv.port}/metrics.json",
+            timeout=2.0, attempts=3, backoff=0.01)
+        assert body == b'{"ok": true}'
+        assert srv.requests_seen == 3
+
+
+def test_http_get_with_retry_exhausts():
+    with chaos.FlakyHTTPServer(fail_first=10) as srv:
+        with pytest.raises(Exception):
+            http_get_with_retry(f"http://127.0.0.1:{srv.port}/x",
+                                timeout=1.0, attempts=3, backoff=0.01)
+        assert srv.requests_seen == 3
+
+
+def test_kv_put_retries_flaky_server():
+    """KVClient.put_json (READY records, reset requests) retries through
+    transient connection failures instead of failing the rendezvous."""
+    with chaos.FlakyHTTPServer(fail_first=2, body=b"{}") as srv:
+        client = KVClient("127.0.0.1", srv.port)
+        client.put_json("worker_state/g0/host/0", {"state": "READY"},
+                        timeout=2.0, backoff=0.01)
+        assert srv.requests_seen == 3
+
+
+# ---------------------------------------------------------------------------
+# blacklist cooldown (HostManager unit + driver state machine)
+
+
+def test_host_manager_cooldown_readmits():
+    disc = FixedHostDiscovery({"hostA": 1, "hostB": 1})
+    mgr = HostManager(disc, cooldown=0.3)
+    mgr.refresh()
+    assert set(mgr.current) == {"hostA", "hostB"}
+    mgr.blacklist("hostB")
+    mgr.refresh()
+    assert set(mgr.current) == {"hostA"}
+    assert mgr.is_blacklisted("hostB")
+    # poll (not a blind sleep) until the cooldown re-admits the host
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        mgr.refresh()
+        if "hostB" in mgr.current:
+            break
+        time.sleep(0.02)
+    assert set(mgr.current) == {"hostA", "hostB"}
+    assert not mgr.is_blacklisted("hostB")
+
+
+def test_host_manager_permanent_without_cooldown():
+    mgr = HostManager(FixedHostDiscovery({"h": 1}), cooldown=0)
+    mgr.blacklist("h")
+    mgr.refresh()
+    assert mgr.current == {}
+    assert mgr.is_blacklisted("h")
+
+
+class FakeWorker:
+    """Injected spawn handle: the driver's full reap/blacklist/respawn path
+    runs against these instead of subprocesses."""
+
+    spawned = []
+
+    def __init__(self, hostname, rank, command, env):
+        self.hostname = hostname
+        self.rank = rank
+        self.env = env
+        self.exit_code = None
+        FakeWorker.spawned.append(self)
+
+    def poll(self):
+        return self.exit_code
+
+    def terminate(self):
+        self.exit_code = 0 if self.exit_code is None else self.exit_code
+
+    def kill(self):
+        self.terminate()
+
+    def wait(self, timeout=None):
+        return self.exit_code
+
+
+def test_driver_blacklist_cooldown_rejoin(monkeypatch):
+    """Acceptance (d), state-machine form: a worker failure blacklists its
+    host (threshold 1), the next rebalance excludes it, the cooldown
+    re-admits it, and a later generation respawns a worker there — all
+    driven deterministically through the real ElasticDriver."""
+    monkeypatch.setenv("HOROVOD_FAILURES_TO_BLACKLIST", "1")
+    monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_SECONDS", "0.3")
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    FakeWorker.spawned = []
+    disc = FixedHostDiscovery({"hostA": 1, "hostB": 1})
+    driver = ElasticDriver(disc, min_np=1, max_np=2,
+                           command=["true"], spawn_worker=FakeWorker)
+    try:
+        driver._hosts.refresh()
+        driver._rebalance(first=True)
+        assert driver.generation == 0
+        assert {w.hostname for w in FakeWorker.spawned} == \
+            {"hostA", "hostB"}
+
+        # hostB's worker dies → threshold 1 → blacklisted immediately
+        next(w for w in FakeWorker.spawned
+             if w.hostname == "hostB").exit_code = 1
+        driver._reap_workers()
+        assert driver._hosts.is_blacklisted("hostB")
+        assert driver._rebalance_needed.is_set()
+
+        # the next generation runs without hostB
+        driver._hosts.refresh()
+        driver._rebalance()
+        assert driver.generation == 1
+        assert all(h == "hostA" for h, _ in driver._expected_slots)
+
+        # cooldown elapses → refresh re-admits hostB (polled, not slept)
+        deadline = time.monotonic() + 5.0
+        readmitted = False
+        while time.monotonic() < deadline:
+            if driver._hosts.refresh() and "hostB" in driver._hosts.current:
+                readmitted = True
+                break
+            time.sleep(0.02)
+        assert readmitted, "cooldown never re-admitted hostB"
+        assert not driver._hosts.is_blacklisted("hostB")
+
+        # and the following generation schedules hostB again
+        spawned_before = len(FakeWorker.spawned)
+        driver._rebalance()
+        assert driver.generation == 2
+        assert {h for h, _ in driver._expected_slots} == {"hostA", "hostB"}
+        new = FakeWorker.spawned[spawned_before:]
+        assert any(w.hostname == "hostB" for w in new), \
+            "no worker respawned on the re-admitted host"
+        assert any(w.env.get("HOROVOD_ELASTIC_GENERATION") == "2"
+                   for w in new)
+    finally:
+        driver._shutdown.set()
+        driver._kv.stop()
+
+
+def test_driver_clean_generation_clears_failure_counts(monkeypatch):
+    """One failure (below threshold 2) followed by a clean generation must
+    not leave the host one strike from blacklisting forever."""
+    monkeypatch.setenv("HOROVOD_FAILURES_TO_BLACKLIST", "2")
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    FakeWorker.spawned = []
+    disc = FixedHostDiscovery({"hostA": 1})
+    driver = ElasticDriver(disc, min_np=1, max_np=1,
+                           command=["true"], spawn_worker=FakeWorker)
+    try:
+        driver._hosts.refresh()
+        driver._rebalance(first=True)
+        FakeWorker.spawned[0].exit_code = 1
+        driver._reap_workers()
+        assert driver._host_failures.get("hostA") == 1
+        # a clean generation: every expected slot records READY → the real
+        # go-barrier loop publishes go AND clears the failure count
+        import threading
+        barrier = threading.Thread(target=driver._go_barrier_loop,
+                                   daemon=True)
+        barrier.start()
+        gen = driver.generation
+        for host, slot in driver._expected_slots:
+            driver._registry.record(gen, host, slot, "READY")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                driver._kv.get_json(f"go/g{gen}") is None:
+            time.sleep(0.02)
+        assert driver._kv.get_json(f"go/g{gen}") is not None, \
+            "go barrier never released"
+        assert "hostA" not in driver._host_failures
+        driver._shutdown.set()
+        barrier.join(timeout=5)
+    finally:
+        driver._shutdown.set()
+        driver._kv.stop()
+
+
+# ---------------------------------------------------------------------------
+# partition tolerance (chaos harness: SIGSTOP = partitioned rank)
+
+
+PARTITION_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from horovod_tpu.engine import EngineSession, OP_ALLREDUCE
+
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+port = int(os.environ["HOROVOD_CONTROLLER_PORT"])
+s = EngineSession(rank=rank, size=size, transport="tcp",
+                  addr="127.0.0.1", port=port, timeout_sec=30.0)
+for step in range(30):
+    h = s.enqueue(f"p{{step}}", OP_ALLREDUCE, "float32", [8])
+    s.wait(h, timeout=25.0)
+    print(f"partition-progress rank={{rank}} step={{step}}", flush=True)
+s.shutdown()
+print(f"partition worker {{rank}} OK", flush=True)
+"""
+
+
+def test_partition_heals_without_abort(tmp_path):
+    """A short network partition (SIGSTOP'd rank, sockets open but silent)
+    must NOT trigger the fast abort — it is indistinguishable from a slow
+    rank and heals when traffic resumes. Detection stays reserved for real
+    teardown (closed sockets / abort frames)."""
+    import textwrap
+    size = 2
+    from horovod_tpu.runner.launch import free_ports
+    port = free_ports(1)[0]
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(PARTITION_WORKER).format(repo=REPO))
+    procs = []
+    for r in range(size):
+        env = dict(os.environ,
+                   HOROVOD_RANK=str(r), HOROVOD_SIZE=str(size),
+                   HOROVOD_CONTROLLER_PORT=str(port))
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen([sys.executable, str(script)],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    # wait for real progress, then partition rank 1 for a second mid-run
+    # (generous deadline: jax import under CI load dominates)
+    deadline = time.monotonic() + 240
+    saw_progress = False
+    while time.monotonic() < deadline:
+        line = procs[1].stdout.readline().decode()
+        if "partition-progress rank=1 step=3" in line:
+            saw_progress = True
+            break
+        if line == "" and procs[1].poll() is not None:
+            break  # EOF: drained every buffered line and the rank exited
+    assert saw_progress, "rank 1 never progressed"
+    chaos.stall(procs[1].pid, 1.0)
+    outs = [p.communicate(timeout=180)[0].decode() for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+    assert f"partition worker 1 OK" in outs[1]
+
+
+# ---------------------------------------------------------------------------
+# full subprocess acceptance (d): kill → blacklist → cooldown → rejoin
+
+
+ELASTIC_TRAIN = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import horovod_tpu as hvd_top
+import horovod_tpu.jax as hvd
+from horovod_tpu.jax import elastic
+
+hvd_top.init()
+state = elastic.State(step=0)
+TOTAL = int(os.environ.get("TOTAL_STEPS", "25"))
+
+@elastic.run
+def train(state):
+    while state.step < TOTAL:
+        out = np.asarray(hvd.allreduce(
+            np.ones(2, np.float32), op=hvd.Sum,
+            name=f"batch.{{state.step}}"))
+        assert np.allclose(out, hvd_top.size()), (out, hvd_top.size())
+        print(f"progress rank={{hvd_top.rank()}} step={{state.step}} "
+              f"gen={{os.environ.get('HOROVOD_ELASTIC_GENERATION')}}",
+              flush=True)
+        state.step += 1
+        state.commit()
+        time.sleep(0.05)
+    return state.step
+
+steps = train(state)
+print(f"worker-done rank={{hvd_top.rank()}} steps={{steps}} "
+      f"gen={{os.environ.get('HOROVOD_ELASTIC_GENERATION')}}", flush=True)
+hvd_top.shutdown()
+"""
+
+
+def test_elastic_blacklist_cooldown_rejoin_subprocess(tmp_path):
+    """Acceptance (d), end to end: kill one worker → the driver blacklists
+    its host (threshold 1) → with every host blacklisted the job waits →
+    the cooldown re-admits the host → workers rejoin at a later generation
+    → training completes with committed state intact."""
+    import textwrap
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:2\n")
+    discovery = tmp_path / "discover.sh"
+    discovery.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    discovery.chmod(0o755)
+    train = tmp_path / "train_cooldown.py"
+    train.write_text(textwrap.dedent(ELASTIC_TRAIN).format(repo=REPO))
+
+    env = dict(os.environ, TOTAL_STEPS="25",
+               HOROVOD_CONTROLLER_TIMEOUT_SECONDS="10",
+               HOROVOD_FAILURES_TO_BLACKLIST="1",
+               HOROVOD_BLACKLIST_COOLDOWN_SECONDS="2",
+               HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS="0.1",
+               JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "--min-np", "2", "--max-np", "2",
+         "--host-discovery-script", str(discovery), "--verbose",
+         "--", sys.executable, str(train.resolve())],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    lines = []
+    deadline = time.monotonic() + 120
+    progressed = False
+    while time.monotonic() < deadline and proc.poll() is None:
+        line = proc.stdout.readline().decode(errors="replace")
+        lines.append(line)
+        if "step=2" in line:
+            progressed = True
+            break
+    assert progressed, "".join(lines)
+    killed = chaos.kill_workers("train_cooldown.py", count=1)
+    assert killed, "no worker found to kill"
+
+    try:
+        out, _ = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    text = "".join(lines) + out.decode(errors="replace")
+    assert proc.returncode == 0, text
+    assert "blacklisting localhost" in text, text
+    done = [line for line in text.splitlines() if "worker-done" in line]
+    assert done, text
+    # the job finished in a generation AFTER the one that was running when
+    # the host was blacklisted — i.e. the host re-joined post-cooldown
+    final_gens = [int(line.split("gen=")[1].split()[0]) for line in done]
+    assert all(g >= 1 for g in final_gens), text
+    # committed state survived: nobody restarted from step 0 post-rejoin
+    post = [int(line.split("step=")[1].split()[0])
+            for line in text.splitlines()
+            if "progress" in line and "gen=" in line and
+            int(line.split("gen=")[1].split()[0]) >= 1]
+    assert post and min(post) > 0, text

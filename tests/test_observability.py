@@ -88,10 +88,11 @@ def test_stall_report_empty_before_any_warning():
 # ABI guard
 
 
-def test_abi_version_is_5():
+def test_abi_version_is_6():
+    # 5 → 6: hvdtpu_abort + hvdtpu_set_fault_spec, CORRUPTED wait status
     lib = bindings.load_library()
-    assert bindings.ABI_VERSION == 5
-    assert lib.hvdtpu_abi_version() == 5
+    assert bindings.ABI_VERSION == 6
+    assert lib.hvdtpu_abi_version() == 6
 
 
 def test_stale_library_refused(monkeypatch):
