@@ -32,6 +32,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     cuda_built,
     ddl_built,
     engine_metrics,
+    flight_dump,
     gloo_built,
     gloo_enabled,
     init,

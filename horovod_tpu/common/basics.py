@@ -61,7 +61,8 @@ class _HorovodTpuContext:
                 return
             # Python logging honors the same HOROVOD_LOG_LEVEL /
             # HOROVOD_LOG_TIMESTAMP the C++ engine reads (logging.cc).
-            from horovod_tpu.common.hvd_logging import setup_python_logging
+            from horovod_tpu.common.hvd_logging import (
+                set_rank_context, setup_python_logging)
             setup_python_logging()
             from horovod_tpu.runner.elastic import worker as elastic_worker
             if elastic_worker.is_elastic_worker():
@@ -82,6 +83,10 @@ class _HorovodTpuContext:
             self.local_size = _env_int("HOROVOD_LOCAL_SIZE", 1)
             self.cross_rank = _env_int("HOROVOD_CROSS_RANK", self.rank)
             self.cross_size = _env_int("HOROVOD_CROSS_SIZE", self.size)
+            # From here on every hvd_logging record carries rank/local_rank
+            # so multi-rank logs interleave legibly (re-stamped below if a
+            # comm= subset re-ranks this process).
+            set_rank_context(self.rank, self.local_rank)
             self.elastic = os.environ.get("HOROVOD_ELASTIC", "0") == "1"
             # Process-subset communicator (reference: hvd.init(comm=[ranks]),
             # operations.cc:712-714 + mpi_context.cc:126-138 MPI_Group_incl):
@@ -134,6 +139,7 @@ class _HorovodTpuContext:
                     self.rank = 0
                     self.size = 1
                     self.cross_rank, self.cross_size = 0, 1
+                set_rank_context(self.rank, self.local_rank)
             try:
                 self.mesh = mesh_lib.build_mesh(mesh_spec, devices)
                 if start_engine is None:
@@ -407,6 +413,18 @@ def stall_report() -> Optional[dict]:
     EVERY rank — the coordinator broadcasts each new report."""
     _require_init()
     return _ctx.engine.stall_report() if _ctx.engine is not None else None
+
+
+def flight_dump(dir: Optional[str] = None) -> Optional[dict]:
+    """On-demand collective flight-recorder dump of this process's engine
+    session (``Session.flight_dump()``), or None when no engine is
+    running. When ``dir`` is given, also writes
+    ``<dir>/flight_rank<R>.json`` for the cross-rank post-mortem analyzer
+    (``python -m horovod_tpu.profiler.flight <dir>``). The engine dumps
+    automatically to ``HOROVOD_FLIGHT_DIR`` on abort, on a fresh stall
+    report, and on SIGUSR2."""
+    _require_init()
+    return _ctx.engine.flight_dump(dir) if _ctx.engine is not None else None
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False):

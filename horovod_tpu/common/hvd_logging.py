@@ -34,6 +34,31 @@ _LEVELS = {
     "fatal": logging.CRITICAL,
 }
 
+# rank/local_rank stamped by basics.init() (and re-stamped on comm= subset
+# re-ranking / elastic re-init); None before init — records then carry no
+# rank tag, so single-process logs stay unchanged.
+_rank_context = {"rank": None, "local_rank": None}
+
+
+def set_rank_context(rank: int, local_rank: int):
+    """Tag every subsequent ``horovod_tpu`` log record with this process's
+    rank/local_rank so multi-rank logs interleave legibly. Called by
+    ``init()``; safe to call again when the topology changes."""
+    _rank_context["rank"] = rank
+    _rank_context["local_rank"] = local_rank
+
+
+class _RankContextFilter(logging.Filter):
+    """Injects ``hvd_rank`` (the format-string fragment) plus raw
+    ``rank``/``local_rank`` attributes into every record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        r, lr = _rank_context["rank"], _rank_context["local_rank"]
+        record.rank = r
+        record.local_rank = lr
+        record.hvd_rank = f" rank={r} local={lr}" if r is not None else ""
+        return True
+
 
 def setup_python_logging(force: bool = False) -> logging.Logger:
     """Configure the ``horovod_tpu`` logger tree from the env. Idempotent;
@@ -44,12 +69,14 @@ def setup_python_logging(force: bool = False) -> logging.Logger:
     level = _LEVELS.get(os.environ.get("HOROVOD_LOG_LEVEL", "").lower(),
                         logging.WARNING)
     ts = os.environ.get("HOROVOD_LOG_TIMESTAMP", "0") not in ("", "0")
-    fmt = "[hvdtpu %(levelname)s %(name)s] %(message)s"
+    fmt = "[hvdtpu%(hvd_rank)s %(levelname)s %(name)s] %(message)s"
     if ts:
-        fmt = "[hvdtpu %(asctime)s %(levelname)s %(name)s] %(message)s"
+        fmt = ("[hvdtpu%(hvd_rank)s %(asctime)s %(levelname)s %(name)s] "
+               "%(message)s")
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(logging.Formatter(fmt,
                                            datefmt="%Y-%m-%d %H:%M:%S"))
+    handler.addFilter(_RankContextFilter())
     for h in list(logger.handlers):
         logger.removeHandler(h)
     logger.addHandler(handler)

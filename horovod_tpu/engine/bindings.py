@@ -52,7 +52,9 @@ _lib_lock = threading.Lock()
 # semantic ABI change so a stale prebuilt .so is rejected at load time.
 # 6: hvdtpu_abort + hvdtpu_set_fault_spec; hvdtpu_wait can return
 #    StatusType::CORRUPTED (6) -> HorovodCorruptedError.
-ABI_VERSION = 6
+# 7: hvdtpu_flight_dump + hvdtpu_bench_flight_record (collective flight
+#    recorder); Request wire format carries a signature hash.
+ABI_VERSION = 7
 
 
 def _lib_path() -> Path:
@@ -182,6 +184,13 @@ def load_library():
         lib.hvdtpu_last_stall_report.restype = ctypes.c_int64
         lib.hvdtpu_last_stall_report.argtypes = [
             ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        lib.hvdtpu_flight_dump.restype = ctypes.c_int64
+        lib.hvdtpu_flight_dump.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64]
+        lib.hvdtpu_bench_flight_record.restype = ctypes.c_double
+        lib.hvdtpu_bench_flight_record.argtypes = [ctypes.c_int64,
+                                                   ctypes.c_int32]
         lib.hvdtpu_abort.restype = ctypes.c_int32
         lib.hvdtpu_abort.argtypes = [ctypes.c_int64, ctypes.c_char_p]
         lib.hvdtpu_set_fault_spec.restype = ctypes.c_int32
@@ -200,6 +209,14 @@ def set_fault_spec(spec: str, seed: int = 0):
     rc = lib.hvdtpu_set_fault_spec((spec or "").encode(), seed)
     if rc != 0:
         raise ValueError(lib.hvdtpu_last_error().decode())
+
+
+def bench_flight_record(iters: int, enabled: bool = True) -> float:
+    """ns per flight-recorder Record() call (``enabled=False`` times the
+    disabled early-out — the pair is bench.py's recorder-overhead delta).
+    Session-free: runs on a standalone recorder instance."""
+    lib = load_library()
+    return float(lib.hvdtpu_bench_flight_record(iters, 1 if enabled else 0))
 
 
 def bench_combine(dtype_name: str, num_elements: int, iters: int,
@@ -360,6 +377,22 @@ class EngineSession:
         every rank can name the missing ranks (reference behavior analog:
         test_stall.py in the reference only sees rank-0 log text)."""
         return self._json_call(self._lib.hvdtpu_last_stall_report)
+
+    def flight_dump(self, dir: Optional[str] = None) -> Optional[dict]:
+        """On-demand flight-recorder dump: the black box of the last
+        HOROVOD_FLIGHT_RECORDER_SIZE collective events on this rank
+        ({"rank", "size", "trigger", "reason", "events": [...]}; see
+        engine/src/flight_recorder.h). When ``dir`` is given, also writes
+        ``<dir>/flight_rank<R>.json`` — the input of the cross-rank
+        analyzer (``python -m horovod_tpu.profiler.flight <dir>``). The
+        engine writes the same file automatically on abort, on a fresh
+        stall report, and on SIGUSR2 when HOROVOD_FLIGHT_DIR is set."""
+        d = (dir or "").encode()
+
+        def call(session, buf, size):
+            return self._lib.hvdtpu_flight_dump(session, d, buf, size)
+
+        return self._json_call(call)
 
     # -- data plane hookup --------------------------------------------------
 

@@ -44,7 +44,9 @@ extern "C" {
 // of silently changing behavior.
 // 6: hvdtpu_abort + hvdtpu_set_fault_spec; hvdtpu_wait can return
 //    StatusType::CORRUPTED (6) for CRC-detected wire corruption.
-int32_t hvdtpu_abi_version() { return 6; }
+// 7: hvdtpu_flight_dump + hvdtpu_bench_flight_record (collective flight
+//    recorder); Request wire format carries a signature hash.
+int32_t hvdtpu_abi_version() { return 7; }
 
 namespace {
 
@@ -82,6 +84,34 @@ int64_t hvdtpu_last_stall_report(int64_t session, char* buf, int64_t len) {
   Engine* e = GetSession(session);
   if (!e) return -1;
   return CopyJson(e->LastStallReport(), buf, len);
+}
+
+// Flight-recorder dump: the black-box JSON of the last
+// HOROVOD_FLIGHT_RECORDER_SIZE collective events on this rank (see
+// FlightRecorder::DumpJson for the schema). When `dir` is non-NULL and
+// non-empty, also writes <dir>/flight_rank<R>.json (the analyzer's
+// input) — only on a call whose caller buffer fits the payload, so the
+// Python buffer-retry dance writes the file exactly once and the file
+// always equals the returned JSON. Same buffer contract as the other
+// JSON calls (CopyJson).
+int64_t hvdtpu_flight_dump(int64_t session, const char* dir, char* buf,
+                           int64_t len) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  std::string json = e->flight_recorder().DumpJson(
+      e->rank(), e->size(), "api", "on-demand dump (hvdtpu_flight_dump)");
+  bool fits = buf == nullptr ||
+              len > static_cast<int64_t>(json.size());
+  if (dir != nullptr && *dir != '\0' && fits) {
+    FlightRecorder::WriteDumpFile(dir, e->rank(), json);
+  }
+  return CopyJson(json, buf, len);
+}
+
+// ns per FlightRecorder::Record call (bench.py's flight-recorder
+// overhead entry); enabled=0 times the disabled early-out.
+double hvdtpu_bench_flight_record(int64_t iters, int32_t enabled) {
+  return BenchFlightRecord(iters, enabled != 0);
 }
 
 // Host data-plane microbenchmark: payload bytes/s of the SUM combine

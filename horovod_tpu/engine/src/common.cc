@@ -95,6 +95,15 @@ uint32_t Crc32c(const void* data, size_t len) {
   return crc ^ 0xFFFFFFFFu;
 }
 
+uint64_t Fnv1a(const void* data, size_t len, uint64_t h) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  for (size_t i = 0; i < len; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
 std::string TensorShape::DebugString() const {
   std::ostringstream os;
   os << "[";

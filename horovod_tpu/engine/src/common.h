@@ -64,6 +64,12 @@ struct Status {
 // same framed transfers, where memcpy/combine dominates anyway.
 uint32_t Crc32c(const void* data, size_t len);
 
+// FNV-1a 64 over a byte range, chainable via the seed — the one hash
+// behind both the flight recorder's tensor-name hash and the desync
+// signature (message.cc), so the two can never silently diverge.
+uint64_t Fnv1a(const void* data, size_t len,
+               uint64_t h = 1469598103934665603ull);
+
 // Timed condition-variable wait — every timed wait in the engine goes
 // through here. Production builds use the plain steady-clock wait_for
 // (immune to wall-clock adjustments). The TSan build substitutes a

@@ -1,6 +1,7 @@
 #include "controller.h"
 
 #include <algorithm>
+#include <cstdio>
 #include <sstream>
 
 #include "data_plane.h"
@@ -154,6 +155,27 @@ bool Controller::IncrementTensorCount(const Request& msg, int joined_count) {
   }
   if (msg.reduce_op != tc.first.reduce_op && err.str().empty()) {
     err << "Mismatched reduction ops for tensor " << msg.tensor_name << ".";
+  }
+  // Desync detection: the signature hash covers the same field set as the
+  // checks above, so it both catches anything they'd catch and gives the
+  // operator a compact cross-rank identity to grep dumps for. The detailed
+  // message (when one fired) names the exact field; both signatures are
+  // always appended so the offending rank is identifiable even from a
+  // truncated log line.
+  if (msg.signature != tc.first.signature) {
+    char a[32], b[32];
+    std::snprintf(a, sizeof(a), "%016llx",
+                  static_cast<unsigned long long>(tc.first.signature));
+    std::snprintf(b, sizeof(b), "%016llx",
+                  static_cast<unsigned long long>(msg.signature));
+    if (err.str().empty()) {
+      err << "Mismatched collective signatures for tensor "
+          << msg.tensor_name << ": rank " << tc.first.request_rank
+          << " submitted a different (op, dtype, shape, reduce-op) than "
+          << "rank " << msg.request_rank << ".";
+    }
+    err << " (signatures: rank " << tc.first.request_rank << "=0x" << a
+        << ", rank " << msg.request_rank << "=0x" << b << ")";
   }
   if (!err.str().empty() && tc.validation_error.empty()) {
     tc.validation_error = err.str();

@@ -1,11 +1,42 @@
 #include "engine.h"
 
 #include <chrono>
+#include <csignal>
+#include <cstdlib>
+#include <mutex>
 #include <sstream>
 
 #include "fault_injector.h"
 
 namespace hvdtpu {
+
+namespace {
+
+// SIGUSR2 → on-demand flight dump. The handler only bumps an atomic (the
+// one async-signal-safe thing to do); every engine's background loop
+// notices the bump on its next cycle and writes the dump from a normal
+// thread. A rank wedged outside the cycle loop (blocked in a transport
+// recv) won't dump until it unblocks — the abort trigger covers that
+// path.
+std::atomic<int64_t> g_sigusr2_count{0};
+
+// Previous SIGUSR2 disposition, chained from our handler so hvd.init()
+// does not silently disable a handler the application installed first.
+void (*g_prev_usr2)(int) = nullptr;
+
+void SigUsr2Handler(int sig) {
+  g_sigusr2_count.fetch_add(1, std::memory_order_relaxed);
+  if (g_prev_usr2 != nullptr) g_prev_usr2(sig);
+}
+
+std::once_flag g_sigusr2_once;
+
+std::string FlightDirFromEnv() {
+  const char* v = std::getenv("HOROVOD_FLIGHT_DIR");
+  return v != nullptr ? std::string(v) : std::string();
+}
+
+}  // namespace
 
 // ---------------------------------------------------------------------------
 // HandleManager
@@ -96,6 +127,29 @@ Status Engine::Init() {
   // a malformed spec must refuse to start rather than silently not inject.
   auto fst = FaultInjector::Global().ConfigureFromEnv();
   if (!fst.ok()) return fst;
+  // Take over SIGUSR2 only when the dump trigger can actually fire
+  // (recorder on + HOROVOD_FLIGHT_DIR set) — otherwise the signal's
+  // default action and any application handler stay untouched.
+  if (flight_.enabled() && !FlightDirFromEnv().empty()) {
+    std::call_once(g_sigusr2_once, [] {
+      struct sigaction sa {};
+      struct sigaction prev {};
+      sa.sa_handler = SigUsr2Handler;
+      sigemptyset(&sa.sa_mask);
+      sa.sa_flags = SA_RESTART;
+      if (sigaction(SIGUSR2, &sa, &prev) != 0) return;
+      if (prev.sa_flags & SA_SIGINFO) {
+        // A 3-arg SA_SIGINFO handler can't be chained through a plain
+        // void(int) pointer — put the application's handler back and
+        // forgo this trigger (abort/stall/api dumps still fire).
+        sigaction(SIGUSR2, &prev, nullptr);
+      } else if (prev.sa_handler != SIG_DFL && prev.sa_handler != SIG_IGN &&
+                 prev.sa_handler != SigUsr2Handler) {
+        g_prev_usr2 = prev.sa_handler;
+      }
+    });
+  }
+  sigusr2_seen_ = g_sigusr2_count.load(std::memory_order_relaxed);
   std::shared_ptr<ControllerTransport> data_transport;
   if (tcfg_.kind == "loopback") {
     auto hub = GetOrCreateLoopbackHub(tcfg_.group, size_);
@@ -164,6 +218,17 @@ Status Engine::EnqueueTensor(TensorTableEntry entry, int64_t* handle) {
   msg.reduce_op = entry.reduce_op;
   msg.group_id = entry.group_id;
   msg.group_size = entry.group_size;
+  msg.signature = ComputeSignature(msg);
+  // Black-boxed BEFORE the message becomes visible in the queue, so the
+  // ring's event order matches the lifecycle (the cycle thread can only
+  // record NEGOTIATE after it can pop the message).
+  flight_.Record(FlightPhase::ENQUEUE, entry.name,
+                 FlightNameHash(entry.name),
+                 cycle_id_.load(std::memory_order_relaxed),
+                 static_cast<int32_t>(entry.op_type),
+                 static_cast<int32_t>(entry.dtype), entry.size_bytes(),
+                 /*status=*/0,
+                 /*aux=*/static_cast<int64_t>(msg.signature));
 
   // QUEUE phase: enqueue -> popped into a negotiation cycle (reference:
   // timeline.h:102-154 per-activity states). Started BEFORE the message
@@ -173,6 +238,16 @@ Status Engine::EnqueueTensor(TensorTableEntry entry, int64_t* handle) {
   auto st = queue_.AddToTensorQueue(entry, msg);
   if (!st.ok()) {
     timeline_.ActivityEnd(msg.tensor_name);
+    // Close the lifecycle: a synchronously rejected submit (duplicate
+    // name) never enters the coordination protocol, and a phantom
+    // ENQUEUE with no terminal phase would read as "still pending" in
+    // the post-mortem verdict. cycle=-1: this DONE is rank-local, not a
+    // response the analyzer may pair across ranks by cycle id.
+    flight_.Record(FlightPhase::DONE, entry.name,
+                   FlightNameHash(entry.name), /*cycle_id=*/-1,
+                   static_cast<int32_t>(entry.op_type),
+                   static_cast<int32_t>(entry.dtype), entry.size_bytes(),
+                   static_cast<int32_t>(st.type));
     handles_.MarkDone(*handle, st.reason);
     return st;
   }
@@ -325,20 +400,64 @@ void Engine::PerformOperation(const Response& response) {
   std::string err = response.error_message;
   StatusType err_code = StatusType::UNKNOWN_ERROR;
   int32_t rc = 0;
+  const int64_t cyc = cycle_id_.load(std::memory_order_relaxed);
+  // Per-tensor payload bytes from the response metadata, one pass over
+  // the flattened dims (ERROR responses carry no dtypes/shapes — bytes
+  // are 0 there). Precomputed: the flight records below look these up
+  // three times per tensor, and fused batches can be hundreds wide.
+  std::vector<int64_t> bytes_of(response.tensor_names.size(), 0);
+  {
+    size_t off = 0;
+    for (size_t i = 0; i < response.tensor_ndims.size() &&
+                       i < response.tensor_dtypes.size() &&
+                       i < bytes_of.size(); ++i) {
+      int64_t elems = 1;
+      for (int32_t d = 0; d < response.tensor_ndims[i]; ++d) {
+        elems *= response.tensor_dims_flat[off + d];
+      }
+      off += response.tensor_ndims[i];
+      bytes_of[i] = elems * DataTypeSize(
+          static_cast<DataType>(response.tensor_dtypes[i]));
+    }
+  }
+  auto tensor_bytes = [&bytes_of](size_t i) -> int64_t {
+    return i < bytes_of.size() ? bytes_of[i] : 0;
+  };
+  auto tensor_dtype = [&response](size_t i) -> int32_t {
+    return i < response.tensor_dtypes.size() ? response.tensor_dtypes[i] : -1;
+  };
   if (response.type == Response::Type::ERROR) {
     // close the NEGOTIATE spans of locally-enqueued tensors — an error
     // response must not leave dangling 'B' events on their lanes
     for (const auto& name : response.tensor_names) {
       if (queue_.HasEntry(name)) timeline_.ActivityEnd(name);
+      // Negotiation-level rejection — for a signature/metadata mismatch
+      // this is the desync verdict, black-boxed with the message's
+      // status so the analyzer can separate it from data-plane failure.
+      flight_.Record(FlightPhase::DESYNC, name, FlightNameHash(name), cyc,
+                     static_cast<int32_t>(response.type), -1, 0,
+                     static_cast<int32_t>(StatusType::INVALID_ARGUMENT));
     }
   } else {
-    for (const auto& name : response.tensor_names) {
+    for (size_t i = 0; i < response.tensor_names.size(); ++i) {
+      const auto& name = response.tensor_names[i];
       if (queue_.HasEntry(name)) {  // locally enqueued (not a joined rank)
         timeline_.ActivityEnd(name);  // close this rank's NEGOTIATE span
       }
       timeline_.ActivityStart(name,
                               std::string("EXEC_") +
                                   ResponseTypeName(response.type));
+      uint64_t h = FlightNameHash(name);
+      // FUSE: the tensor landed in this (possibly multi-tensor) response;
+      // aux carries the fused batch size. EXEC immediately follows — the
+      // data plane runs the whole response as one unit.
+      flight_.Record(FlightPhase::FUSE, name, h, cyc,
+                     static_cast<int32_t>(response.type), tensor_dtype(i),
+                     tensor_bytes(i), 0,
+                     static_cast<int64_t>(response.tensor_names.size()));
+      flight_.Record(FlightPhase::EXEC, name, h, cyc,
+                     static_cast<int32_t>(response.type), tensor_dtype(i),
+                     tensor_bytes(i));
     }
     if (execute_fn_ != nullptr) {
       std::string json = ResponseToJson(response);
@@ -373,12 +492,29 @@ void Engine::PerformOperation(const Response& response) {
       timeline_.ActivityEnd(name);
     }
   }
-  for (const auto& name : response.tensor_names) {
+  for (size_t i = 0; i < response.tensor_names.size(); ++i) {
+    const auto& name = response.tensor_names[i];
+    // ERROR responses already recorded their terminal DESYNC event above
+    // — a DONE on top would read as a phantom second collective to the
+    // analyzer's lifecycle reconstruction.
+    if (response.type != Response::Type::ERROR) {
+      flight_.Record(FlightPhase::DONE, name, FlightNameHash(name), cyc,
+                     static_cast<int32_t>(response.type), tensor_dtype(i),
+                     tensor_bytes(i),
+                     err.empty() ? 0 : static_cast<int32_t>(err_code));
+    }
     TensorTableEntry entry;
     auto st = queue_.GetTensorEntry(name, &entry);
     if (!st.ok()) continue;  // joined rank: no local entry
     handles_.MarkDone(entry.handle, err, err_code);
   }
+}
+
+void Engine::DumpFlightToEnvDir(const std::string& trigger,
+                                const std::string& reason) {
+  std::string dir = FlightDirFromEnv();
+  if (dir.empty()) return;
+  FlightDump(dir, trigger, reason);
 }
 
 void Engine::BackgroundLoop() {
@@ -387,6 +523,7 @@ void Engine::BackgroundLoop() {
   } catch (const std::exception& e) {
     std::fprintf(stderr, "[hvdtpu] FATAL background loop exception: %s\n",
                  e.what());
+    DumpFlightToEnvDir("crash", e.what());
     healthy_.store(false);
     stopped_.store(true);
     handles_.FailAll(std::string("engine crashed: ") + e.what());
@@ -409,10 +546,18 @@ void Engine::BackgroundLoopImpl() {
     queue_.PopMessagesFromQueue(&in.messages);
     metrics_.queue_depth.store(static_cast<int64_t>(queue_.size()),
                                std::memory_order_relaxed);
+    const int64_t cyc = cycle_id_.load(std::memory_order_relaxed);
     for (const auto& msg : in.messages) {
       // QUEUE -> NEGOTIATE: the request enters this cycle's negotiation
       timeline_.ActivityEnd(msg.tensor_name);
       timeline_.ActivityStart(msg.tensor_name, "NEGOTIATE");
+      flight_.Record(FlightPhase::NEGOTIATE, msg.tensor_name,
+                     FlightNameHash(msg.tensor_name), cyc,
+                     static_cast<int32_t>(msg.op_type),
+                     static_cast<int32_t>(msg.dtype),
+                     msg.shape.num_elements() * DataTypeSize(msg.dtype),
+                     /*status=*/0,
+                     /*aux=*/static_cast<int64_t>(msg.signature));
     }
     in.shutdown_requested = shutdown_requested_.load();
     in.join_requested = join_pending_.load();
@@ -433,12 +578,39 @@ void Engine::BackgroundLoopImpl() {
         // count even when a local Abort() raced this cycle
         metrics_.aborts_total.fetch_add(1, std::memory_order_relaxed);
       }
+      // Black box out before the handles fail: every abort comes with an
+      // explanation (the ISSUE-5 contract) — one dump per surviving rank
+      // under HOROVOD_FLIGHT_DIR, reason = the abort fan-out's verdict.
+      DumpFlightToEnvDir("abort", st.reason);
       handles_.FailAll("coordination failure: " + st.reason +
                        " (HorovodInternalError)");
       break;
     }
+    // CYCLE anchor: all ranks leave RunCycle's final collective exchange
+    // together, so non-idle cycles give the analyzer per-rank timestamps
+    // of the SAME logical instant — its clock-alignment sync points.
+    if (!in.messages.empty() || !out.responses.responses.empty()) {
+      flight_.Record(FlightPhase::CYCLE, "", 0, cyc, -1, -1, 0, 0,
+                     static_cast<int64_t>(out.responses.responses.size()));
+    }
     for (const auto& response : out.responses.responses) {
       PerformOperation(response);
+    }
+    cycle_id_.fetch_add(1, std::memory_order_relaxed);
+    // On-demand triggers, serviced from the cycle thread: SIGUSR2 (the
+    // handler only bumps a counter) and a fresh stall report (scanned on
+    // the coordinator, broadcast to every rank — each rank dumps its own
+    // view of the stall).
+    int64_t sig = g_sigusr2_count.load(std::memory_order_relaxed);
+    if (sig != sigusr2_seen_) {
+      sigusr2_seen_ = sig;
+      DumpFlightToEnvDir("sigusr2", "operator requested dump (SIGUSR2)");
+    }
+    int64_t sep = controller_->stall_inspector().report_epoch();
+    if (sep != stall_epoch_seen_) {
+      stall_epoch_seen_ = sep;
+      DumpFlightToEnvDir("stall",
+                         controller_->stall_inspector().last_report());
     }
     metrics_.cycles_total.fetch_add(1, std::memory_order_relaxed);
     metrics_.cycle_us.Observe(

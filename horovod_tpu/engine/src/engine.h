@@ -26,6 +26,7 @@
 #include "common.h"
 #include "controller.h"
 #include "data_plane.h"
+#include "flight_recorder.h"
 #include "message.h"
 #include "metrics.h"
 #include "tensor_queue.h"
@@ -112,6 +113,17 @@ class Engine {
   Timeline& timeline() { return timeline_; }
   Controller& controller() { return *controller_; }
   MetricsStore& metrics() { return metrics_; }
+  FlightRecorder& flight_recorder() { return flight_; }
+
+  // Flight-recorder dump: the JSON black box of the last
+  // HOROVOD_FLIGHT_RECORDER_SIZE collective events on this rank. Writes
+  // <dir>/flight_rank<R>.json when dir is non-empty (the engine's own
+  // triggers — abort, fresh stall report, SIGUSR2 — pass
+  // HOROVOD_FLIGHT_DIR). Safe from any thread.
+  std::string FlightDump(const std::string& dir, const std::string& trigger,
+                         const std::string& reason) {
+    return flight_.DumpToDir(dir, rank_, size_, trigger, reason);
+  }
 
   // JSON snapshot of all runtime counters/gauges/histograms (the payload
   // behind hvdtpu_metrics_snapshot). Safe from any thread.
@@ -133,6 +145,10 @@ class Engine {
   void BackgroundLoopImpl();
   void PerformOperation(const Response& response);
   std::string ResponseToJson(const Response& response);
+  // Dump to HOROVOD_FLIGHT_DIR (no-op when unset) — the automatic
+  // triggers all funnel through here.
+  void DumpFlightToEnvDir(const std::string& trigger,
+                          const std::string& reason);
 
   int rank_, size_, local_rank_, local_size_;
   EngineOptions opts_;
@@ -144,6 +160,12 @@ class Engine {
   HandleManager handles_;
   Timeline timeline_;
   MetricsStore metrics_;
+  FlightRecorder flight_{FlightRecorder::CapacityFromEnv()};
+  // Coordination-cycle id shared by all flight events of a cycle (written
+  // by the background thread, read by frontend enqueues).
+  std::atomic<int64_t> cycle_id_{0};
+  int64_t stall_epoch_seen_ = 0;   // background thread only
+  int64_t sigusr2_seen_ = 0;       // background thread only
 
   std::thread background_;
   std::atomic<bool> abort_requested_{false};

@@ -1,0 +1,203 @@
+#include "flight_recorder.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+
+#include "metrics.h"  // JsonEscape
+
+namespace hvdtpu {
+
+const char* FlightPhaseName(FlightPhase p) {
+  switch (p) {
+    case FlightPhase::ENQUEUE: return "ENQUEUE";
+    case FlightPhase::NEGOTIATE: return "NEGOTIATE";
+    case FlightPhase::FUSE: return "FUSE";
+    case FlightPhase::EXEC: return "EXEC";
+    case FlightPhase::DONE: return "DONE";
+    case FlightPhase::CYCLE: return "CYCLE";
+    case FlightPhase::DESYNC: return "DESYNC";
+  }
+  return "UNKNOWN";
+}
+
+uint64_t FlightNameHash(const std::string& name) {
+  return Fnv1a(name.data(), name.size());
+}
+
+FlightRecorder::FlightRecorder(int64_t capacity)
+    : slots_(capacity > 0 ? static_cast<size_t>(capacity) : 0),
+      start_(std::chrono::steady_clock::now()),
+      origin_unix_us_(std::chrono::duration_cast<std::chrono::microseconds>(
+                          std::chrono::system_clock::now().time_since_epoch())
+                          .count()) {}
+
+int64_t FlightRecorder::CapacityFromEnv() {
+  const char* v = std::getenv("HOROVOD_FLIGHT_RECORDER_SIZE");
+  if (v == nullptr || *v == '\0') return kDefaultCapacity;
+  return std::atoll(v);
+}
+
+int64_t FlightRecorder::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+void FlightRecorder::Record(FlightPhase phase, const std::string& name,
+                            uint64_t name_hash, int64_t cycle_id,
+                            int32_t op_type, int32_t dtype,
+                            int64_t payload_bytes, int32_t status,
+                            int64_t aux) {
+  if (slots_.empty()) return;
+  uint64_t idx = next_.fetch_add(1, std::memory_order_relaxed);
+  Slot& s = slots_[idx % slots_.size()];
+  constexpr auto rx = std::memory_order_relaxed;
+  // Seqlock write side: invalidate, release fence (orders the
+  // invalidation before the relaxed field stores), fields, then the
+  // release publish (orders the fields before the new sequence).
+  s.seq.store(0, rx);
+  std::atomic_thread_fence(std::memory_order_release);
+  s.ts_us.store(NowUs(), rx);
+  s.name_hash.store(name_hash, rx);
+  s.cycle_id.store(cycle_id, rx);
+  s.payload_bytes.store(payload_bytes, rx);
+  s.aux.store(aux, rx);
+  s.phase.store(static_cast<int32_t>(phase), rx);
+  s.op_type.store(op_type, rx);
+  s.dtype.store(dtype, rx);
+  s.status.store(status, rx);
+  char packed[kNameBytes] = {0};
+  size_t n = name.size() < kNameBytes - 1 ? name.size() : kNameBytes - 1;
+  std::memcpy(packed, name.data(), n);
+  for (size_t w = 0; w < kNameWords; ++w) {
+    uint64_t word;
+    std::memcpy(&word, packed + w * 8, 8);
+    s.name[w].store(word, rx);
+  }
+  s.seq.store(idx + 1, std::memory_order_release);
+}
+
+std::string FlightRecorder::DumpJson(int rank, int size,
+                                     const std::string& trigger,
+                                     const std::string& reason) const {
+  int64_t wall_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                        std::chrono::system_clock::now().time_since_epoch())
+                        .count();
+  std::ostringstream os;
+  os << "{\"rank\":" << rank << ",\"size\":" << size
+     << ",\"capacity\":" << capacity()
+     << ",\"recorded\":" << recorded()
+     << ",\"origin_unix_us\":" << origin_unix_us_
+     << ",\"dump_unix_us\":" << wall_us
+     << ",\"dump_ts_us\":" << NowUs()
+     << ",\"trigger\":\"" << JsonEscape(trigger) << "\""
+     << ",\"reason\":\"" << JsonEscape(reason) << "\""
+     << ",\"events\":[";
+  // Copy slots under the seqlock, then emit in event-index order.
+  struct Copy {
+    uint64_t idx;
+    int64_t ts_us, cycle_id, payload_bytes, aux;
+    uint64_t name_hash;
+    int32_t phase, op_type, dtype, status;
+    char name[kNameBytes];
+  };
+  std::vector<Copy> copies;
+  copies.reserve(slots_.size());
+  constexpr auto rx = std::memory_order_relaxed;
+  for (const Slot& slot : slots_) {
+    // Seqlock read side: acquire-load the sequence, relaxed-copy the
+    // fields, acquire fence (orders the copies before the re-check),
+    // then discard the slot if the sequence moved underneath us.
+    uint64_t seq = slot.seq.load(std::memory_order_acquire);
+    if (seq == 0) continue;
+    Copy c;
+    c.idx = seq - 1;
+    c.ts_us = slot.ts_us.load(rx);
+    c.name_hash = slot.name_hash.load(rx);
+    c.cycle_id = slot.cycle_id.load(rx);
+    c.payload_bytes = slot.payload_bytes.load(rx);
+    c.aux = slot.aux.load(rx);
+    c.phase = slot.phase.load(rx);
+    c.op_type = slot.op_type.load(rx);
+    c.dtype = slot.dtype.load(rx);
+    c.status = slot.status.load(rx);
+    for (size_t w = 0; w < kNameWords; ++w) {
+      uint64_t word = slot.name[w].load(rx);
+      std::memcpy(c.name + w * 8, &word, 8);
+    }
+    c.name[kNameBytes - 1] = '\0';
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (slot.seq.load(rx) != seq) continue;  // torn mid-copy
+    copies.push_back(c);
+  }
+  std::sort(copies.begin(), copies.end(),
+            [](const Copy& a, const Copy& b) { return a.idx < b.idx; });
+  char hexbuf[32];
+  for (size_t i = 0; i < copies.size(); ++i) {
+    const Copy& c = copies[i];
+    std::snprintf(hexbuf, sizeof(hexbuf), "%016llx",
+                  static_cast<unsigned long long>(c.name_hash));
+    if (i) os << ",";
+    os << "{\"i\":" << c.idx << ",\"ts_us\":" << c.ts_us << ",\"phase\":\""
+       << FlightPhaseName(static_cast<FlightPhase>(c.phase))
+       << "\",\"name\":\"" << JsonEscape(c.name) << "\",\"hash\":\""
+       << hexbuf << "\",\"cycle\":" << c.cycle_id
+       << ",\"op\":" << c.op_type << ",\"dtype\":" << c.dtype
+       << ",\"bytes\":" << c.payload_bytes << ",\"status\":" << c.status
+       << ",\"aux\":" << c.aux << "}";
+  }
+  os << "]}";
+  return os.str();
+}
+
+std::string FlightRecorder::DumpToDir(const std::string& dir, int rank,
+                                      int size, const std::string& trigger,
+                                      const std::string& reason) const {
+  std::string json = DumpJson(rank, size, trigger, reason);
+  if (!dir.empty()) WriteDumpFile(dir, rank, json);
+  return json;
+}
+
+void FlightRecorder::WriteDumpFile(const std::string& dir, int rank,
+                                   const std::string& json) {
+  std::string path = dir + "/flight_rank" + std::to_string(rank) + ".json";
+  // Unique tmp per writer: an on-demand dump (API thread) can race an
+  // abort/stall trigger (cycle thread) into the same file — a shared tmp
+  // would interleave their writes and rename torn JSON into place.
+  static std::atomic<uint64_t> tmp_counter{0};
+  std::string tmp = path + ".tmp" +
+                    std::to_string(tmp_counter.fetch_add(
+                        1, std::memory_order_relaxed));
+  // Write-then-rename so the analyzer never reads a half-written dump
+  // (the abort path dumps while the process is going down).
+  std::FILE* f = std::fopen(tmp.c_str(), "w");
+  if (f != nullptr) {
+    std::fwrite(json.data(), 1, json.size(), f);
+    std::fclose(f);
+    std::rename(tmp.c_str(), path.c_str());
+  } else {
+    std::fprintf(stderr,
+                 "[hvdtpu] WARNING: could not write flight dump %s\n",
+                 path.c_str());
+  }
+}
+
+double BenchFlightRecord(int64_t iters, bool enabled) {
+  FlightRecorder rec(enabled ? 4096 : 0);
+  const std::string name = "bench.flight.tensor";
+  uint64_t h = FlightNameHash(name);
+  auto t0 = std::chrono::steady_clock::now();
+  for (int64_t i = 0; i < iters; ++i) {
+    rec.Record(FlightPhase::ENQUEUE, name, h, i, 0, 7, 4096);
+  }
+  double ns = std::chrono::duration<double, std::nano>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+  return iters > 0 ? ns / static_cast<double>(iters) : 0.0;
+}
+
+}  // namespace hvdtpu

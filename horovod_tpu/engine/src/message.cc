@@ -71,6 +71,36 @@ class Reader {
 
 }  // namespace
 
+uint64_t ComputeSignature(const Request& req) {
+  // FNV-1a (the shared Fnv1a helper) over the metadata that must agree
+  // across ranks for this op (same rule set as
+  // Controller::IncrementTensorCount's field checks).
+  uint64_t h = Fnv1a(req.tensor_name.data(), req.tensor_name.size());
+  auto mix64 = [&h](int64_t v) { h = Fnv1a(&v, sizeof(v), h); };
+  mix64(static_cast<int64_t>(req.op_type));
+  mix64(static_cast<int64_t>(req.dtype));
+  mix64(req.reduce_op);
+  switch (req.op_type) {
+    case OpType::ALLREDUCE:
+      for (int64_t d : req.shape.dims) mix64(d);
+      break;
+    case OpType::BROADCAST:
+      for (int64_t d : req.shape.dims) mix64(d);
+      mix64(req.root_rank);
+      break;
+    case OpType::ALLGATHER:
+      // First dim is per-rank; rank count and trailing dims must agree.
+      mix64(static_cast<int64_t>(req.shape.dims.size()));
+      for (size_t d = 1; d < req.shape.dims.size(); ++d) {
+        mix64(req.shape.dims[d]);
+      }
+      break;
+    default:  // ALLTOALL/JOIN/BARRIER: no shape agreement required
+      break;
+  }
+  return h;
+}
+
 void Request::SerializeTo(std::string* out) const {
   Writer w(out);
   w.Pod<int32_t>(request_rank);
@@ -85,6 +115,7 @@ void Request::SerializeTo(std::string* out) const {
   w.Pod<int32_t>(reduce_op);
   w.Pod<int32_t>(group_id);
   w.Pod<int32_t>(group_size);
+  w.Pod<uint64_t>(signature);
 }
 
 Request Request::Deserialize(const char* data, size_t len, size_t* consumed) {
@@ -102,6 +133,7 @@ Request Request::Deserialize(const char* data, size_t len, size_t* consumed) {
   req.reduce_op = r.Pod<int32_t>();
   req.group_id = r.Pod<int32_t>();
   req.group_size = r.Pod<int32_t>();
+  req.signature = r.Pod<uint64_t>();
   if (consumed) *consumed = r.pos();
   return req;
 }

@@ -32,10 +32,23 @@ struct Request {
   int32_t reduce_op = 0;
   int32_t group_id = -1;
   int32_t group_size = 0;  // number of tensors in the group (grouped ops)
+  // Desync detection: a compact hash of the negotiation-relevant metadata
+  // (name, op, dtype, reduce op, and the shape components that must agree
+  // across ranks for this op). Computed at enqueue, carried through the
+  // coordination cycle; the coordinator compares signatures before the
+  // field-by-field checks so a rank submitting a mismatched collective is
+  // named immediately with both signatures instead of hanging or reducing
+  // garbage (flight-recorder DESYNC events carry the same hash).
+  uint64_t signature = 0;
 
   void SerializeTo(std::string* out) const;
   static Request Deserialize(const char* data, size_t len, size_t* consumed);
 };
+
+// The signature hash for a request. Excludes per-rank-variable shape
+// components (allgather/alltoall first dims legitimately differ across
+// ranks), mirroring the coordinator's field-by-field validation rules.
+uint64_t ComputeSignature(const Request& req);
 
 // A whole cycle's worth of requests from one rank, plus engine state bits
 // (reference: message.h RequestList with shutdown/joined flags).

@@ -93,6 +93,7 @@ bool StallInspector::CheckForStalledTensors(int32_t global_size) {
     std::lock_guard<std::mutex> lock(report_mu_);
     last_report_ = std::move(json);
     new_report_ = true;
+    report_epoch_.fetch_add(1, std::memory_order_relaxed);
   }
   return should_shut_down;
 }
@@ -107,6 +108,7 @@ std::string StallInspector::ConsumeNewReport() {
 void StallInspector::SetLastReport(const std::string& json) {
   std::lock_guard<std::mutex> lock(report_mu_);
   last_report_ = json;
+  report_epoch_.fetch_add(1, std::memory_order_relaxed);
 }
 
 std::string StallInspector::last_report() const {

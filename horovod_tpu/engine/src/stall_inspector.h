@@ -57,6 +57,12 @@ class StallInspector {
   void SetLastReport(const std::string& json);
   // Any rank, any thread: the last report observed ("" before the first).
   std::string last_report() const;
+  // Monotonic count of reports observed by this rank (scan fired here, or
+  // a broadcast report arrived). The engine compares it across cycles to
+  // trigger a flight-recorder dump exactly once per fresh report.
+  int64_t report_epoch() const {
+    return report_epoch_.load(std::memory_order_relaxed);
+  }
 
   void Clear();
 
@@ -79,6 +85,7 @@ class StallInspector {
   mutable std::mutex report_mu_;
   std::string last_report_;
   bool new_report_ = false;
+  std::atomic<int64_t> report_epoch_{0};
 };
 
 }  // namespace hvdtpu

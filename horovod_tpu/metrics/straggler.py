@@ -13,8 +13,12 @@ fleet (sigma → 0) doesn't flag micro-jitter, and a rank is only re-flagged
 after it recovers (one structured event per slow episode, not one per
 heartbeat).
 
-Pure logic, no I/O — unit-testable without processes; the driver owns the
-scraping and the structured-event logging.
+Pure logic, no blocking I/O — unit-testable without processes; the driver
+owns the scraping and the structured-event logging. When a ``registry``
+is supplied, every window also exports per-rank gauges —
+``hvd_straggler_score{rank=R}`` (peer-relative skew in sigmas,
+``(t - median) / sigma``) and ``hvd_straggler_flagged{rank=R}`` — so
+``/metrics`` serves the live scores, not just the logged/KV events.
 """
 
 from __future__ import annotations
@@ -25,22 +29,47 @@ from typing import Dict, List
 
 class StragglerDetector:
     def __init__(self, k: float = 3.0, windows: int = 3,
-                 min_rel_skew: float = 0.05):
+                 min_rel_skew: float = 0.05, registry=None):
         self.k = float(k)
         self.windows = int(windows)
         self.min_rel_skew = float(min_rel_skew)
+        self._registry = registry
         self._streak: Dict[int, int] = {}
         self._flagged: set = set()
+        self.last_scores: Dict[int, float] = {}
+
+    def _export(self, rank: int, score: float):
+        self.last_scores[rank] = score
+        if self._registry is None:
+            return
+        self._registry.gauge(
+            "hvd_straggler_score",
+            help="peer-relative step-time skew in sigmas, (t - median)/sigma",
+            rank=str(rank)).set(score)
+        self._registry.gauge(
+            "hvd_straggler_flagged",
+            help="1 while the rank is in a flagged straggler episode",
+            rank=str(rank)).set(1.0 if rank in self._flagged else 0.0)
 
     def update(self, step_times: Dict[int, float]) -> List[dict]:
         """Feed one window of per-rank mean step times; returns the
         structured straggler events that fired on this window."""
         events: List[dict] = []
-        # ranks that disappeared (scrape failure / rescale) lose their state
+        # ranks that disappeared (scrape failure / rescale) lose their
+        # state — including their exported gauges, or /metrics would keep
+        # reporting a departed rank as a flagged straggler forever
         for r in list(self._streak):
             if r not in step_times:
                 self._streak.pop(r, None)
                 self._flagged.discard(r)
+        for r in list(self.last_scores):
+            if r not in step_times:
+                self.last_scores.pop(r, None)
+                if self._registry is not None:
+                    self._registry.gauge("hvd_straggler_score",
+                                         rank=str(r)).set(0.0)
+                    self._registry.gauge("hvd_straggler_flagged",
+                                         rank=str(r)).set(0.0)
         if len(step_times) < 2:
             return events
         for r, t in step_times.items():
@@ -54,6 +83,7 @@ class StragglerDetector:
             else:
                 self._streak.pop(r, None)
                 self._flagged.discard(r)
+                self._export(r, (t - med) / sigma if sigma > 0 else 0.0)
                 continue
             if self._streak[r] >= self.windows and r not in self._flagged:
                 self._flagged.add(r)
@@ -66,6 +96,9 @@ class StragglerDetector:
                     "threshold_sec": threshold,
                     "consecutive_windows": self._streak[r],
                 })
+            # exported after the flag update so the flagged gauge flips in
+            # the same window as the event
+            self._export(r, (t - med) / sigma if sigma > 0 else 0.0)
         return events
 
     @property

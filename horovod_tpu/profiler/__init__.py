@@ -47,16 +47,19 @@ _SUBMODULE_EXPORTS = {
     "load_engine_timeline": "trace_merge",
     "find_jax_trace": "trace_merge",
     "merge_traces": "trace_merge",
+    # flight (post-mortem analyzer over flight-recorder dumps)
+    "load_dumps": "flight",
+    "analyze_flight_dumps": "flight",
 }
 
 __all__ = sorted(_SUBMODULE_EXPORTS) + [
-    "annotate", "flops", "mfu", "trace_merge",
+    "annotate", "flight", "flops", "mfu", "trace_merge",
 ]
 
 
 def __getattr__(name):
     import importlib
-    if name in ("annotate", "flops", "mfu", "trace_merge"):
+    if name in ("annotate", "flight", "flops", "mfu", "trace_merge"):
         return importlib.import_module(f"{__name__}.{name}")
     mod = _SUBMODULE_EXPORTS.get(name)
     if mod is None:

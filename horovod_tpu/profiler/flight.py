@@ -1,0 +1,422 @@
+"""Cross-rank post-mortem analyzer for collective flight-recorder dumps.
+
+The engine's flight recorder (engine/src/flight_recorder.{h,cc}) black-boxes
+the last ``HOROVOD_FLIGHT_RECORDER_SIZE`` per-collective events on every
+rank and dumps one JSON file per rank (``flight_rank<R>.json`` in
+``HOROVOD_FLIGHT_DIR``) on abort, on a fresh stall report, on SIGUSR2, and
+on demand (``hvd.flight_dump()``). This module is the other half of the
+contract — *every abort comes with an explanation*:
+
+- merge the per-rank dumps of one job,
+- align the per-rank steady clocks using the shared coordination-cycle
+  anchors as sync points (all ranks leave a cycle's final collective
+  exchange together, so a cycle's CYCLE event marks the same logical
+  instant on every rank),
+- emit one Perfetto-loadable trace via the existing ``trace_merge``
+  machinery (one process group per rank, one lane per tensor), and
+- print a verdict: which rank died, lagged, or never enqueued which
+  tensor, and whether a collective-signature mismatch (desync) occurred.
+
+CLI::
+
+    python -m horovod_tpu.profiler.flight <dir> [--trace out.json]
+
+(Also installed as the ``hvd-flight-analyze`` console script.)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Dict, List, Optional, Union
+
+from horovod_tpu.profiler import trace_merge
+
+# Phase names as emitted by FlightPhaseName (flight_recorder.cc).
+TERMINAL_PHASES = ("DONE", "DESYNC")
+
+# A rank is only called "lagging" when its last collective activity trails
+# the fleet by more than this — sub-second skew is normal pipelining, not
+# a verdict (and clock alignment is only anchor-accurate anyway).
+LAG_THRESHOLD_US = 1_000_000.0
+
+
+def load_dumps(path: Union[str, os.PathLike]) -> Dict[int, dict]:
+    """rank -> dump dict from a directory of ``flight_rank<R>.json`` files
+    (or a single dump file). Unreadable files are skipped — the analyzer
+    runs right after a crash, so partial evidence beats none."""
+    path = str(path)
+    files = [path] if os.path.isfile(path) else sorted(
+        glob.glob(os.path.join(path, "flight_rank*.json")))
+    dumps: Dict[int, dict] = {}
+    for f in files:
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                d = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        m = re.search(r"flight_rank(\d+)\.json$", f)
+        rank = d.get("rank", int(m.group(1)) if m else -1)
+        dumps[int(rank)] = d
+    return dumps
+
+
+class Collective:
+    """One reconstructed lifecycle of one tensor on one rank."""
+
+    __slots__ = ("rank", "name", "hash", "signature", "phases", "status",
+                 "op", "dtype", "bytes", "occurrence", "resp_cycle")
+
+    def __init__(self, rank: int, name: str):
+        self.rank = rank
+        self.name = name
+        self.hash = ""
+        self.signature: Optional[int] = None
+        self.phases: Dict[str, float] = {}  # phase -> ts_us (rank-local)
+        self.status = 0
+        self.op = -1
+        self.dtype = -1
+        self.bytes = 0
+        self.occurrence = 0
+        # Coordination cycle of the response-side phases (FUSE/EXEC/DONE/
+        # DESYNC). Cycles advance in lockstep on every rank (RunCycle is a
+        # blocking exchange), so (name, resp_cycle) identifies the same
+        # logical collective across ranks; -1 = never got a response.
+        self.resp_cycle = -1
+
+    @property
+    def done(self) -> bool:
+        return any(p in self.phases for p in TERMINAL_PHASES)
+
+    @property
+    def ok(self) -> bool:
+        return "DONE" in self.phases and self.status == 0
+
+    @property
+    def last_ts(self) -> float:
+        return max(self.phases.values()) if self.phases else 0.0
+
+
+def reconstruct(dump: dict) -> List[Collective]:
+    """Group one rank's event stream into per-collective lifecycles. A new
+    ENQUEUE for an already-open name starts a new occurrence (steps reuse
+    tensor names); ring wrap can leave the oldest collectives starting
+    mid-lifecycle, which is fine — they are already complete."""
+    rank = int(dump.get("rank", -1))
+    out: List[Collective] = []
+    # Stack of open occurrences per name: a synchronously rejected
+    # duplicate submit opens and closes while the original is still in
+    # flight — its terminal event must pop only the duplicate, leaving
+    # the original to receive its later phases.
+    open_by_name: Dict[str, List[Collective]] = {}
+    counts: Dict[str, int] = {}
+    for e in dump.get("events", []):
+        name = e.get("name", "")
+        phase = e.get("phase", "")
+        if phase == "CYCLE" or not name:
+            continue
+        stack = open_by_name.setdefault(name, [])
+        c = stack[-1] if stack else None
+        if c is None or (phase == "ENQUEUE" and c.phases):
+            c = Collective(rank, name)
+            c.occurrence = counts.get(name, 0)
+            counts[name] = c.occurrence + 1
+            stack.append(c)
+            out.append(c)
+        c.phases[phase] = float(e.get("ts_us", 0))
+        c.hash = e.get("hash", c.hash)
+        if e.get("op", -1) >= 0:
+            c.op = e["op"]
+        if e.get("dtype", -1) >= 0:
+            c.dtype = e["dtype"]
+        c.bytes = max(c.bytes, int(e.get("bytes", 0)))
+        if phase in ("ENQUEUE", "NEGOTIATE"):
+            # aux of these phases carries the desync-detection signature
+            c.signature = int(e.get("aux", 0)) & 0xFFFFFFFFFFFFFFFF
+        if phase in ("FUSE", "EXEC", "DONE", "DESYNC"):
+            cyc = int(e.get("cycle", -1))
+            if cyc >= 0:
+                c.resp_cycle = cyc
+        if phase in TERMINAL_PHASES:
+            c.status = int(e.get("status", 0)) or c.status
+            stack.pop()
+    return out
+
+
+def cycle_anchors(dump: dict) -> Dict[int, float]:
+    """cycle_id -> rank-local ts_us of that coordination cycle's anchor."""
+    anchors: Dict[int, float] = {}
+    for e in dump.get("events", []):
+        if e.get("phase") == "CYCLE":
+            anchors[int(e.get("cycle", -1))] = float(e.get("ts_us", 0))
+    return anchors
+
+
+def align_clocks(dumps: Dict[int, dict]) -> Dict[int, float]:
+    """Per-rank offset (us) mapping rank-local steady timestamps onto the
+    reference rank's axis: ``aligned = ts + offset[rank]``.
+
+    Baseline from each dump's wall-clock origin; refined with the shared
+    coordination-cycle anchors (median over common cycles — immune to a
+    few anchors recorded while one rank was wedged)."""
+    if not dumps:
+        return {}
+    ref = min(dumps)
+    ref_origin = float(dumps[ref].get("origin_unix_us", 0))
+    ref_anchor = cycle_anchors(dumps[ref])
+    offsets: Dict[int, float] = {}
+    for rank, d in dumps.items():
+        off = ref_origin and float(d.get("origin_unix_us", 0)) - ref_origin
+        anchors = cycle_anchors(d)
+        common = sorted(set(anchors) & set(ref_anchor))
+        if rank != ref and common:
+            off = statistics.median(ref_anchor[c] - anchors[c]
+                                    for c in common)
+        offsets[rank] = float(off or 0.0)
+    offsets[ref] = 0.0
+    return offsets
+
+
+def analyze(dumps: Dict[int, dict]) -> dict:
+    """The post-mortem verdict over one job's per-rank dumps."""
+    verdict: dict = {
+        "ranks_with_dumps": sorted(dumps),
+        "size": max((int(d.get("size", 0)) for d in dumps.values()),
+                    default=0),
+        "dead_ranks": [],
+        "in_flight": [],       # [{tensor, ranks_waiting, ranks_missing,...}]
+        "desync": [],          # signature mismatches / error responses
+        "lagging_rank": None,
+        "last_activity_us": {},
+        "triggers": {r: d.get("trigger", "") for r, d in dumps.items()},
+        "reasons": {r: d.get("reason", "") for r, d in dumps.items()},
+        "lines": [],
+    }
+    if not dumps:
+        verdict["lines"].append("no flight dumps found")
+        return verdict
+    size = verdict["size"] or (max(dumps) + 1)
+    verdict["dead_ranks"] = [r for r in range(size) if r not in dumps]
+
+    offsets = align_clocks(dumps)
+    verdict["clock_offsets_us"] = {r: round(o, 1)
+                                   for r, o in offsets.items()}
+    colls = {r: reconstruct(d) for r, d in dumps.items()}
+
+    # --- last aligned activity per rank → who lagged -----------------------
+    last: Dict[int, float] = {}
+    for r, cs in colls.items():
+        ts = [c.last_ts for c in cs if c.phases]
+        anchors = cycle_anchors(dumps[r])
+        if anchors:
+            ts.append(max(anchors.values()))
+        if ts:
+            last[r] = max(ts) + offsets[r]
+    verdict["last_activity_us"] = {r: round(t, 1) for r, t in last.items()}
+    if len(last) > 1:
+        lag_rank = min(last, key=last.get)
+        lag_behind = max(last.values()) - last[lag_rank]
+        if lag_behind > LAG_THRESHOLD_US:
+            verdict["lagging_rank"] = lag_rank
+            verdict["lag_behind_us"] = round(lag_behind, 1)
+
+    # --- in-flight / never-enqueued ----------------------------------------
+    # Pairing collectives across ranks: response-side phases carry the
+    # coordination cycle id, which advances in lockstep on every rank
+    # (RunCycle is a blocking exchange), so (name, resp_cycle) is the same
+    # logical collective everywhere — immune to each rank's ring wrapping
+    # at a different point. Collectives that never got a response (the
+    # trailing in-flight ones) pair by name alone: the engine holds at
+    # most one open occurrence of a name at a time.
+    by_key: Dict[tuple, Dict[int, Collective]] = {}
+    pending: Dict[str, Dict[int, Collective]] = {}
+    names_by_rank: Dict[int, set] = {r: set() for r in dumps}
+    for r, cs in colls.items():
+        for c in cs:
+            names_by_rank[r].add(c.name)
+            if c.resp_cycle >= 0:
+                by_key.setdefault((c.name, c.resp_cycle), {})[r] = c
+            else:
+                pending.setdefault(c.name, {})[r] = c
+
+    def _no_record(name):
+        # Ranks whose retained ring has no trace of this tensor at all —
+        # "never enqueued" as far as the evidence goes. A rank that merely
+        # completed a different occurrence is NOT listed.
+        return [r for r in sorted(dumps) if name not in names_by_rank[r]]
+
+    groups = [((name, cyc), per_rank, max(c.occurrence
+                                          for c in per_rank.values()))
+              for (name, cyc), per_rank in sorted(by_key.items())]
+    groups += [((name, None), per_rank, max(c.occurrence
+                                            for c in per_rank.values()))
+               for name, per_rank in sorted(pending.items())]
+    for (name, _cyc), per_rank, occ in groups:
+        waiting = sorted(r for r, c in per_rank.items() if not c.done)
+        failed = sorted(r for r, c in per_rank.items()
+                        if c.done and not c.ok and "DESYNC" not in c.phases)
+        if not waiting and not failed:
+            continue
+        never = _no_record(name) + verdict["dead_ranks"]
+        verdict["in_flight"].append({
+            "tensor": name,
+            "occurrence": occ,
+            "ranks_waiting": waiting,
+            "ranks_failed": failed,
+            "ranks_without_it": sorted(set(never)),
+        })
+
+    # --- desync -------------------------------------------------------------
+    seen_desync = set()
+    for (name, _cyc), per_rank, occ in groups:
+        sigs = {r: c.signature for r, c in per_rank.items()
+                if c.signature is not None}
+        if len(set(sigs.values())) > 1 and name not in seen_desync:
+            seen_desync.add(name)
+            verdict["desync"].append({
+                "tensor": name,
+                "occurrence": occ,
+                "signatures": {r: f"{s:016x}" for r, s in sorted(
+                    sigs.items())},
+            })
+        for r, c in sorted(per_rank.items()):
+            if "DESYNC" in c.phases and name not in seen_desync:
+                seen_desync.add(name)
+                verdict["desync"].append({
+                    "tensor": name,
+                    "occurrence": occ,
+                    "error_on_ranks": sorted(
+                        rr for rr, cc in per_rank.items()
+                        if "DESYNC" in cc.phases),
+                })
+
+    # --- human-readable verdict --------------------------------------------
+    lines = verdict["lines"]
+    if verdict["dead_ranks"]:
+        lines.append(
+            f"rank(s) {verdict['dead_ranks']} produced no dump — dead or "
+            f"unreachable ({len(dumps)}/{size} ranks reported)")
+    for t, reason in sorted(set(
+            (verdict["triggers"][r], verdict["reasons"][r])
+            for r in dumps)):
+        if t:
+            lines.append(f"dump trigger [{t}]: {reason[:200]}")
+    for item in verdict["in_flight"]:
+        state = []
+        if item["ranks_waiting"]:
+            state.append(f"still pending on rank(s) {item['ranks_waiting']}")
+        if item["ranks_failed"]:
+            state.append(f"failed on rank(s) {item['ranks_failed']}")
+        who = (f"; never enqueued / no record on rank(s) "
+               f"{item['ranks_without_it']}"
+               if item["ranks_without_it"] else "")
+        lines.append(
+            f"in flight at dump time: tensor '{item['tensor']}' "
+            f"(occurrence {item['occurrence']}) {' and '.join(state)}{who}")
+    for item in verdict["desync"]:
+        if "signatures" in item:
+            sig = ", ".join(f"rank {r}=0x{s}"
+                            for r, s in item["signatures"].items())
+            lines.append(
+                f"SIGNATURE MISMATCH on tensor '{item['tensor']}': {sig}")
+        else:
+            lines.append(
+                f"desync error response on tensor '{item['tensor']}' "
+                f"(ranks {item['error_on_ranks']})")
+    if verdict["lagging_rank"] is not None and not verdict["dead_ranks"]:
+        lines.append(
+            f"rank {verdict['lagging_rank']} lags the fleet by "
+            f"{verdict['lag_behind_us'] / 1e6:.3f}s of collective activity")
+    if not lines:
+        lines.append("no anomaly: all recorded collectives completed on "
+                     "all reporting ranks")
+    return verdict
+
+
+# package-level alias (horovod_tpu.profiler.analyze_flight_dumps)
+analyze_flight_dumps = analyze
+
+
+# ---------------------------------------------------------------------------
+# Perfetto trace emission (via the trace_merge machinery)
+
+# Span vocabulary mirroring the engine timeline's phase names.
+_SPANS = (("ENQUEUE", "NEGOTIATE", "QUEUE"),
+          ("NEGOTIATE", "FUSE", "NEGOTIATE"),
+          ("EXEC", "DONE", "EXEC"))
+
+
+def _rank_events(colls: List[Collective], offset_us: float) -> List[dict]:
+    """Chrome B/E spans per collective, one lane per tensor name."""
+    out: List[dict] = []
+    for c in colls:
+        for begin, end, label in _SPANS:
+            if begin in c.phases and end in c.phases:
+                out.append({"ph": "B", "tid": c.name, "name": label,
+                            "ts": c.phases[begin] + offset_us})
+                out.append({"ph": "E", "tid": c.name, "name": label,
+                            "ts": c.phases[end] + offset_us})
+        if not c.done and c.phases:
+            out.append({"ph": "i", "tid": c.name, "name": "IN_FLIGHT",
+                        "s": "t", "ts": c.last_ts + offset_us})
+        if "DESYNC" in c.phases:
+            out.append({"ph": "i", "tid": c.name, "name": "DESYNC",
+                        "s": "t", "ts": c.phases["DESYNC"] + offset_us})
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def to_perfetto(dumps: Dict[int, dict],
+                out_path: Optional[str] = None) -> dict:
+    """One Perfetto-loadable Chrome trace: one process group per rank
+    (clock-aligned), one thread lane per tensor — built with the
+    trace_merge lane machinery and written through its writer."""
+    offsets = align_clocks(dumps)
+    merged: List[dict] = []
+    for rank in sorted(dumps):
+        events = _rank_events(reconstruct(dumps[rank]), offsets[rank])
+        merged += trace_merge._rewrite_engine_events(
+            events, engine_pid=trace_merge.DEFAULT_ENGINE_PID + 1 + rank,
+            engine_label=f"hvd flight rank {rank}", offset_us=0.0)
+    return trace_merge.merge_traces([], jax_trace=merged, out_path=out_path)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    trace_out = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        # "" = flag present but value missing -> usage error below
+        trace_out = argv[i + 1] if i + 1 < len(argv) else ""
+        del argv[i:i + 2]
+    if len(argv) != 1 or trace_out == "":
+        print("usage: python -m horovod_tpu.profiler.flight <dump-dir> "
+              "[--trace out.json]", file=sys.stderr)
+        return 2
+    dumps = load_dumps(argv[0])
+    if not dumps:
+        print(f"no flight dumps under {argv[0]} (expected "
+              f"flight_rank<R>.json — set HOROVOD_FLIGHT_DIR or call "
+              f"hvd.flight_dump(dir))", file=sys.stderr)
+        return 1
+    verdict = analyze(dumps)
+    print(f"flight dumps: ranks {verdict['ranks_with_dumps']} of "
+          f"{verdict['size']}")
+    for line in verdict["lines"]:
+        print(f"  - {line}")
+    if trace_out:
+        to_perfetto(dumps, out_path=trace_out)
+        print(f"perfetto trace written to {trace_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

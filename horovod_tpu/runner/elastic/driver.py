@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.metrics import step_stats
+from horovod_tpu.metrics.registry import get_registry
 from horovod_tpu.metrics.straggler import StragglerDetector
 
 from horovod_tpu.runner import hosts as hosts_lib
@@ -101,13 +102,37 @@ class ElasticDriver:
         self._go_deadline: float = 0.0
         self._go_published: set = set()
         self._logger = get_logger("elastic.driver")
-        # straggler detection over scraped worker step times
+        # straggler detection over scraped worker step times; per-rank
+        # scores land in the driver's registry as hvd_straggler_score /
+        # hvd_straggler_flagged gauges
         self._straggler = StragglerDetector(
             k=float(os.environ.get("HOROVOD_STRAGGLER_STDDEVS", "3.0")),
-            windows=int(os.environ.get("HOROVOD_STRAGGLER_WINDOWS", "3")))
+            windows=int(os.environ.get("HOROVOD_STRAGGLER_WINDOWS", "3")),
+            registry=get_registry())
+        # Driver-side /metrics endpoint serving those gauges.
+        # HOROVOD_DRIVER_METRICS_PORT (not the worker port family: the
+        # workers already occupy HOROVOD_METRICS_PORT + local_rank on this
+        # host); "0" binds ephemeral. Off by default.
+        self._metrics_exporter = None
+        dport = os.environ.get("HOROVOD_DRIVER_METRICS_PORT", "")
+        if dport != "":
+            try:
+                from horovod_tpu.metrics import MetricsExporter
+                self._metrics_exporter = MetricsExporter(
+                    get_registry(), port=int(dport),
+                    labels={"job": os.environ.get("HOROVOD_JOB_NAME",
+                                                  "default"),
+                            "role": "elastic-driver"}).start()
+                self._logger.info("driver metrics endpoint on :%d/metrics",
+                                  self._metrics_exporter.port)
+            except (OSError, ValueError) as e:
+                self._logger.warning(
+                    "driver metrics exporter disabled: %s", e)
         # (host, slot) -> last (step_count, step_seconds_sum) observed
         self._metrics_prev: Dict[Tuple[str, int], Tuple[int, float]] = {}
         self.straggler_events: List[dict] = []
+        # analyzer verdicts collected after worker failures (flight dumps)
+        self.flight_verdicts: List[dict] = []
         self._lock = threading.Lock()
         self._rebalance_needed = threading.Event()
         self._shutdown = threading.Event()
@@ -141,6 +166,9 @@ class ElasticDriver:
             self._shutdown.set()
             poller.join(timeout=5)
             barrier.join(timeout=5)
+            if self._metrics_exporter is not None:
+                self._metrics_exporter.stop()
+                self._metrics_exporter = None
             for w in self._workers.values():
                 w.terminate()
             if on_complete is not None:
@@ -319,6 +347,7 @@ class ElasticDriver:
                     s.hostname, s.rank, self._command, env)
 
     def _reap_workers(self):
+        failed = []
         with self._lock:
             for key, w in list(self._workers.items()):
                 code = w.poll()
@@ -339,6 +368,7 @@ class ElasticDriver:
                     continue
                 self._log(f"worker {key} failed with code {code}")
                 del self._workers[key]
+                failed.append((key, code))
                 self._host_failures[host] = \
                     self._host_failures.get(host, 0) + 1
                 if self._host_failures[host] >= self._failures_to_blacklist:
@@ -350,6 +380,69 @@ class ElasticDriver:
                 # fresh generation); replaces the prior hack of clearing the
                 # discovery view, which raced with the discovery thread
                 self._rebalance_needed.set()
+        # Dump collection polls the filesystem for up to 1.5s — done once
+        # for the whole reap pass (several workers dying together are one
+        # incident) and outside the lock so the go-barrier, rebalance, and
+        # metrics threads aren't frozen while post-mortems are gathered.
+        if failed:
+            self._collect_flight_dumps(failed)
+
+    def _collect_flight_dumps(self, failed):
+        """Post-mortem hook: when workers die (``failed`` = this reap
+        pass's [(key, exit_code), ...]) and the job runs with
+        ``HOROVOD_FLIGHT_DIR``, every surviving rank's engine writes a
+        flight dump during the fast abort that follows. Collect them and
+        log the cross-rank analyzer's verdict (which rank died, which
+        tensor was in flight) next to the failure itself, so the operator
+        never has to reconstruct the last seconds by hand."""
+        flight_dir = (self._extra_env.get("HOROVOD_FLIGHT_DIR") or
+                      os.environ.get("HOROVOD_FLIGHT_DIR"))
+        if not flight_dir:
+            return
+        try:
+            from horovod_tpu.profiler import flight
+            # Survivors dump within one coordination cycle of the death,
+            # and the driver notices the exit on its ~1s heartbeat — so
+            # this incident's dumps are at most a few seconds old. Dumps
+            # older than that window are leftovers of an earlier trigger
+            # (files are overwritten in place, never cleaned); analyzing
+            # them would describe the wrong incident. Wait briefly for
+            # fresh files to land (write-then-rename keeps them whole).
+            freshness_us = 30e6
+            deadline = time.monotonic() + 1.5
+            dumps = {}
+            while time.monotonic() < deadline:
+                dumps = {
+                    r: d
+                    for r, d in flight.load_dumps(flight_dir).items()
+                    if time.time() * 1e6 - d.get("dump_unix_us", 0)
+                    < freshness_us}
+                if dumps:
+                    # don't analyze a partial set: a survivor whose dump
+                    # hasn't landed yet would be reported dead. Dying
+                    # ranks often dump too (the abort path runs before
+                    # exit), so a count net of the dead can be satisfied
+                    # while a slow survivor is still writing — only a
+                    # dump from EVERY rank ends the wait early; anything
+                    # less polls to the deadline.
+                    expect = max(int(d.get("size", 0))
+                                 for d in dumps.values())
+                    if len(dumps) >= max(expect, 1):
+                        break
+                time.sleep(0.1)
+            if not dumps:
+                self._log(f"worker(s) {sorted(k for k, _ in failed)} failed "
+                          f"(codes {[c for _, c in failed]}) but no fresh "
+                          f"flight dumps appeared in {flight_dir}")
+                return
+            verdict = flight.analyze(dumps)
+            self.flight_verdicts.append(verdict)
+            for line in verdict["lines"]:
+                self._logger.warning("flight analyzer: %s", line)
+                self._log(f"flight analyzer: {line}")
+        except Exception as e:  # noqa: BLE001 — post-mortem analysis must
+            self._log(f"flight-dump collection failed: {e!r}")  # not kill
+            # the driver
 
     # -- cluster health (metrics scrape + straggler detection) --------------
 

@@ -1,6 +1,7 @@
 """Observability satellites: machine-readable stall reports on every rank
-(fault-injection: one rank withholds a tensor), the ABI-5 guard, the
-unified HOROVOD_LOG_LEVEL knob for the Python layers, and the
+(fault-injection: one rank withholds a tensor), the ABI guard, the
+unified HOROVOD_LOG_LEVEL knob for the Python layers (incl. per-rank log
+tagging), per-rank straggler-score gauges on /metrics, and the
 MetricAverageCallback cross-rank mean (2-rank subprocess run)."""
 
 import importlib.util
@@ -88,11 +89,12 @@ def test_stall_report_empty_before_any_warning():
 # ABI guard
 
 
-def test_abi_version_is_6():
-    # 5 → 6: hvdtpu_abort + hvdtpu_set_fault_spec, CORRUPTED wait status
+def test_abi_version_is_7():
+    # 6 → 7: hvdtpu_flight_dump + hvdtpu_bench_flight_record (flight
+    # recorder), Request wire format carries a signature hash
     lib = bindings.load_library()
-    assert bindings.ABI_VERSION == 6
-    assert lib.hvdtpu_abi_version() == 6
+    assert bindings.ABI_VERSION == 7
+    assert lib.hvdtpu_abi_version() == 7
 
 
 def test_stale_library_refused(monkeypatch):
@@ -129,6 +131,95 @@ def test_python_logging_honors_horovod_log_level(monkeypatch):
     assert "%(asctime)s" in logger.handlers[0].formatter._fmt
     monkeypatch.setenv("HOROVOD_LOG_TIMESTAMP", "0")
     hvd_logging.setup_python_logging(force=True)
+
+
+def test_log_records_carry_rank_after_init(monkeypatch, capsys):
+    """Satellite: once init() has stamped the rank context, every record
+    emitted through common/hvd_logging carries rank/local_rank so
+    multi-rank logs interleave legibly; before that, nothing changes."""
+    import logging
+
+    from horovod_tpu.common import hvd_logging
+
+    monkeypatch.setattr(hvd_logging, "_rank_context",
+                        {"rank": None, "local_rank": None})
+    logger = hvd_logging.setup_python_logging(force=True)
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(self.format(record))
+
+    cap = Capture()
+    cap.setFormatter(logger.handlers[0].formatter)
+    cap.addFilter(hvd_logging._RankContextFilter())
+    logger.addHandler(cap)
+    try:
+        log = hvd_logging.get_logger("test")
+        log.warning("before-init line")
+        assert "rank=" not in records[-1]
+        assert records[-1].startswith("[hvdtpu ")
+        # what basics.init() does after resolving the topology
+        hvd_logging.set_rank_context(3, 1)
+        log.warning("after-init line")
+        assert "rank=3 local=1" in records[-1], records[-1]
+    finally:
+        logger.removeHandler(cap)
+        hvd_logging.setup_python_logging(force=True)
+
+
+# ---------------------------------------------------------------------------
+# per-rank straggler scores as /metrics gauges
+
+
+def test_straggler_scores_exported_as_gauges():
+    """Satellite: the StragglerDetector's per-rank scores are live gauges
+    on /metrics(.json), not just logged events — scraped here through a
+    real exporter on an ephemeral port."""
+    import json as json_mod
+    import urllib.request
+
+    from horovod_tpu.metrics import MetricsExporter, MetricsRegistry
+    from horovod_tpu.metrics.straggler import StragglerDetector
+
+    reg = MetricsRegistry()
+    det = StragglerDetector(k=2.0, windows=2, registry=reg)
+    # rank 2 is 3x slower than its peers for two consecutive windows
+    events = []
+    for _ in range(2):
+        events += det.update({0: 1.0, 1: 1.01, 2: 3.0, 3: 0.99})
+    assert [e["rank"] for e in events] == [2]
+
+    exporter = MetricsExporter(reg, port=0).start()
+    try:
+        snap = json_mod.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics.json",
+            timeout=5).read().decode())
+        fams = {m["name"]: m for m in snap["metrics"]}
+        assert "hvd_straggler_score" in fams
+        scores = {s["labels"]["rank"]: s["value"]
+                  for s in fams["hvd_straggler_score"]["samples"]}
+        assert set(scores) == {"0", "1", "2", "3"}
+        assert scores["2"] > 2.0  # far beyond the k=2 threshold
+        assert all(abs(scores[r]) < 2.0 for r in ("0", "1", "3"))
+        flagged = {s["labels"]["rank"]: s["value"]
+                   for s in fams["hvd_straggler_flagged"]["samples"]}
+        assert flagged["2"] == 1.0
+        assert flagged["0"] == 0.0
+        # the text endpoint renders the same family for Prometheus
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics",
+            timeout=5).read().decode()
+        assert 'hvd_straggler_score{rank="2"}' in text
+    finally:
+        exporter.stop()
+    # recovery clears the flag gauge on the next window
+    det.update({0: 1.0, 1: 1.01, 2: 1.0, 3: 0.99})
+    assert reg.gauge("hvd_straggler_flagged", rank="2").value == 0.0
+    # a departed rank's gauges are zeroed, not served stale forever
+    det.update({0: 1.0, 1: 1.01, 3: 5.0})
+    assert reg.gauge("hvd_straggler_score", rank="2").value == 0.0
+    assert reg.gauge("hvd_straggler_flagged", rank="2").value == 0.0
 
 
 # ---------------------------------------------------------------------------
