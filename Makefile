@@ -1,0 +1,47 @@
+# Repo-level convenience targets. The engine's own build lives in
+# horovod_tpu/engine/Makefile; this file is the front door the docs and
+# the verify flow reference.
+
+PYTHON ?= python
+
+.PHONY: all lint lock-graph engine tsan asan ubsan sanitizers test test-fast clean
+
+all: engine
+
+# Static collective-safety & engine-concurrency analysis (hvd-lint).
+# Zero findings is a tier-1 gate (tests/test_lint.py runs the same scan).
+lint:
+	$(PYTHON) -m horovod_tpu.lint
+
+# The static lock-order graph as graphviz dot (also written by every full
+# `make lint` run).
+lock-graph:
+	$(PYTHON) -m horovod_tpu.lint --rules HVL102 \
+	    --lock-graph horovod_tpu/engine/build/lock_order.dot
+
+engine:
+	$(MAKE) -C horovod_tpu/engine
+
+# Sanitizer matrix over the pure-C++ engine harness (tsan_harness.cc):
+# data races (tsan), heap errors + leaks (asan), undefined behavior
+# (ubsan). Each builds into its own build-<san>/ directory.
+tsan:
+	$(MAKE) -C horovod_tpu/engine tsan
+
+asan:
+	$(MAKE) -C horovod_tpu/engine asan
+
+ubsan:
+	$(MAKE) -C horovod_tpu/engine ubsan
+
+sanitizers: tsan asan ubsan
+
+# Tier-1 fast shard (the driver's gate) and the full suite.
+test-fast:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q
+
+clean:
+	$(MAKE) -C horovod_tpu/engine clean

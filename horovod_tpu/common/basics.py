@@ -17,19 +17,15 @@ but TPU-native underneath:
 
 from __future__ import annotations
 
-import os
 import subprocess
 import threading
 from typing import Optional, Sequence
 
 import jax
 
+from horovod_tpu.common.env_registry import (env_bool, env_int, env_is_set,
+                                             env_str)
 from horovod_tpu.parallel import mesh as mesh_lib
-
-
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    return int(v) if v not in (None, "") else default
 
 
 class _HorovodTpuContext:
@@ -76,18 +72,18 @@ class _HorovodTpuContext:
             # multi-host job launched outside hvdrun-tpu would read size=1
             # and every "single-process" fallback would silently diverge.
             jaxd = jax.process_count() if jax.process_count() > 1 else 1
-            self.rank = _env_int("HOROVOD_RANK",
-                                 jax.process_index() if jaxd > 1 else 0)
-            self.size = _env_int("HOROVOD_SIZE", jaxd)
-            self.local_rank = _env_int("HOROVOD_LOCAL_RANK", 0)
-            self.local_size = _env_int("HOROVOD_LOCAL_SIZE", 1)
-            self.cross_rank = _env_int("HOROVOD_CROSS_RANK", self.rank)
-            self.cross_size = _env_int("HOROVOD_CROSS_SIZE", self.size)
+            self.rank = env_int("HOROVOD_RANK",
+                                jax.process_index() if jaxd > 1 else 0)
+            self.size = env_int("HOROVOD_SIZE", jaxd)
+            self.local_rank = env_int("HOROVOD_LOCAL_RANK")
+            self.local_size = env_int("HOROVOD_LOCAL_SIZE")
+            self.cross_rank = env_int("HOROVOD_CROSS_RANK", self.rank)
+            self.cross_size = env_int("HOROVOD_CROSS_SIZE", self.size)
             # From here on every hvd_logging record carries rank/local_rank
             # so multi-rank logs interleave legibly (re-stamped below if a
             # comm= subset re-ranks this process).
             set_rank_context(self.rank, self.local_rank)
-            self.elastic = os.environ.get("HOROVOD_ELASTIC", "0") == "1"
+            self.elastic = env_bool("HOROVOD_ELASTIC")
             # Process-subset communicator (reference: hvd.init(comm=[ranks]),
             # operations.cc:712-714 + mpi_context.cc:126-138 MPI_Group_incl):
             # members re-rank into the subset; non-members become size-1
@@ -116,7 +112,7 @@ class _HorovodTpuContext:
                         # init round (all members init in lockstep, so
                         # their round counters agree), though not reserved
                         # against other services
-                        base = _env_int("HOROVOD_CONTROLLER_PORT", 0)
+                        base = env_int("HOROVOD_CONTROLLER_PORT")
                         if base:
                             off = base + 2 * (1 + members[0] +
                                               world * (_subset_round - 1))
@@ -154,8 +150,8 @@ class _HorovodTpuContext:
                     # controller rendezvous in the env) gets that default,
                     # and eager ops raise loudly rather than degrade.
                     start_engine = self.size > 1 and (
-                        "HOROVOD_SIZE" in os.environ or
-                        "HOROVOD_CONTROLLER_PORT" in os.environ)
+                        env_is_set("HOROVOD_SIZE") or
+                        env_is_set("HOROVOD_CONTROLLER_PORT"))
                 if start_engine:
                     from horovod_tpu.common.exceptions import \
                         HorovodInternalError
@@ -227,12 +223,12 @@ def _negotiate_subset_ports(members, is_leader: bool):
     and publishes them; other members poll. Returns (port, data_port) or
     None when no rendezvous KV is in the env."""
     import time
-    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
-    port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+    addr = env_str("HOROVOD_RENDEZVOUS_ADDR")
+    port = env_int("HOROVOD_RENDEZVOUS_PORT")
     if not addr or not port:
         return None
     from horovod_tpu.runner.http_kv import KVClient
-    client = KVClient(addr, int(port))
+    client = KVClient(addr, port)
     # per-init round counter (incremented by the caller; all members call
     # init in lockstep), so a second init(comm=...) in the same processes
     # can't read the previous round's — now closed — ports

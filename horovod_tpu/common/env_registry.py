@@ -1,0 +1,271 @@
+"""Typed registry of every ``HOROVOD_*`` environment variable.
+
+Single source of truth for the launcher/env contract: each variable is
+declared once with its type, default, and one-line doc. Three consumers:
+
+- **typed accessors** (`env_str`/`env_int`/`env_float`/`env_bool`/
+  `env_is_set`) — the only sanctioned way Python code reads a
+  ``HOROVOD_*`` variable. Reading an undeclared name raises at import
+  time of the caller, so a typo'd read cannot silently become a default.
+  `hvd-lint` rule HVL004 flags direct ``os.environ`` reads.
+- **docs table** — ``docs/DESIGN.md``'s env reference is generated from
+  this module (`render_env_table`); lint rule HVL006 fails when the two
+  drift.
+- **typo detection** — lint rule HVL005 edit-distances every
+  ``HOROVOD_*`` string literal in the tree against these names.
+
+Engine-side (C++) variables are declared here too, marked
+``scope="cpp"``, so the docs table and the typo check cover the whole
+contract even though the readers live in ``engine/src``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+_UNSET = object()
+
+# Strings env_bool treats as False; anything else non-empty is True
+# ("non-0" semantics — matches both the C++ engine's flag parsing and the
+# historical `== "1"` call sites).
+_FALSY = ("", "0", "false", "no", "off")
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    type: str      # "str" | "int" | "float" | "bool"
+    default: object  # typed default; None = no default (unset-able)
+    doc: str       # one line, rendered into docs/DESIGN.md
+    scope: str     # "py" | "cpp" | "both" — where the readers live
+
+
+REGISTRY: Dict[str, EnvVar] = {}
+
+
+def _decl(name: str, type: str, default, doc: str, scope: str = "py"):
+    assert name.startswith("HOROVOD_") and name not in REGISTRY, name
+    REGISTRY[name] = EnvVar(name, type, default, doc, scope)
+
+
+# -- topology / launcher contract (exported by the launcher, read at init) --
+_decl("HOROVOD_RANK", "int", 0, "global process rank (launcher contract)")
+_decl("HOROVOD_SIZE", "int", 1, "number of processes in the job")
+_decl("HOROVOD_LOCAL_RANK", "int", 0, "rank within this host")
+_decl("HOROVOD_LOCAL_SIZE", "int", 1, "processes on this host")
+_decl("HOROVOD_CROSS_RANK", "int", 0, "host index of this process")
+_decl("HOROVOD_CROSS_SIZE", "int", 1, "number of hosts")
+_decl("HOROVOD_HOSTNAME", "str", "localhost",
+      "this worker's hostname as the launcher addresses it")
+_decl("HOROVOD_CLUSTER_JOB", "str", None,
+      "cluster-scheduler job id scoping dynamic endpoint negotiation")
+_decl("HOROVOD_CLUSTER_ROUND", "str", "0",
+      "per-run scope for dynamic endpoint negotiation (actor pools)")
+
+# -- controller / rendezvous endpoints --
+_decl("HOROVOD_CONTROLLER_ADDR", "str", "127.0.0.1",
+      "host of rank 0's coordination engine", "both")
+_decl("HOROVOD_CONTROLLER_PORT", "int", 0,
+      "control-channel TCP port of the coordinator", "both")
+_decl("HOROVOD_CONTROLLER_DATA_PORT", "int", 0,
+      "eager data channel port (<=0 means control port + 1)", "both")
+_decl("HOROVOD_CONTROLLER_TIMEOUT_SECONDS", "float", 30.0,
+      "connect/accept deadline for the engine's TCP links", "both")
+_decl("HOROVOD_GLOO_TIMEOUT_SECONDS", "float", 30.0,
+      "reference-compat alias accepted for the controller timeout")
+_decl("HOROVOD_RENDEZVOUS_ADDR", "str", None,
+      "launcher's HTTP KV server address (rendezvous)")
+_decl("HOROVOD_RENDEZVOUS_PORT", "int", 0,
+      "launcher's HTTP KV server port")
+
+# -- engine tuning knobs (EngineOptions, common.h) --
+_decl("HOROVOD_CYCLE_TIME", "float", 1.0,
+      "background-loop coordination cycle time in ms", "both")
+_decl("HOROVOD_FUSION_THRESHOLD", "int", 64 << 20,
+      "fusion buffer size in bytes (tensor batching)", "both")
+_decl("HOROVOD_CACHE_CAPACITY", "int", 1024,
+      "response-cache capacity in entries (0 disables)", "both")
+_decl("HOROVOD_STALL_CHECK_TIME_SECONDS", "float", 60.0,
+      "stall-inspector warning threshold", "both")
+_decl("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "float", 0.0,
+      "stall-inspector abort deadline (0 = never abort)", "both")
+_decl("HOROVOD_STALL_CHECK_DISABLE", "bool", False,
+      "disable the stall-inspector scan", "both")
+_decl("HOROVOD_ENGINE_LIB", "str", None,
+      "path override for libhvdtpu_core.so (skips the build probe)")
+_decl("HOROVOD_HIERARCHICAL_ALLREDUCE", "bool", False,
+      "two-level gradient reduction (reduce-scatter over fast axes, "
+      "cross-slice allreduce, all-gather back)")
+
+# -- autotuner --
+_decl("HOROVOD_AUTOTUNE", "bool", False,
+      "online Bayesian tuning of cycle time / fusion threshold / cache",
+      "both")
+_decl("HOROVOD_AUTOTUNE_LOG", "str", None,
+      "CSV file recording autotune samples", "both")
+_decl("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "int", 3,
+      "samples discarded before scoring begins", "both")
+_decl("HOROVOD_AUTOTUNE_STEPS", "int", 30,
+      "tuning steps before parameters freeze", "both")
+_decl("HOROVOD_AUTOTUNE_SAMPLE_CYCLES", "int", 10,
+      "coordination cycles aggregated per sample", "both")
+
+# -- timeline / profiling --
+_decl("HOROVOD_TIMELINE", "str", None,
+      "Chrome-trace timeline path (coordinator writes)", "both")
+_decl("HOROVOD_TIMELINE_MARK_CYCLES", "bool", False,
+      "add cycle markers to the timeline", "both")
+_decl("HOROVOD_FLASH_MIN_SEQ", "int", 1024,
+      "sequence length above which attention routes to the flash kernel")
+
+# -- logging --
+_decl("HOROVOD_LOG_LEVEL", "str", "warning",
+      "trace/debug/info/warning/error/fatal — C++ engine and Python",
+      "both")
+_decl("HOROVOD_LOG_TIMESTAMP", "bool", False,
+      "prefix timestamps on log lines", "both")
+
+# -- metrics / observability --
+_decl("HOROVOD_METRICS_PORT", "int", None,
+      "base port of the per-worker Prometheus endpoint (actual = base + "
+      "local_rank; unset = off; 0 = ephemeral)")
+_decl("HOROVOD_DRIVER_METRICS_PORT", "int", None,
+      "driver-side /metrics endpoint serving straggler gauges "
+      "(0 = ephemeral; unset = off)")
+_decl("HOROVOD_JOB_NAME", "str", "default",
+      "job label on every metrics sample")
+_decl("HOROVOD_STRAGGLER_STDDEVS", "float", 3.0,
+      "leave-one-out skew threshold k for straggler flagging")
+_decl("HOROVOD_STRAGGLER_WINDOWS", "int", 3,
+      "consecutive skewed windows before a rank is flagged")
+
+# -- flight recorder / post-mortem --
+_decl("HOROVOD_FLIGHT_RECORDER_SIZE", "int", 2048,
+      "per-collective event ring capacity (0 disables recording)", "cpp")
+_decl("HOROVOD_FLIGHT_DIR", "str", None,
+      "directory for per-rank flight dumps (flight_rank<R>.json); "
+      "unset = no automatic dumps", "both")
+
+# -- fault injection / wire integrity (engine-side readers) --
+_decl("HOROVOD_FAULT_SPEC", "str", None,
+      "seeded fault-injection rules ([channel.]point:action[@...]); "
+      "unset = off", "cpp")
+_decl("HOROVOD_FAULT_SEED", "int", 0,
+      "RNG seed for prob= fault rules (runs are reproducible)", "cpp")
+_decl("HOROVOD_MAX_FRAME_BYTES", "int", (1 << 31) - 1,
+      "upper bound on a single framed payload (test knob)", "cpp")
+_decl("HOROVOD_DATA_FAULT_INJECT", "str", None,
+      "data-plane fault toggles (truncate_star_allgatherv, ...)", "cpp")
+_decl("HOROVOD_RING_THRESHOLD_BYTES", "int", 1 << 20,
+      "payload size where the host data plane switches star -> ring",
+      "cpp")
+_decl("HOROVOD_CONNECT_RETRIES", "int", 0,
+      "max connect attempts per TCP link (0 = bounded by deadline only)",
+      "cpp")
+_decl("HOROVOD_CONNECT_BACKOFF_MS", "int", 50,
+      "base reconnect backoff, doubled per attempt with jitter", "cpp")
+_decl("HOROVOD_CONNECT_BACKOFF_CAP_MS", "int", 2000,
+      "reconnect backoff ceiling", "cpp")
+
+# -- elastic --
+_decl("HOROVOD_ELASTIC", "bool", False,
+      "this process is an elastic worker (driver-spawned)", "both")
+_decl("HOROVOD_ELASTIC_GENERATION", "int", 0,
+      "topology generation the driver spawned this worker into")
+_decl("HOROVOD_ELASTIC_MIN_GENERATION", "int", 0,
+      "reject rendezvous info older than this generation (set on reset)")
+_decl("HOROVOD_ELASTIC_MAX_RETRIES", "int", 100,
+      "bound on HorovodInternalError recovery rounds (0 = unbounded)")
+_decl("HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS", "float", 0.5,
+      "base backoff between recovery rounds, doubled (cap 30s) + jitter")
+_decl("HOROVOD_BLACKLIST_COOLDOWN_SECONDS", "float", 300.0,
+      "blacklisted hosts become eligible again after this long "
+      "(<=0 = permanent)")
+_decl("HOROVOD_FAILURES_TO_BLACKLIST", "int", 3,
+      "worker failures on a host before blacklisting")
+
+
+def _lookup(name: str) -> EnvVar:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not a registered HOROVOD_* variable; declare it in "
+            "horovod_tpu/common/env_registry.py (hvd-lint rule HVL005 "
+            "guards against typos)") from None
+
+
+def env_is_set(name: str) -> bool:
+    """True when the registered variable is present and non-empty."""
+    _lookup(name)
+    return os.environ.get(name, "") != ""
+
+
+def env_raw(name: str) -> Optional[str]:
+    """The raw string value, or None when unset/empty (registered names
+    only)."""
+    _lookup(name)
+    v = os.environ.get(name)
+    return v if v not in (None, "") else None
+
+
+def env_str(name: str, default=_UNSET) -> Optional[str]:
+    var = _lookup(name)
+    assert var.type == "str", f"{name} is {var.type}, not str"
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return var.default if default is _UNSET else default
+    return v
+
+
+def env_int(name: str, default=_UNSET) -> Optional[int]:
+    var = _lookup(name)
+    assert var.type == "int", f"{name} is {var.type}, not int"
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return var.default if default is _UNSET else default
+    return int(v)
+
+
+def env_float(name: str, default=_UNSET) -> Optional[float]:
+    var = _lookup(name)
+    assert var.type == "float", f"{name} is {var.type}, not float"
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return var.default if default is _UNSET else default
+    return float(v)
+
+
+def env_bool(name: str, default=_UNSET) -> bool:
+    """"non-0" truthiness: unset/empty -> default; "0"/"false"/"no"/"off"
+    (any case) -> False; anything else -> True."""
+    var = _lookup(name)
+    assert var.type == "bool", f"{name} is {var.type}, not bool"
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return bool(var.default) if default is _UNSET else bool(default)
+    return v.strip().lower() not in _FALSY
+
+
+def render_env_table() -> str:
+    """The markdown table docs/DESIGN.md embeds between the
+    ``<!-- env-table:begin -->`` / ``<!-- env-table:end -->`` markers.
+    Regenerate with ``python -m horovod_tpu.lint --write-env-table``;
+    lint rule HVL006 fails when the embedded copy drifts."""
+    scope_label = {"py": "Python", "cpp": "C++ engine", "both": "both"}
+    lines = [
+        "| Variable | Type | Default | Scope | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for var in sorted(REGISTRY.values(), key=lambda v: v.name):
+        if var.default is None:
+            default = "_(unset)_"
+        elif var.type == "bool":
+            default = "1" if var.default else "0"
+        else:
+            default = f"`{var.default}`"
+        lines.append(f"| `{var.name}` | {var.type} | {default} | "
+                     f"{scope_label[var.scope]} | {var.doc} |")
+    return "\n".join(lines) + "\n"

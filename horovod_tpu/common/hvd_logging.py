@@ -17,8 +17,9 @@ The full HOROVOD_* observability env table lives in docs/DESIGN.md
 from __future__ import annotations
 
 import logging
-import os
 import sys
+
+from horovod_tpu.common.env_registry import env_bool, env_str
 
 _ROOT = "horovod_tpu"
 
@@ -66,9 +67,9 @@ def setup_python_logging(force: bool = False) -> logging.Logger:
     logger = logging.getLogger(_ROOT)
     if getattr(logger, "_hvd_configured", False) and not force:
         return logger
-    level = _LEVELS.get(os.environ.get("HOROVOD_LOG_LEVEL", "").lower(),
+    level = _LEVELS.get(env_str("HOROVOD_LOG_LEVEL").lower(),
                         logging.WARNING)
-    ts = os.environ.get("HOROVOD_LOG_TIMESTAMP", "0") not in ("", "0")
+    ts = env_bool("HOROVOD_LOG_TIMESTAMP")
     fmt = "[hvdtpu%(hvd_rank)s %(levelname)s %(name)s] %(message)s"
     if ts:
         fmt = ("[hvdtpu%(hvd_rank)s %(asctime)s %(levelname)s %(name)s] "

@@ -18,12 +18,13 @@ from __future__ import annotations
 
 import ctypes
 import json
-import os
 import subprocess
 import threading
 from pathlib import Path
 from typing import Callable, Optional, Sequence
 
+from horovod_tpu.common.env_registry import (env_bool, env_float, env_int,
+                                             env_str)
 from horovod_tpu.common.exceptions import HorovodInternalError
 
 # Engine wire dtype ids (engine/src/common.h DataType).
@@ -64,7 +65,7 @@ def _lib_path() -> Path:
 def build_library(force: bool = False) -> Path:
     # Explicit library override (e.g. the TSan build in build-tsan/): trust
     # the caller, skip make — the ABI check below still rejects stale ones.
-    override = os.environ.get("HOROVOD_ENGINE_LIB")
+    override = env_str("HOROVOD_ENGINE_LIB")
     if override:
         return Path(override)
     # Run make when a toolchain is present: its dependency tracking makes a
@@ -232,16 +233,6 @@ def bench_combine(dtype_name: str, num_elements: int, iters: int,
         1 if scalar_baseline else 0))
 
 
-def _env_float(name, default):
-    v = os.environ.get(name)
-    return float(v) if v not in (None, "") else default
-
-
-def _env_int(name, default):
-    v = os.environ.get(name)
-    return int(v) if v not in (None, "") else default
-
-
 class EngineSession:
     """One engine rank: background coordination thread + async handles."""
 
@@ -262,32 +253,30 @@ class EngineSession:
                  stall_shutdown_sec: Optional[float] = None,
                  timeout_sec: Optional[float] = None):
         self._lib = load_library()
-        addr = addr or os.environ.get("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
+        addr = addr or env_str("HOROVOD_CONTROLLER_ADDR")
         port = port if port is not None else \
-            _env_int("HOROVOD_CONTROLLER_PORT", 0)
+            env_int("HOROVOD_CONTROLLER_PORT")
         if transport == "tcp" and port <= 0:
             raise ValueError(
                 "tcp transport needs HOROVOD_CONTROLLER_PORT (the launcher "
                 "exports it; set it manually for hand-rolled runs)")
         data_port = data_port if data_port is not None else \
-            _env_int("HOROVOD_CONTROLLER_DATA_PORT", 0)
+            env_int("HOROVOD_CONTROLLER_DATA_PORT")
         cycle_time_ms = cycle_time_ms if cycle_time_ms is not None else \
-            _env_float("HOROVOD_CYCLE_TIME", 1.0)
+            env_float("HOROVOD_CYCLE_TIME")
         fusion_threshold = fusion_threshold if fusion_threshold is not None \
-            else _env_int("HOROVOD_FUSION_THRESHOLD", 64 << 20)
+            else env_int("HOROVOD_FUSION_THRESHOLD")
         cache_capacity = cache_capacity if cache_capacity is not None else \
-            _env_int("HOROVOD_CACHE_CAPACITY", 1024)
+            env_int("HOROVOD_CACHE_CAPACITY")
         stall_warning_sec = stall_warning_sec if stall_warning_sec is not None\
-            else _env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0)
+            else env_float("HOROVOD_STALL_CHECK_TIME_SECONDS")
         stall_shutdown_sec = stall_shutdown_sec if stall_shutdown_sec is not \
-            None else _env_float("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0)
-        stall_disable = os.environ.get("HOROVOD_STALL_CHECK_DISABLE",
-                                       "0") == "1"
+            None else env_float("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS")
+        stall_disable = env_bool("HOROVOD_STALL_CHECK_DISABLE")
         timeout_sec = timeout_sec if timeout_sec is not None else \
-            _env_float("HOROVOD_CONTROLLER_TIMEOUT_SECONDS", 30.0)
-        timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
-        timeline_cycles = os.environ.get("HOROVOD_TIMELINE_MARK_CYCLES",
-                                         "0") == "1"
+            env_float("HOROVOD_CONTROLLER_TIMEOUT_SECONDS")
+        timeline_path = env_str("HOROVOD_TIMELINE") or ""
+        timeline_cycles = env_bool("HOROVOD_TIMELINE_MARK_CYCLES")
 
         self._session = self._lib.hvdtpu_create_session(
             rank, size, local_rank, local_size,

@@ -82,6 +82,7 @@ bool CvWaitFor(std::condition_variable& cv,
                std::unique_lock<std::mutex>& lock, double seconds,
                Pred pred) {
 #if defined(__SANITIZE_THREAD__)
+  // hvd-lint: disable=HVL101 — this IS the sanctioned wrapper
   return cv.wait_until(
       lock,
       std::chrono::system_clock::now() +
@@ -89,6 +90,7 @@ bool CvWaitFor(std::condition_variable& cv,
               std::chrono::duration<double>(seconds)),
       pred);
 #else
+  // hvd-lint: disable=HVL101 — this IS the sanctioned wrapper
   return cv.wait_for(lock, std::chrono::duration<double>(seconds), pred);
 #endif
 }

@@ -16,13 +16,13 @@ semantics carried over exactly:
 from __future__ import annotations
 
 import copy
-import os
 import queue
 from typing import Any, Callable, Dict
 
 import jax
 
 from horovod_tpu.common import basics
+from horovod_tpu.common.env_registry import env_float, env_int
 from horovod_tpu.common.exceptions import (
     HorovodInternalError,
     HostsUpdatedInterrupt,
@@ -180,10 +180,8 @@ def run(func: Callable) -> Callable:
         import random
         import time
         start_notification_poller()
-        max_retries = int(os.environ.get(
-            "HOROVOD_ELASTIC_MAX_RETRIES", "100") or 0)
-        backoff_base = float(os.environ.get(
-            "HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS", "0.5") or 0)
+        max_retries = env_int("HOROVOD_ELASTIC_MAX_RETRIES")
+        backoff_base = env_float("HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS")
         failures = 0
         last_failure = None
         skip_sync = False

@@ -1,0 +1,17 @@
+"""``hvd-lint``: static analysis for the collective/engine contracts.
+
+Two prongs (see docs/DESIGN.md "Static analysis & correctness tooling"):
+
+- **Python** (stdlib ``ast``): rank-divergent collectives (HVL001),
+  collective-order divergence (HVL002), swallowed aborts (HVL003), env
+  discipline + typo detection + docs sync (HVL004–006).
+- **C++** (pattern + lightweight parse over ``engine/src``): raw timed
+  cv waits outside CvWaitFor (HVL101), static lock-order graph with
+  cycle detection + dot emission (HVL102), atomics audit (HVL103).
+
+Run ``hvd-lint`` / ``make lint`` / ``python -m horovod_tpu.lint``;
+``tests/test_lint.py`` keeps the repository itself at zero findings.
+"""
+
+from horovod_tpu.lint.base import RULES, Finding  # noqa: F401
+from horovod_tpu.lint.cli import main, run_lint  # noqa: F401

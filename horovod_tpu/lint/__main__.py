@@ -1,0 +1,4 @@
+from horovod_tpu.lint.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

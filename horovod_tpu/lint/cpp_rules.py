@@ -1,0 +1,253 @@
+"""C++ prong: engine-concurrency rules over ``engine/src`` (HVL101–103).
+
+No compiler needed — a pattern scan plus a lightweight brace-tracking
+parse is enough for the three contracts the engine's threading model
+rests on:
+
+- HVL101 — every timed condition-variable wait must go through
+  ``CvWaitFor`` (common.h). gcc-10's libtsan cannot model
+  ``pthread_cond_clockwait``, so a raw ``wait_for`` turns `make tsan`
+  into a wall of bogus double-lock reports (the PR-4 rule, previously
+  enforced only by reviewer memory).
+- HVL102 — a static lock-order graph: within each scanned function,
+  acquiring mutex B while holding mutex A adds edge A→B; a cycle in the
+  union graph is a deadlock hazard. The graph is emitted as graphviz dot
+  (``--lock-graph``) for review. Mutex identity is file-scoped (textual
+  member/global name within one translation unit); the parse is
+  intra-procedural, so call-chain inversions are out of scope — the TSan
+  build covers those dynamically.
+- HVL103 — atomics discipline: hot-path counters (MetricsStore, flight
+  recorder) must pass ``memory_order_relaxed`` explicitly (a bare
+  ``fetch_add`` is seq_cst — a silent hot-path regression), and fields
+  whose names mark them as cross-thread lifecycle flags
+  (shutdown/abort/stop/healthy...) must be ``std::atomic``.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from horovod_tpu.lint.base import Reporter
+
+# -- HVL101: raw timed cv waits ----------------------------------------
+
+_RAW_WAIT_RE = re.compile(
+    r"\.\s*wait_for\s*\(|\.\s*wait_until\s*\(|pthread_cond_clockwait")
+
+
+def check_raw_cv_wait(rep: Reporter, path: Path):
+    fr = rep.scan_file(path)
+    for i, line in enumerate(fr.lines, start=1):
+        code = line.split("//", 1)[0]
+        if _RAW_WAIT_RE.search(code):
+            fr.add(
+                "HVL101", i,
+                "raw timed cv wait — use CvWaitFor (common.h): gcc-10 "
+                "libtsan does not model pthread_cond_clockwait, so plain "
+                "wait_for/wait_until poisons `make tsan` with bogus "
+                "double-lock reports")
+
+
+# -- HVL102: static lock-order graph -----------------------------------
+
+_GUARD_RE = re.compile(
+    r"std::(?P<kind>lock_guard|unique_lock|scoped_lock)\s*(?:<[^<>]*>)?\s+"
+    r"(?P<var>\w+)\s*[({](?P<args>[^;]*?)[)}]\s*;")
+_UNLOCK_RE = re.compile(r"\b(?P<var>\w+)\s*\.\s*unlock\s*\(\s*\)")
+
+
+def _norm_mutex(expr: str) -> str:
+    expr = expr.strip()
+    expr = re.sub(r"^this\s*->\s*", "", expr)
+    expr = re.sub(r"\s+", "", expr)
+    return expr
+
+
+class LockGraph:
+    """Union lock-order graph over all scanned translation units."""
+
+    def __init__(self):
+        # edge (a, b) -> first acquisition site "file:line"
+        self.edges: Dict[Tuple[str, str], str] = {}
+        self.nodes: set = set()
+
+    def add_edge(self, held: str, acquired: str, site: str):
+        self.nodes.update((held, acquired))
+        self.edges.setdefault((held, acquired), site)
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles found by DFS (enough to answer "any?" and
+        name one per strongly-connected loop)."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        found: List[List[str]] = []
+        seen_cycles = set()
+
+        def dfs(node, stack, on_stack):
+            for nxt in adj.get(node, ()):
+                if nxt in on_stack:
+                    cyc = stack[stack.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        found.append(cyc)
+                else:
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    dfs(nxt, stack, on_stack)
+                    on_stack.discard(nxt)
+                    stack.pop()
+
+        for start in sorted(self.nodes):
+            dfs(start, [start], {start})
+        return found
+
+    def to_dot(self) -> str:
+        lines = ["digraph lock_order {",
+                 '  rankdir=LR; node [shape=box, fontname="monospace"];',
+                 "  // nodes = mutexes (file-scoped); edge A->B = B "
+                 "acquired while A held, labeled with the site.",
+                 "  // no edges means no nested locking anywhere — the "
+                 "engine's preferred state."]
+        cycle_edges = set()
+        for cyc in self.cycles():
+            for a, b in zip(cyc, cyc[1:]):
+                cycle_edges.add((a, b))
+        for node in sorted(self.nodes):
+            lines.append(f'  "{node}";')
+        for (a, b), site in sorted(self.edges.items()):
+            style = ' color=red penwidth=2' if (a, b) in cycle_edges else ""
+            lines.append(f'  "{a}" -> "{b}" [label="{site}"{style}];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+def scan_lock_orders(rep: Reporter, path: Path, graph: LockGraph):
+    """Track RAII guard scopes by brace depth; each acquisition while
+    other guards are live adds held→acquired edges."""
+    fr = rep.scan_file(path)
+    fname = path.name
+    rel = rep._rel(path)
+    depth = 0
+    # live guards: list of (depth_at_acquisition, guard_var, mutex_node)
+    live: List[Tuple[int, str, str]] = []
+    for i, raw in enumerate(fr.lines, start=1):
+        code = raw.split("//", 1)[0]
+        # Process braces, guard declarations, and explicit unlocks in
+        # source order: a guard lives at the brace depth of its
+        # declaration *position* and dies when depth drops below it —
+        # an unrelated inner block closing must not release it.
+        events = sorted(
+            [(j, "brace", ch) for j, ch in enumerate(code) if ch in "{}"]
+            + [(m.start(), "guard", m) for m in _GUARD_RE.finditer(code)]
+            + [(m.start(), "unlock", m) for m in _UNLOCK_RE.finditer(code)],
+            key=lambda e: e[0])
+        for _, kind, ev in events:
+            if kind == "brace":
+                if ev == "{":
+                    depth += 1
+                else:
+                    depth -= 1
+                    live = [g for g in live if g[0] <= depth]
+                continue
+            if kind == "unlock":
+                var = ev.group("var")
+                live = [g for g in live if g[1] != var]
+                continue
+            args = ev.group("args")
+            # scoped_lock may take several mutexes; the others take
+            # (mutex[, tag]) — the first argument is always the mutex,
+            # std::defer_lock-style tags never contain '('.
+            first = args.split(",")[0]
+            mutexes = [first] if ev.group("kind") != "scoped_lock" \
+                else args.split(",")
+            for mx in mutexes:
+                mx = _norm_mutex(mx)
+                if not mx or mx in ("std::defer_lock", "std::adopt_lock",
+                                    "std::try_to_lock"):
+                    continue
+                node = f"{fname}:{mx}"
+                site = f"{rel}:{i}"
+                for _, _, held in live:
+                    if held == node:
+                        fr.add(
+                            "HVL102", i,
+                            f"mutex `{mx}` acquired while already held "
+                            "in the same scope chain — self-deadlock on "
+                            "a non-recursive mutex")
+                    else:
+                        graph.add_edge(held, node, site)
+                graph.nodes.add(node)
+                live.append((depth, ev.group("var"), node))
+
+
+def check_lock_order(rep: Reporter, paths: Sequence[Path],
+                     dot_out: Path | None = None) -> LockGraph:
+    graph = LockGraph()
+    for p in paths:
+        scan_lock_orders(rep, p, graph)
+    for cyc in graph.cycles():
+        sites = " -> ".join(cyc)
+        path, line = cyc[0].split(":", 1)[0], 1
+        edge_site = graph.edges.get((cyc[0], cyc[1]))
+        if edge_site:
+            path, _, ln = edge_site.rpartition(":")
+            line = int(ln or 1)
+        rep.add_repo_finding(
+            "HVL102", Path(path), line,
+            f"lock-order cycle (deadlock hazard): {sites} — two threads "
+            "taking these mutexes in opposite orders can deadlock; "
+            "impose a single acquisition order or collapse the locks")
+    if dot_out is not None:
+        dot_out.parent.mkdir(parents=True, exist_ok=True)
+        dot_out.write_text(graph.to_dot())
+    return graph
+
+
+# -- HVL103: atomics discipline ----------------------------------------
+
+# hot-path files where a bare fetch_add (seq_cst) is a perf regression
+HOT_PATH_FILES = ("metrics.h", "metrics.cc",
+                  "flight_recorder.h", "flight_recorder.cc")
+
+_FETCH_ADD_RE = re.compile(r"\.\s*fetch_(?:add|sub)\s*\(")
+_FLAG_FIELD_RE = re.compile(
+    r"^\s*(?:volatile\s+)?(?:bool|u?int(?:32|64)?_t|int|size_t)\s+"
+    r"(?P<name>\w*(?:shutdown|abort|stop|running|healthy|quit|"
+    r"terminat)\w*_)\s*(?:=[^;]*)?;")
+
+
+def check_atomics(rep: Reporter, path: Path):
+    fr = rep.scan_file(path)
+    hot = path.name in HOT_PATH_FILES
+    for i, raw in enumerate(fr.lines, start=1):
+        code = raw.split("//", 1)[0]
+        # the memory_order argument may sit on a continuation line: join
+        # from the call through the end of ITS statement (first ';'),
+        # not beyond — the next statement's ordering must not mask this one
+        m = _FETCH_ADD_RE.search(code)
+        stmt = code[m.start():] if m else ""
+        j = i
+        while m and ";" not in stmt and j < len(fr.lines):
+            stmt += " " + fr.lines[j].split("//", 1)[0]
+            j += 1
+        stmt = stmt.split(";", 1)[0]
+        if hot and m and "memory_order_relaxed" not in stmt:
+            fr.add(
+                "HVL103", i,
+                "hot-path counter increment without an explicit "
+                "memory_order_relaxed — a bare fetch_add is seq_cst and "
+                "puts a full fence on the per-collective fast path")
+        if path.suffix == ".h":
+            m = _FLAG_FIELD_RE.match(code)
+            if m and "atomic" not in code:
+                fr.add(
+                    "HVL103", i,
+                    f"`{m.group('name')}` looks like a cross-thread "
+                    "lifecycle flag (background loop writes, API thread "
+                    "reads) but is not std::atomic — a plain field is a "
+                    "data race; wrap it or rename it if it is "
+                    "mutex-guarded")

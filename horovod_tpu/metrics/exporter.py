@@ -16,12 +16,13 @@ port by ``HOROVOD_LOCAL_RANK`` so one env value serves the whole host.
 from __future__ import annotations
 
 import json
-import os
 import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from horovod_tpu.common.env_registry import (env_int, env_is_set, env_raw,
+                                             env_str)
 from horovod_tpu.metrics import prom
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
 
@@ -97,26 +98,26 @@ def start_exporter_from_env(registry: Optional[MetricsRegistry] = None,
     Failure to bind logs a warning and returns None: observability must
     never take down training.
     """
-    port_env = os.environ.get("HOROVOD_METRICS_PORT", "")
-    if port_env == "":
+    if not env_is_set("HOROVOD_METRICS_PORT"):
         return None
     from horovod_tpu.common.hvd_logging import get_logger
     log = get_logger("metrics")
     try:
-        base = int(port_env.strip())
-        local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", "0") or 0)
-    except ValueError:
+        base = env_int("HOROVOD_METRICS_PORT")
+        local_rank = env_int("HOROVOD_LOCAL_RANK")
+        rank_label = rank if rank is not None else env_int("HOROVOD_RANK")
+    except ValueError as e:
         # a malformed telemetry env var must not take down training
-        log.warning("ignoring malformed HOROVOD_METRICS_PORT=%r", port_env)
+        log.warning("metrics exporter disabled, malformed env value "
+                    "(HOROVOD_METRICS_PORT=%r): %s",
+                    env_raw("HOROVOD_METRICS_PORT"), e)
         return None
     port = base + local_rank if base > 0 else 0
     reg = registry if registry is not None else get_registry()
     if engine is not None:
         from horovod_tpu.metrics.registry import engine_collector
         reg.register_collector(engine_collector(engine), name="engine")
-    labels = {"rank": str(rank if rank is not None else
-                          os.environ.get("HOROVOD_RANK", "0")),
-              "job": os.environ.get("HOROVOD_JOB_NAME", "default")}
+    labels = {"rank": str(rank_label), "job": env_str("HOROVOD_JOB_NAME")}
     try:
         exporter = MetricsExporter(reg, port=port, labels=labels).start()
     except OSError as e:
@@ -129,19 +130,19 @@ def start_exporter_from_env(registry: Optional[MetricsRegistry] = None,
 
 def _publish_endpoint(exporter: MetricsExporter, log):
     """Elastic jobs: tell the driver where to scrape this worker."""
-    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
-    kv_port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
-    if not addr or not kv_port:
-        return
     try:
+        addr = env_str("HOROVOD_RENDEZVOUS_ADDR")
+        kv_port = env_int("HOROVOD_RENDEZVOUS_PORT")
+        if not addr or not kv_port:
+            return
         from horovod_tpu.runner.http_kv import KVClient
-        host = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
-        local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+        host = env_str("HOROVOD_HOSTNAME", socket.gethostname())
+        local_rank = str(env_int("HOROVOD_LOCAL_RANK"))
         scrape_addr = "127.0.0.1" if host == "localhost" else host
-        KVClient(addr, int(kv_port)).put_json(
+        KVClient(addr, kv_port).put_json(
             f"metrics_addr/{host}/{local_rank}",
             {"addr": scrape_addr, "port": exporter.port,
-             "rank": int(os.environ.get("HOROVOD_RANK", "0"))},
+             "rank": env_int("HOROVOD_RANK")},
             timeout=5.0)
     except Exception as e:  # noqa: BLE001 — best-effort publication
         log.warning("could not publish metrics endpoint: %s", e)

@@ -33,7 +33,6 @@ chip.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -409,8 +408,8 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def flash_min_seq() -> int:
     """The routing crossover (elements of Tk), env-overridable."""
-    env = os.environ.get("HOROVOD_FLASH_MIN_SEQ", "")
-    return int(env) if env else DEFAULT_FLASH_MIN_SEQ
+    from horovod_tpu.common.env_registry import env_int
+    return env_int("HOROVOD_FLASH_MIN_SEQ", DEFAULT_FLASH_MIN_SEQ)
 
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,

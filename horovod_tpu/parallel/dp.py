@@ -16,7 +16,6 @@ Adasum, and prescale/postscale, matching reference knobs.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -39,8 +38,8 @@ def _resolve_hierarchical(hierarchical: Optional[bool],
     HOROVOD_HIERARCHICAL_ALLREDUCE, operations.cc:470-494). Needs at least
     two reduce axes — the first is the slow/DCN level."""
     if hierarchical is None:
-        hierarchical = os.environ.get(
-            "HOROVOD_HIERARCHICAL_ALLREDUCE", "0") == "1"
+        from horovod_tpu.common.env_registry import env_bool
+        hierarchical = env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE")
     return hierarchical and len(axes) >= 2
 
 

@@ -8,12 +8,12 @@ the driver polls it every second (reference: driver.py:181-201).
 
 from __future__ import annotations
 
-import os
 import subprocess
 import threading
 import time
 from typing import Dict, List
 
+from horovod_tpu.common.env_registry import env_float
 from horovod_tpu.runner import hosts as hosts_lib
 
 # A blacklisted host becomes eligible again after this long and is
@@ -77,9 +77,8 @@ class HostManager:
         self._blacklist: Dict[str, float] = {}
         self.current: Dict[str, int] = {}
         if cooldown is None:
-            cooldown = float(os.environ.get(
-                "HOROVOD_BLACKLIST_COOLDOWN_SECONDS",
-                str(DEFAULT_BLACKLIST_COOLDOWN_SECONDS)) or 0)
+            cooldown = env_float("HOROVOD_BLACKLIST_COOLDOWN_SECONDS",
+                                 DEFAULT_BLACKLIST_COOLDOWN_SECONDS)
         self._cooldown = cooldown
 
     def blacklist(self, hostname: str):

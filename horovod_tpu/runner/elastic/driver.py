@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu.common.env_registry import (env_float, env_int, env_is_set,
+                                             env_str)
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.metrics import step_stats
 from horovod_tpu.metrics.registry import get_registry
@@ -94,9 +96,8 @@ class ElasticDriver:
         self._prev_host_order: List[str] = []
         self._workers: Dict[Tuple[str, int], WorkerProcess] = {}
         self._host_failures: Dict[str, int] = {}
-        self._failures_to_blacklist = int(os.environ.get(
-            "HOROVOD_FAILURES_TO_BLACKLIST",
-            str(FAILURES_TO_BLACKLIST)) or FAILURES_TO_BLACKLIST)
+        self._failures_to_blacklist = env_int(
+            "HOROVOD_FAILURES_TO_BLACKLIST", FAILURES_TO_BLACKLIST)
         self._removed_slots: set = set()
         self._expected_slots: List[Tuple[str, int]] = []
         self._go_deadline: float = 0.0
@@ -106,22 +107,20 @@ class ElasticDriver:
         # scores land in the driver's registry as hvd_straggler_score /
         # hvd_straggler_flagged gauges
         self._straggler = StragglerDetector(
-            k=float(os.environ.get("HOROVOD_STRAGGLER_STDDEVS", "3.0")),
-            windows=int(os.environ.get("HOROVOD_STRAGGLER_WINDOWS", "3")),
+            k=env_float("HOROVOD_STRAGGLER_STDDEVS"),
+            windows=env_int("HOROVOD_STRAGGLER_WINDOWS"),
             registry=get_registry())
         # Driver-side /metrics endpoint serving those gauges.
         # HOROVOD_DRIVER_METRICS_PORT (not the worker port family: the
         # workers already occupy HOROVOD_METRICS_PORT + local_rank on this
         # host); "0" binds ephemeral. Off by default.
         self._metrics_exporter = None
-        dport = os.environ.get("HOROVOD_DRIVER_METRICS_PORT", "")
-        if dport != "":
+        if env_is_set("HOROVOD_DRIVER_METRICS_PORT"):
             try:
                 from horovod_tpu.metrics import MetricsExporter
                 self._metrics_exporter = MetricsExporter(
-                    get_registry(), port=int(dport),
-                    labels={"job": os.environ.get("HOROVOD_JOB_NAME",
-                                                  "default"),
+                    get_registry(), port=env_int("HOROVOD_DRIVER_METRICS_PORT"),
+                    labels={"job": env_str("HOROVOD_JOB_NAME"),
                             "role": "elastic-driver"}).start()
                 self._logger.info("driver metrics endpoint on :%d/metrics",
                                   self._metrics_exporter.port)
@@ -396,7 +395,7 @@ class ElasticDriver:
         tensor was in flight) next to the failure itself, so the operator
         never has to reconstruct the last seconds by hand."""
         flight_dir = (self._extra_env.get("HOROVOD_FLIGHT_DIR") or
-                      os.environ.get("HOROVOD_FLIGHT_DIR"))
+                      env_str("HOROVOD_FLIGHT_DIR"))
         if not flight_dir:
             return
         try:

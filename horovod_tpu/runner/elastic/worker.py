@@ -27,6 +27,8 @@ import os
 import time
 from typing import Optional, Tuple
 
+from horovod_tpu.common.env_registry import (env_bool, env_int, env_is_set,
+                                             env_str)
 from horovod_tpu.runner.elastic.registration import (  # noqa: F401
     FAILURE,
     READY,
@@ -37,24 +39,24 @@ from horovod_tpu.runner.elastic.registration import (  # noqa: F401
 
 def kv_client():
     from horovod_tpu.runner.http_kv import KVClient
-    return KVClient(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
-                    int(os.environ["HOROVOD_RENDEZVOUS_PORT"]))
+    return KVClient(env_str("HOROVOD_RENDEZVOUS_ADDR"),
+                    env_int("HOROVOD_RENDEZVOUS_PORT"))
 
 
 def is_elastic_worker() -> bool:
     """True when this process was spawned by the elastic driver."""
-    return (os.environ.get("HOROVOD_ELASTIC") == "1"
-            and bool(os.environ.get("HOROVOD_RENDEZVOUS_ADDR")))
+    return (env_bool("HOROVOD_ELASTIC")
+            and env_is_set("HOROVOD_RENDEZVOUS_ADDR"))
 
 
 def current_generation() -> int:
     """The topology generation this worker last rendezvoused into."""
-    return int(os.environ.get("HOROVOD_ELASTIC_GENERATION", "0"))
+    return env_int("HOROVOD_ELASTIC_GENERATION")
 
 
 def _slot() -> Tuple[str, str]:
-    return (os.environ.get("HOROVOD_HOSTNAME", "localhost"),
-            os.environ.get("HOROVOD_LOCAL_RANK", "0"))
+    return (env_str("HOROVOD_HOSTNAME"),
+            str(env_int("HOROVOD_LOCAL_RANK")))
 
 
 def record_state(generation: int, state: str, client=None):
@@ -90,7 +92,7 @@ def rendezvous(timeout: float = 300.0) -> int:
     """
     client = kv_client()
     host, local_rank = _slot()
-    min_gen = int(os.environ.get("HOROVOD_ELASTIC_MIN_GENERATION", "0"))
+    min_gen = env_int("HOROVOD_ELASTIC_MIN_GENERATION")
     deadline = time.monotonic() + timeout
     while True:
         gen_info = client.get_json("generation", timeout=60.0)

@@ -21,19 +21,21 @@ import sys
 
 import cloudpickle
 
+from horovod_tpu.common.env_registry import env_int, env_str
+
 
 def _kv_client():
-    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
-    port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+    addr = env_str("HOROVOD_RENDEZVOUS_ADDR")
+    port = env_int("HOROVOD_RENDEZVOUS_PORT")
     if not addr or not port:
         return None
     from horovod_tpu.runner.http_kv import KVClient
-    return KVClient(addr, int(port))
+    return KVClient(addr, port)
 
 
 def main():
     fn_path, out_dir = sys.argv[1], sys.argv[2]
-    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    rank = env_int("HOROVOD_RANK")
     kv = _kv_client()
     try:
         with open(os.path.join(out_dir, f"started.{rank}"), "w"):
@@ -71,7 +73,7 @@ def main():
         # results may be collected together. The env var tracks re-inits
         # (elastic/worker.py rewrites it at each rendezvous); static jobs
         # stay at generation 0.
-        gen = os.environ.get("HOROVOD_ELASTIC_GENERATION", "0")
+        gen = env_int("HOROVOD_ELASTIC_GENERATION")
         kv.put_json(f"task_result/g{gen}/{rank}",
                     {"data": base64.b64encode(payload).decode()})
 

@@ -1,0 +1,17 @@
+"""HVL001 clean: every rank submits the same collectives; rank-dependent
+branches only do local work (logging, checkpoint writes)."""
+import horovod_tpu as hvd
+
+
+def train(state):
+    out = hvd.allreduce(state)  # uniform: all ranks
+    if hvd.rank() == 0:
+        print("loss", out)  # local-only under the rank branch
+    state = hvd.broadcast(out, root_rank=0)  # uniform again
+    return state
+
+
+def early_finisher(state):
+    if hvd.rank() == 0:
+        return hvd.join()  # join is the sanctioned subset-of-ranks op
+    return state

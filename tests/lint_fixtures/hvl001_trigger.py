@@ -1,0 +1,19 @@
+"""HVL001 trigger: collectives reachable only under rank conditions."""
+import horovod_tpu as hvd
+
+
+def guarded_broadcast(state):
+    if hvd.rank() == 0:
+        hvd.broadcast(state, root_rank=0)  # only rank 0 submits
+
+
+def early_exit(state):
+    if hvd.local_rank() != 0:
+        return None
+    return hvd.allreduce(state)  # subset of ranks reaches this
+
+
+def while_rank(state):
+    while hvd.rank() < 2:
+        state = hvd.allgather(state)
+    return state

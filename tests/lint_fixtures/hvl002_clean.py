@@ -1,0 +1,14 @@
+"""HVL002 clean: both branches of the rank-dependent if issue the SAME
+collective sequence (different tensors are negotiated by name, the order
+contract holds)."""
+import horovod_tpu as hvd
+
+
+def symmetric(state, grads):
+    if hvd.rank() == 0:
+        hvd.allreduce(grads)
+        hvd.broadcast(state, root_rank=0)
+    else:
+        hvd.allreduce(grads)
+        hvd.broadcast(state, root_rank=0)
+    return state

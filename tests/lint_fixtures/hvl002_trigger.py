@@ -1,0 +1,13 @@
+"""HVL002 trigger: rank-dependent if/else with divergent collective
+sequences — both sides collect, but never in the same order."""
+import horovod_tpu as hvd
+
+
+def divergent(state, grads):
+    if hvd.rank() == 0:
+        hvd.allreduce(grads)
+        hvd.broadcast(state, root_rank=0)
+    else:
+        hvd.broadcast(state, root_rank=0)
+        hvd.allreduce(grads)
+    return state

@@ -1,0 +1,37 @@
+"""HVL003 clean: handlers that re-raise, narrow, or wrap no
+collectives."""
+import horovod_tpu as hvd
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+
+def reraises(grads):
+    try:
+        return hvd.allreduce(grads)
+    except Exception:
+        cleanup()
+        raise
+
+
+def narrow(grads):
+    try:
+        return hvd.allreduce(grads)
+    except ValueError:  # specific, cannot catch HorovodInternalError
+        return None
+
+
+def explicit_recovery(grads):
+    try:
+        return hvd.allreduce(grads)
+    except HorovodInternalError:  # explicit = deliberate (elastic loop)
+        return None
+
+
+def no_collectives():
+    try:
+        return open("/nonexistent").read()
+    except Exception:
+        return ""
+
+
+def cleanup():
+    pass

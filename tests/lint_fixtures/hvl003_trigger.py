@@ -1,0 +1,17 @@
+"""HVL003 trigger: broad except swallowing a collective failure."""
+import horovod_tpu as hvd
+
+
+def swallow(grads):
+    try:
+        out = hvd.allreduce(grads)
+    except Exception:  # eats HorovodInternalError, strands peers
+        out = None
+    return out
+
+
+def swallow_bare(handle):
+    try:
+        return hvd.synchronize(handle)
+    except:  # noqa: E722 — bare except, same problem
+        return None

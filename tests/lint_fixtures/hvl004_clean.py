@@ -1,0 +1,18 @@
+"""HVL004 clean: reads via the typed registry; env *writes* (the
+launcher building a child environment) stay allowed."""
+import os
+
+from horovod_tpu.common.env_registry import env_bool, env_float, env_int
+
+
+def reads():
+    a = env_float("HOROVOD_CYCLE_TIME")
+    b = env_int("HOROVOD_RANK")
+    c = env_bool("HOROVOD_ELASTIC")
+    return a, b, c
+
+
+def launcher_write(rank):
+    os.environ["HOROVOD_RANK"] = str(rank)  # writes are the launcher's job
+    other = os.environ.get("JAX_PLATFORMS")  # non-HOROVOD reads untouched
+    return other

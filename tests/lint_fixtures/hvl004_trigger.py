@@ -1,0 +1,10 @@
+"""HVL004 trigger: direct os.environ reads of HOROVOD_* variables."""
+import os
+
+
+def reads():
+    a = os.environ.get("HOROVOD_CYCLE_TIME", "1.0")
+    b = os.environ["HOROVOD_RANK"]
+    c = os.getenv("HOROVOD_FUSION_THRESHOLD")
+    d = "HOROVOD_ELASTIC" in os.environ
+    return a, b, c, d

@@ -1,0 +1,6 @@
+"""HVL005 clean: only registered names appear, docstrings included.
+
+HOROVOD_CYCLE_TIME and HOROVOD_FUSION_THRESHOLD are fine to mention.
+"""
+
+KNOWN = "HOROVOD_CACHE_CAPACITY"

@@ -1,0 +1,5 @@
+"""HVL005 trigger: misspelled / unregistered HOROVOD_* names in string
+literals (reads and docs alike)."""
+
+TYPO = "HOROVOD_CYLE_TIME"  # edit distance 1 from HOROVOD_CYCLE_TIME
+UNKNOWN = "HOROVOD_COMPLETELY_MADE_UP_KNOB_XYZ"

@@ -1,0 +1,12 @@
+// HVL101 clean: the sanctioned wrapper (untimed waits are fine too —
+// libtsan models plain pthread_cond_wait).
+#include <condition_variable>
+#include <mutex>
+
+#include "common.h"  // CvWaitFor
+
+bool GoodWaits(std::condition_variable& cv, std::mutex& mu, bool& flag) {
+  std::unique_lock<std::mutex> lock(mu);
+  cv.wait(lock, [&] { return flag; });  // untimed: fine
+  return hvdtpu::CvWaitFor(cv, lock, 0.005, [&] { return flag; });
+}

@@ -1,0 +1,13 @@
+// HVL101 trigger: raw timed cv waits that bypass CvWaitFor.
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+
+bool RawWaits(std::condition_variable& cv, std::mutex& mu, bool& flag) {
+  std::unique_lock<std::mutex> lock(mu);
+  cv.wait_for(lock, std::chrono::milliseconds(5));
+  return cv.wait_until(lock,
+                       std::chrono::steady_clock::now() +
+                           std::chrono::milliseconds(5),
+                       [&] { return flag; });
+}

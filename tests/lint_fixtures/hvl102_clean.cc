@@ -1,0 +1,27 @@
+// HVL102 clean: nesting in one consistent order, and scoped release
+// before taking the second lock elsewhere (the engine's house style).
+#include <mutex>
+
+struct Ordered {
+  std::mutex queue_mu_;
+  std::mutex state_mu_;
+  int depth_ = 0;
+  int epoch_ = 0;
+
+  void Producer() {
+    std::lock_guard<std::mutex> lq(queue_mu_);
+    std::lock_guard<std::mutex> ls(state_mu_);  // queue -> state
+    depth_++;
+    epoch_++;
+  }
+
+  void Reaper() {
+    int snapshot;
+    {
+      std::lock_guard<std::mutex> lq(queue_mu_);
+      snapshot = depth_;
+    }  // released before the next lock: no edge
+    std::lock_guard<std::mutex> ls(state_mu_);
+    epoch_ = snapshot;
+  }
+};

@@ -1,0 +1,26 @@
+// HVL102 trigger: two functions take the same pair of mutexes in
+// opposite orders — the classic AB/BA deadlock.
+#include <mutex>
+
+struct Inverted {
+  std::mutex queue_mu_;
+  std::mutex state_mu_;
+  int depth_ = 0;
+  int epoch_ = 0;
+
+  void Producer() {
+    std::lock_guard<std::mutex> lq(queue_mu_);
+    std::lock_guard<std::mutex> ls(state_mu_);  // queue -> state
+    depth_++;
+    epoch_++;
+  }
+
+  void Reaper() {
+    std::lock_guard<std::mutex> ls(state_mu_);
+    if (depth_ > 0) {  // inner block between the acquisitions must not
+      epoch_++;        // release `ls` from the tracker's point of view
+    }
+    std::lock_guard<std::mutex> lq(queue_mu_);  // state -> queue: cycle!
+    depth_--;
+  }
+};

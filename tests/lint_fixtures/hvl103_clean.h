@@ -1,0 +1,18 @@
+// HVL103 clean: lifecycle flags are std::atomic; plain fields carry
+// names that say they are mutex-guarded state, not flags.
+#ifndef LINT_FIXTURE_HVL103_CLEAN_H
+#define LINT_FIXTURE_HVL103_CLEAN_H
+
+#include <atomic>
+
+class Loop {
+ public:
+  void RequestShutdown() { shutdown_requested_.store(true); }
+
+ private:
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<int> abort_count_{0};
+  bool work_available_ = false;  // guarded by cycle_mu_
+};
+
+#endif

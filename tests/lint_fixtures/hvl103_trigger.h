@@ -1,0 +1,14 @@
+// HVL103 trigger: a cross-thread lifecycle flag as a plain field.
+#ifndef LINT_FIXTURE_HVL103_TRIGGER_H
+#define LINT_FIXTURE_HVL103_TRIGGER_H
+
+class Loop {
+ public:
+  void RequestShutdown() { shutdown_requested_ = true; }  // API thread
+
+ private:
+  bool shutdown_requested_ = false;  // read by the background loop: race
+  int abort_count_ = 0;
+};
+
+#endif

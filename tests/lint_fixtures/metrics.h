@@ -1,0 +1,19 @@
+// HVL103 hot-path fixture: named like the real MetricsStore header so
+// the relaxed-ordering rule applies. A bare fetch_add defaults to
+// seq_cst — a full fence on the per-collective fast path.
+#ifndef LINT_FIXTURE_METRICS_H
+#define LINT_FIXTURE_METRICS_H
+
+#include <atomic>
+
+struct Counters {
+  std::atomic<long> ops{0};
+  std::atomic<long> bytes{0};
+
+  void Hit(long n) {
+    ops.fetch_add(1);  // seq_cst: HVL103
+    bytes.fetch_add(n, std::memory_order_relaxed);  // correct
+  }
+};
+
+#endif

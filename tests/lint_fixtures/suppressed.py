@@ -1,0 +1,25 @@
+"""Suppression-comment fixture: the same patterns as the triggers, each
+silenced by an explicit, reviewable hvd-lint comment."""
+import os
+
+import horovod_tpu as hvd
+
+
+def checkpoint_restore(state):
+    # deliberate: restore-then-broadcast happens before peers init
+    if hvd.rank() == 0:
+        hvd.broadcast(state, root_rank=0)  # hvd-lint: disable=HVL001
+    return state
+
+
+def tolerated(grads):
+    try:
+        return hvd.allreduce(grads)
+    # hvd-lint: disable=HVL003 — benchmark probe, failure means skip
+    except Exception:
+        return None
+
+
+def raw_read():
+    # hvd-lint: disable=HVL004 — bootstrap probe before registry import
+    return os.environ.get("HOROVOD_RANK")

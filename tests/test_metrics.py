@@ -386,3 +386,22 @@ def test_timed_step_wrapper_forwards_attributes():
     after = get_registry().histogram(
         "hvd_frontend_step_seconds", framework="jax").snapshot().count
     assert after == before + 1
+
+
+def test_exporter_malformed_env_degrades_to_warning(monkeypatch):
+    # "observability must never take down training": malformed values for
+    # ANY env var the exporter reads disable it with a warning, not a raise
+    from horovod_tpu.metrics.exporter import start_exporter_from_env
+    monkeypatch.setenv("HOROVOD_METRICS_PORT", "91x0")
+    assert start_exporter_from_env(registry=MetricsRegistry()) is None
+    monkeypatch.setenv("HOROVOD_METRICS_PORT", "0")
+    monkeypatch.setenv("HOROVOD_RANK", "r0")  # rank label parse
+    assert start_exporter_from_env(registry=MetricsRegistry()) is None
+    monkeypatch.delenv("HOROVOD_RANK")
+    # malformed rendezvous port: exporter still starts, publication is
+    # best-effort (warned, swallowed)
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", "12x")
+    exporter = start_exporter_from_env(registry=MetricsRegistry())
+    assert exporter is not None
+    exporter.stop()
