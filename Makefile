@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all lint lock-graph engine tsan asan ubsan sanitizers test test-fast clean
+.PHONY: all lint lock-graph engine top tsan asan ubsan sanitizers test test-fast clean
 
 all: engine
 
@@ -21,6 +21,12 @@ lock-graph:
 
 engine:
 	$(MAKE) -C horovod_tpu/engine
+
+# Live per-rank cluster view (hvd-top). Targets come from --targets /
+# the rendezvous KV / HOROVOD_METRICS_PORT; pass flags via TOP_ARGS,
+# e.g. `make top TOP_ARGS="--once --targets 127.0.0.1:9090"`.
+top:
+	$(PYTHON) -m horovod_tpu.obs.top $(TOP_ARGS)
 
 # Sanitizer matrix over the pure-C++ engine harness (tsan_harness.cc):
 # data races (tsan), heap errors + leaks (asan), undefined behavior

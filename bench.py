@@ -72,6 +72,9 @@ BASELINE_PER_DEVICE = 1656.82 / 16.0  # reference docs/benchmarks.rst:32-43
 RESNET_BATCH_CANDIDATES = tuple(
     int(b) for b in os.environ.get(
         "HVD_BENCH_RESNET_BATCHES", "128,256,512").split(",") if b)
+# One read for every consumer (_bert_bench, step_attribution) — two copies
+# of the default would drift.
+BERT_BATCH = int(os.environ.get("HVD_BENCH_BERT_BATCH", 32))
 
 RESNET50_PARAMS = pflops.RESNET50_PARAMS
 BERT_BASE_PARAMS = pflops.BERT_BASE_PARAMS
@@ -215,7 +218,7 @@ def _bert_bench(mesh, n_dev, use_flash=False):
     from horovod_tpu.models import BertBase
     from horovod_tpu.parallel import dp
 
-    per_chip = int(os.environ.get("HVD_BENCH_BERT_BATCH", 32))
+    per_chip = BERT_BATCH
     model = BertBase(max_len=BERT_SEQ, use_flash=use_flash)
     rs = np.random.RandomState(0)
     tokens = jnp.asarray(rs.randint(0, 30522, (8, BERT_SEQ)))
@@ -625,6 +628,10 @@ def main():
         print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
         engine_metrics = {"error": repr(e)}
 
+    # Measured ResNet per-step wall time, shared by the two overhead
+    # accountings below (one derivation, not two drifting copies).
+    resnet_step_sec = batch_per_chip / rate if rate > 0 else None
+
     # Flight-recorder overhead (ISSUE 5 acceptance: the always-on black box
     # must cost <1% of step time). ns/Record measured on-vs-off through the
     # C API; a collective costs ~5 lifecycle events, and an eager-path step
@@ -638,7 +645,7 @@ def main():
                                                          enabled=False)
                      for _ in range(3))
         records_per_step = 1000
-        step_sec = batch_per_chip / rate if rate > 0 else None
+        step_sec = resnet_step_sec
         delta_ns = max(0.0, on_ns - off_ns)
         flight_overhead = {
             "ns_per_record_on": round(on_ns, 2),
@@ -653,6 +660,22 @@ def main():
     except Exception as e:  # telemetry must not sink the bench
         print(f"flight-recorder bench failed: {e!r}", file=sys.stderr)
         flight_overhead = {"error": repr(e)}
+
+    # Step-time attribution (ISSUE 7 acceptance: per-model compute /
+    # exposed-comm / stall decomposition + critical-path rank, and the
+    # attributor's measured per-step cost against its 1% budget). The
+    # block is the input contract for the ROADMAP autotuner PR.
+    try:
+        from horovod_tpu.obs import attribution as obs_attribution
+        step_secs = {}
+        if resnet_step_sec:
+            step_secs["resnet50"] = resnet_step_sec
+        if bert_seq_per_sec > 0:
+            step_secs["bert_base"] = BERT_BATCH / bert_seq_per_sec
+        step_attribution = obs_attribution.bench_block(step_secs)
+    except Exception as e:  # telemetry must not sink the bench
+        print(f"step attribution failed: {e!r}", file=sys.stderr)
+        step_attribution = {"error": repr(e)}
 
     print(json.dumps({
         "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
@@ -673,6 +696,7 @@ def main():
         "collective_bytes_per_step_per_replica": coll_bytes,
         "engine_metrics": engine_metrics,
         "flight_recorder_overhead": flight_overhead,
+        "step_attribution": step_attribution,
         "device_kind": jax.devices()[0].device_kind,
     }))
 

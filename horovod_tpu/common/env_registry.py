@@ -141,6 +141,21 @@ _decl("HOROVOD_STRAGGLER_STDDEVS", "float", 3.0,
 _decl("HOROVOD_STRAGGLER_WINDOWS", "int", 3,
       "consecutive skewed windows before a rank is flagged")
 
+# -- step-time attribution / hvd-top --
+_decl("HOROVOD_STEP_ATTRIBUTION", "bool", True,
+      "per-step time attribution + anomaly detection fed by the frontend "
+      "step timer (0 disables the attributor and the engine step marks)")
+_decl("HOROVOD_ANOMALY_STDDEVS", "float", 4.0,
+      "step-time spike threshold in rolling sigmas before an anomaly "
+      "event fires (structured log + automatic flight dump)")
+_decl("HOROVOD_ANOMALY_WINDOW", "int", 64,
+      "rolling window of recent step times behind anomaly detection")
+_decl("HOROVOD_ATTRIBUTION_EVERY", "int", 10,
+      "steps between flight-ring attribution refreshes (per-step "
+      "decomposition gauge export cadence; 0 = frontend timing only)")
+_decl("HOROVOD_TOP_INTERVAL", "float", 2.0,
+      "hvd-top live-view refresh interval in seconds")
+
 # -- flight recorder / post-mortem --
 _decl("HOROVOD_FLIGHT_RECORDER_SIZE", "int", 2048,
       "per-collective event ring capacity (0 disables recording)", "cpp")

@@ -55,7 +55,10 @@ _lib_lock = threading.Lock()
 #    StatusType::CORRUPTED (6) -> HorovodCorruptedError.
 # 7: hvdtpu_flight_dump + hvdtpu_bench_flight_record (collective flight
 #    recorder); Request wire format carries a signature hash.
-ABI_VERSION = 7
+# 8: hvdtpu_step_begin/hvdtpu_step_end — frontend step-boundary marks
+#    recorded into the flight ring (step-time attribution); DONE flight
+#    events carry the response's exec-callback span (us) in aux.
+ABI_VERSION = 8
 
 
 def _lib_path() -> Path:
@@ -192,6 +195,10 @@ def load_library():
         lib.hvdtpu_bench_flight_record.restype = ctypes.c_double
         lib.hvdtpu_bench_flight_record.argtypes = [ctypes.c_int64,
                                                    ctypes.c_int32]
+        lib.hvdtpu_step_begin.restype = ctypes.c_int32
+        lib.hvdtpu_step_begin.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.hvdtpu_step_end.restype = ctypes.c_int32
+        lib.hvdtpu_step_end.argtypes = [ctypes.c_int64, ctypes.c_int64]
         lib.hvdtpu_abort.restype = ctypes.c_int32
         lib.hvdtpu_abort.argtypes = [ctypes.c_int64, ctypes.c_char_p]
         lib.hvdtpu_set_fault_spec.restype = ctypes.c_int32
@@ -382,6 +389,19 @@ class EngineSession:
             return self._lib.hvdtpu_flight_dump(session, d, buf, size)
 
         return self._json_call(call)
+
+    def step_begin(self, step_id: int):
+        """Record a frontend step-boundary STEP_BEGIN mark (flight ring)
+        for the step-time attribution engine. One lock-free flight Record —
+        cheap enough for every train-step invocation. Driven automatically
+        by the ``hvd_frontend_step_seconds`` step-timer wrapper."""
+        if not self._destroyed:
+            self._lib.hvdtpu_step_begin(self._session, step_id)
+
+    def step_end(self, step_id: int):
+        """Record the matching STEP_END mark (see :meth:`step_begin`)."""
+        if not self._destroyed:
+            self._lib.hvdtpu_step_end(self._session, step_id)
 
     # -- data plane hookup --------------------------------------------------
 

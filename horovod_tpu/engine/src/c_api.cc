@@ -46,7 +46,10 @@ extern "C" {
 //    StatusType::CORRUPTED (6) for CRC-detected wire corruption.
 // 7: hvdtpu_flight_dump + hvdtpu_bench_flight_record (collective flight
 //    recorder); Request wire format carries a signature hash.
-int32_t hvdtpu_abi_version() { return 7; }
+// 8: hvdtpu_step_begin/hvdtpu_step_end — frontend step-boundary marks
+//    recorded into the flight ring (step-time attribution); DONE flight
+//    events carry the response's exec-callback span (us) in aux.
+int32_t hvdtpu_abi_version() { return 8; }
 
 namespace {
 
@@ -112,6 +115,27 @@ int64_t hvdtpu_flight_dump(int64_t session, const char* dir, char* buf,
 // overhead entry); enabled=0 times the disabled early-out.
 double hvdtpu_bench_flight_record(int64_t iters, int32_t enabled) {
   return BenchFlightRecord(iters, enabled != 0);
+}
+
+// Frontend step-boundary marks: STEP_BEGIN/STEP_END flight events whose
+// aux carries the caller's step id. Driven by the Python step timer
+// (horovod_tpu.metrics timed_step) around every train-step invocation so
+// the attribution engine can decompose each step window into compute /
+// exposed-comm / negotiation-stall / host time. One lock-free flight
+// Record per call — cheap enough for every step. Returns 0, or -1 on an
+// invalid session.
+int32_t hvdtpu_step_begin(int64_t session, int64_t step_id) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  e->StepMark(/*begin=*/true, step_id);
+  return 0;
+}
+
+int32_t hvdtpu_step_end(int64_t session, int64_t step_id) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  e->StepMark(/*begin=*/false, step_id);
+  return 0;
 }
 
 // Host data-plane microbenchmark: payload bytes/s of the SUM combine

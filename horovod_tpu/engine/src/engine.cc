@@ -279,6 +279,17 @@ Status Engine::PollHandle(int64_t handle, bool* done, std::string* error) {
   return handles_.Poll(handle, done, error);
 }
 
+void Engine::StepMark(bool begin, int64_t step_id) {
+  flight_.Record(begin ? FlightPhase::STEP_BEGIN : FlightPhase::STEP_END,
+                 "", /*name_hash=*/0,
+                 cycle_id_.load(std::memory_order_relaxed),
+                 /*op_type=*/-1, /*dtype=*/-1, /*payload_bytes=*/0,
+                 /*status=*/0, /*aux=*/step_id);
+  if (!begin) {
+    metrics_.steps_marked.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
 Status Engine::WaitHandle(int64_t handle, double timeout_sec) {
   return handles_.Wait(handle, timeout_sec);
 }
@@ -400,6 +411,10 @@ void Engine::PerformOperation(const Response& response) {
   std::string err = response.error_message;
   StatusType err_code = StatusType::UNKNOWN_ERROR;
   int32_t rc = 0;
+  // Exec-callback wall time of this (possibly fused) response, carried as
+  // the DONE events' aux so the attribution engine can price each
+  // collective's exec span even after the EXEC event fell off the ring.
+  int64_t exec_span_us = 0;
   const int64_t cyc = cycle_id_.load(std::memory_order_relaxed);
   // Per-tensor payload bytes from the response metadata, one pass over
   // the flattened dims (ERROR responses carry no dtypes/shapes — bytes
@@ -463,9 +478,9 @@ void Engine::PerformOperation(const Response& response) {
       std::string json = ResponseToJson(response);
       auto t0 = std::chrono::steady_clock::now();
       rc = execute_fn_(json.c_str(), execute_user_data_);
-      metrics_.exec_us.Observe(
-          std::chrono::duration_cast<std::chrono::microseconds>(
-              std::chrono::steady_clock::now() - t0).count());
+      exec_span_us = std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::steady_clock::now() - t0).count();
+      metrics_.exec_us.Observe(exec_span_us);
       if (rc != 0) {
         std::string names;
         for (const auto& n : response.tensor_names) {
@@ -501,7 +516,8 @@ void Engine::PerformOperation(const Response& response) {
       flight_.Record(FlightPhase::DONE, name, FlightNameHash(name), cyc,
                      static_cast<int32_t>(response.type), tensor_dtype(i),
                      tensor_bytes(i),
-                     err.empty() ? 0 : static_cast<int32_t>(err_code));
+                     err.empty() ? 0 : static_cast<int32_t>(err_code),
+                     /*aux=*/exec_span_us);
     }
     TensorTableEntry entry;
     auto st = queue_.GetTensorEntry(name, &entry);

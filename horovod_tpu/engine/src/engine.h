@@ -100,6 +100,14 @@ class Engine {
   Status PollHandle(int64_t handle, bool* done, std::string* error);
   Status WaitHandle(int64_t handle, double timeout_sec);
 
+  // Frontend step-boundary mark (driven by the hvd_frontend_step_seconds
+  // wrapper): black-boxed into the flight ring as STEP_BEGIN/STEP_END so
+  // the attribution engine (horovod_tpu/obs/attribution.py) can split each
+  // collective's negotiate/exec time into overlapped-with-compute vs
+  // exposed against the step window. Lock-free (one flight Record); safe
+  // from any thread.
+  void StepMark(bool begin, int64_t step_id);
+
   void RequestShutdown();
   // Fast abort: fail every pending and future collective on every rank
   // within one coordination cycle (the abort flag rides the next cycle's

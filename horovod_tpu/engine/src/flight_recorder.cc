@@ -20,6 +20,8 @@ const char* FlightPhaseName(FlightPhase p) {
     case FlightPhase::DONE: return "DONE";
     case FlightPhase::CYCLE: return "CYCLE";
     case FlightPhase::DESYNC: return "DESYNC";
+    case FlightPhase::STEP_BEGIN: return "STEP_BEGIN";
+    case FlightPhase::STEP_END: return "STEP_END";
   }
   return "UNKNOWN";
 }

@@ -44,9 +44,14 @@ enum class FlightPhase : int32_t {
   NEGOTIATE = 1,  // popped into a coordination cycle
   FUSE = 2,       // response received (aux = tensors in the fused batch)
   EXEC = 3,       // data-plane execution started
-  DONE = 4,       // handle completed (status carries the failure class)
+  DONE = 4,       // handle completed (status carries the failure class;
+                  // aux = the response's exec-callback span in us, so the
+                  // attribution engine can price each collective's exec
+                  // without pairing EXEC/DONE across ring wrap)
   CYCLE = 5,      // coordination-cycle sync anchor (name empty)
   DESYNC = 6,     // signature/metadata mismatch error named this tensor
+  STEP_BEGIN = 7, // frontend step-boundary mark (name empty, aux = step id)
+  STEP_END = 8,   // frontend step-boundary mark (name empty, aux = step id)
 };
 
 const char* FlightPhaseName(FlightPhase p);

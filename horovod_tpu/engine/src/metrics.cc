@@ -103,6 +103,7 @@ std::string MetricsStore::SnapshotJson(int rank) const {
   AppendKV(&out, "connect_retries", v(connect_retries), &first);
   AppendKV(&out, "crc_failures", v(crc_failures), &first);
   AppendKV(&out, "faults_injected", v(faults_injected), &first);
+  AppendKV(&out, "steps_marked", v(steps_marked), &first);
   out += "},\"gauges\":{";
   first = true;
   AppendKV(&out, "queue_depth", v(queue_depth), &first);

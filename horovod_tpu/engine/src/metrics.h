@@ -86,6 +86,7 @@ struct MetricsStore {
   std::atomic<int64_t> connect_retries{0};      // failed connect attempts
   std::atomic<int64_t> crc_failures{0};         // frames rejected by CRC32C
   std::atomic<int64_t> faults_injected{0};      // HOROVOD_FAULT_SPEC firings
+  std::atomic<int64_t> steps_marked{0};         // frontend STEP_END marks
 
   // -- gauges ---------------------------------------------------------------
   std::atomic<int64_t> queue_depth{0};          // staged, not yet negotiated

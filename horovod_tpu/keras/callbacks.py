@@ -103,14 +103,24 @@ class MetricsCallback(keras.callbacks.Callback):
         self._epochs = self._registry.counter(
             "hvd_frontend_epochs_total", framework="keras")
         self._t0 = None
+        # step attributor (engine STEP marks + anomaly detection) — only on
+        # the default registry; a test-supplied registry stays isolated
+        self._attr = _metrics._get_attributor() if registry is None else None
+        self._sid = 0
 
     def on_train_batch_begin(self, batch, logs=None):
+        if self._attr is not None:
+            self._sid = self._attr.next_step()
+            self._attr.step_begin(self._sid)
         self._t0 = time.perf_counter()
 
     def on_train_batch_end(self, batch, logs=None):
         if self._t0 is not None:
-            self._hist.observe(time.perf_counter() - self._t0)
+            dt = time.perf_counter() - self._t0
+            self._hist.observe(dt)
             self._t0 = None
+            if self._attr is not None:
+                self._attr.step_end(self._sid, dt)
         self._steps.inc()
 
     def on_epoch_end(self, epoch, logs=None):

@@ -47,10 +47,24 @@ STEP_SECONDS = "hvd_frontend_step_seconds"
 STEPS_TOTAL = "hvd_frontend_steps_total"
 
 
+def _get_attributor():
+    """The step attributor behind the frontend timers, or None when
+    disabled (HOROVOD_STEP_ATTRIBUTION=0). Late import: obs.attribution
+    imports this package."""
+    from horovod_tpu.obs.attribution import get_attributor
+    return get_attributor()
+
+
 class _TimedStep:
     """Wraps a (jitted) step callable: records wall time per invocation
     into the shared step-time histogram while forwarding everything else
-    (``.lower``, AOT attributes) to the wrapped function."""
+    (``.lower``, AOT attributes) to the wrapped function.
+
+    Also the frontend half of step-time attribution: each invocation is
+    bracketed with engine STEP_BEGIN/STEP_END flight marks and fed to the
+    rolling anomaly detector (horovod_tpu.obs.attribution) — one lock-free
+    engine record each side plus a deque append, cheap enough for every
+    step."""
 
     def __init__(self, fn, framework: str):
         self._fn = fn
@@ -58,12 +72,26 @@ class _TimedStep:
                                               framework=framework)
         self._steps = get_registry().counter(STEPS_TOTAL,
                                              framework=framework)
+        self._attr = None
+        self._attr_resolved = False
 
     def __call__(self, *args, **kwargs):
+        if not self._attr_resolved:
+            # resolved on first step, not at wrap time: the attributor
+            # needs the engine session, which init() creates later
+            self._attr = _get_attributor()
+            self._attr_resolved = True
+        attr = self._attr
+        sid = attr.next_step() if attr is not None else 0
+        if attr is not None:
+            attr.step_begin(sid)
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
-        self._hist.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._hist.observe(dt)
         self._steps.inc()
+        if attr is not None:
+            attr.step_end(sid, dt)
         return out
 
     def __getattr__(self, item):
@@ -88,10 +116,39 @@ def timed_step(fn, framework: str):
 def record_step(framework: str, seconds: float,
                 registry: Optional[MetricsRegistry] = None):
     """Record one frontend step duration (used by frontends that own their
-    own timing, e.g. the torch optimizer and the keras callback)."""
+    own timing, e.g. the torch optimizer and the keras callback).
+
+    On the default registry the duration also feeds the step attributor's
+    rolling anomaly detector; these frontends can't bracket the step with
+    engine marks (they time after the fact), so they get anomaly events
+    and gauges but no flight-ring windows."""
     reg = registry if registry is not None else get_registry()
     reg.histogram(STEP_SECONDS, framework=framework).observe(seconds)
     reg.counter(STEPS_TOTAL, framework=framework).inc()
+    if registry is None:
+        attr = _get_attributor()
+        if attr is not None:
+            attr.observe(seconds)
+
+
+def snapshot_value(snapshot: dict, name: str, **labels) -> Optional[float]:
+    """Scalar value of a counter/gauge family in a ``/metrics.json``
+    snapshot (summed over samples matching ``labels`` — the families
+    ``hvd-top`` and the driver read carry one sample each). None when the
+    family is absent or no sample matches."""
+    total, found = 0.0, False
+    want = {str(k): str(v) for k, v in labels.items()}
+    for m in snapshot.get("metrics", []):
+        if m.get("name") != name:
+            continue
+        for s in m.get("samples", []):
+            if "value" not in s:
+                continue  # histogram family under a scalar lookup
+            got = s.get("labels", {})
+            if all(got.get(k) == v for k, v in want.items()):
+                total += float(s["value"])
+                found = True
+    return total if found else None
 
 
 def step_stats(snapshot: dict) -> Optional[tuple]:

@@ -21,6 +21,11 @@ def _escape_label(v: str) -> str:
         .replace('"', '\\"')
 
 
+def _escape_help(v: str) -> str:
+    # HELP docstrings escape only backslash and newline (no quotes)
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(pairs: Iterable[Tuple[str, str]]) -> str:
     items = [f'{k}="{_escape_label(v)}"' for k, v in pairs]
     return "{" + ",".join(items) + "}" if items else ""
@@ -53,8 +58,12 @@ def render(metrics: Iterable[Metric],
     for m in metrics:
         if not m.samples:
             continue
-        if m.help:
-            lines.append(f"# HELP {m.name} {m.help}")
+        # Every family gets both comment lines — real Prometheus scrapers
+        # (and promtool check metrics) expect HELP before TYPE for each
+        # metric, so a help-less registration still emits a derived one.
+        help_text = m.help or \
+            m.name.replace("_", " ") + f" ({m.kind}, no help registered)"
+        lines.append(f"# HELP {m.name} {_escape_help(help_text)}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         for sample_labels, value in m.samples:
             if isinstance(value, HistogramValue):

@@ -217,6 +217,49 @@ class MetricsRegistry:
 # on export (Prometheus convention).
 _US_HISTOGRAMS = {"cycle_us": "cycle_seconds", "exec_us": "exec_seconds"}
 
+# HELP docstrings for the C++ MetricsStore families (the engine snapshot
+# carries bare name/value pairs; the wire format wants a doc per family).
+# Keys are the post-mapping names without the hvd_engine_ prefix / _total
+# suffix. Anything the engine adds later falls back to a derived string in
+# prom.render, so this map can lag a C++ release without breaking scrapes.
+_ENGINE_HELP = {
+    "enqueued": "tensors submitted to the engine queue",
+    "allreduce_ops": "completed allreduce responses",
+    "allgather_ops": "completed allgather responses",
+    "broadcast_ops": "completed broadcast responses",
+    "alltoall_ops": "completed alltoall responses",
+    "barrier_ops": "completed barrier responses",
+    "join_ops": "completed join responses",
+    "error_responses": "responses delivered as errors",
+    "allreduce_bytes": "payload bytes moved by allreduce",
+    "allgather_bytes": "payload bytes moved by allgather",
+    "broadcast_bytes": "payload bytes moved by broadcast",
+    "alltoall_bytes": "payload bytes moved by alltoall",
+    "cache_hits": "response-cache hits in the coordination loop",
+    "cache_misses": "response-cache misses (full negotiation)",
+    "cache_invalidations": "response-cache entries invalidated",
+    "cache_evictions": "response-cache capacity evictions",
+    "cycles": "coordination cycles run",
+    "responses": "responses executed (fused batches count once)",
+    "fused_responses": "responses that fused more than one tensor",
+    "fused_tensors": "tensors carried by fused responses",
+    "stall_warnings": "stall-inspector warning scans that fired",
+    "stalled_tensors": "tensors named in stall warnings",
+    "data_ring_ops": "data-plane ops routed over the ring",
+    "data_star_ops": "data-plane ops routed over the star",
+    "aborts": "fast-abort protocol activations",
+    "connect_retries": "failed transport connect attempts",
+    "crc_failures": "frames rejected by CRC32C",
+    "faults_injected": "HOROVOD_FAULT_SPEC firings",
+    "steps_marked": "frontend STEP_END marks (step attribution)",
+    "queue_depth": "tensors staged but not yet negotiated",
+    "cache_size": "response-cache entries resident",
+    "fusion_batch_tensors": "tensors per fused response",
+    "response_bytes": "payload bytes per response",
+    "cycle_seconds": "coordination-cycle latency",
+    "exec_seconds": "data-plane exec-callback latency",
+}
+
 
 def engine_collector(session) -> Callable[[], List[Metric]]:
     """Collector pulling ``session.metrics()`` (the C++ MetricsStore
@@ -231,10 +274,12 @@ def engine_collector(session) -> Callable[[], List[Metric]]:
             return []
         out: List[Metric] = []
         for k, v in sorted(snap.get("counters", {}).items()):
-            out.append(Metric(f"hvd_engine_{k}_total", "counter", "",
+            out.append(Metric(f"hvd_engine_{k}_total", "counter",
+                              _ENGINE_HELP.get(k, ""),
                               [((), float(v))]))
         for k, v in sorted(snap.get("gauges", {}).items()):
-            out.append(Metric(f"hvd_engine_{k}", "gauge", "",
+            out.append(Metric(f"hvd_engine_{k}", "gauge",
+                              _ENGINE_HELP.get(k, ""),
                               [((), float(v))]))
         for k, h in sorted(snap.get("histograms", {}).items()):
             name, scale = k, 1.0
@@ -243,7 +288,8 @@ def engine_collector(session) -> Callable[[], List[Metric]]:
             hv = HistogramValue(
                 tuple(b * scale for b in h["bounds"]),
                 tuple(h["counts"]), h["sum"] * scale, h["count"])
-            out.append(Metric(f"hvd_engine_{name}", "histogram", "",
+            out.append(Metric(f"hvd_engine_{name}", "histogram",
+                              _ENGINE_HELP.get(name, ""),
                               [((), hv)]))
         return out
 

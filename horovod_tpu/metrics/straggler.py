@@ -101,6 +101,25 @@ class StragglerDetector:
             self._export(r, (t - med) / sigma if sigma > 0 else 0.0)
         return events
 
+    def reset(self):
+        """Drop all rolling state (streaks, flagged episodes, scores) and
+        zero the exported per-rank gauges.
+
+        The elastic driver calls this on every topology generation change:
+        after a resize the rank→host mapping shifts, so pre-resize samples
+        and streaks would be charged to whichever rank inherited the
+        number — a healthy worker could be flagged on another machine's
+        history."""
+        for r in list(self.last_scores):
+            if self._registry is not None:
+                self._registry.gauge("hvd_straggler_score",
+                                     rank=str(r)).set(0.0)
+                self._registry.gauge("hvd_straggler_flagged",
+                                     rank=str(r)).set(0.0)
+        self._streak.clear()
+        self._flagged.clear()
+        self.last_scores.clear()
+
     @property
     def flagged(self) -> set:
         """Ranks currently in a flagged episode."""

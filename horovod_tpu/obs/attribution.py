@@ -1,0 +1,618 @@
+"""Step-time attribution: decompose every training step into compute /
+exposed-comm / negotiation-stall / host time, cross-rank.
+
+The frontend step timer (``hvd_frontend_step_seconds`` wrapper,
+``horovod_tpu.metrics.timed_step``) brackets every train-step invocation
+with engine step marks (``hvdtpu_step_begin/end`` → STEP_BEGIN/STEP_END
+flight events), and the flight recorder already black-boxes every
+collective's lifecycle with per-response exec spans. This module turns
+those two streams into the per-step answer the ROADMAP's perf items need
+— total comm time is not the decisive metric, *exposed* (non-overlapped)
+comm time on the critical path is (arXiv:1810.11112).
+
+Decomposition model (documented in docs/DESIGN.md "Step attribution"):
+within one step window ``[begin, end]`` on one rank,
+
+- while the frontend is still **enqueueing** work it is also driving
+  compute (dispatching the forward/backward that produces the next
+  gradient), so everything up to the window's last ENQUEUE is
+  ``compute``;
+- after the last ENQUEUE the frontend only waits. Tail time covered by a
+  collective's exec span is ``exposed_comm`` (comm the step actually
+  waited on — the critical-path quantity); tail time spent between
+  ENQUEUE and EXEC with no exec running is ``stall`` (negotiation /
+  straggler wait); the remainder of the tail is ``host`` (result fetch,
+  Python overhead);
+- exec spans that overlap the enqueueing phase are ``overlapped_comm`` —
+  comm the engine hid behind compute (free).
+
+``compute + exposed_comm + stall + host == step`` exactly. A pure-jit
+step (no engine-visible collectives — XLA's latency-hiding scheduler owns
+the overlap) decomposes as 100% compute, which is honest: the engine can
+only attribute the comm it routes.
+
+Cross-rank, step windows pair by step id, clocks align via the flight
+analyzer's shared CYCLE anchors, and the rank whose window ends last on
+the aligned axis is the step's **critical-path rank** — its last-completing
+collective is the gating tensor.
+
+The ``step_attribution`` record this module emits (:func:`attribute`,
+:func:`bench_block`) is the input contract for the ROADMAP autotuner PR:
+stable keys, seconds, fractions of step time.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import Counter, deque
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.common.env_registry import (env_bool, env_float, env_int,
+                                             env_str)
+from horovod_tpu.profiler import flight as flight_mod
+
+# Windows shorter than this many samples never fire the anomaly detector —
+# mean/sigma over a handful of warmup steps is noise, not a baseline.
+MIN_ANOMALY_SAMPLES = 8
+
+# Sigma floor as a fraction of the rolling mean, mirroring the straggler
+# detector: a perfectly uniform step trace (sigma -> 0) must not flag
+# micro-jitter.
+MIN_REL_SIGMA = 0.05
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic (all spans are (lo, hi) with lo <= hi, microseconds)
+
+
+def _union(spans: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Merge overlapping/touching spans; drops empty ones."""
+    out: List[Tuple[float, float]] = []
+    for lo, hi in sorted(s for s in spans if s[1] > s[0]):
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+def _span_len(spans: List[Tuple[float, float]]) -> float:
+    return sum(hi - lo for lo, hi in spans)
+
+
+def _clip(spans: List[Tuple[float, float]], lo: float,
+          hi: float) -> List[Tuple[float, float]]:
+    return [(max(s, lo), min(t, hi)) for s, t in spans
+            if t > lo and s < hi]
+
+
+def _subtract(spans: List[Tuple[float, float]],
+              cut: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """``spans`` minus ``cut`` (both pre-unioned)."""
+    out: List[Tuple[float, float]] = []
+    for lo, hi in spans:
+        cur = lo
+        for clo, chi in cut:
+            if chi <= cur or clo >= hi:
+                continue
+            if clo > cur:
+                out.append((cur, clo))
+            cur = max(cur, chi)
+            if cur >= hi:
+                break
+        if cur < hi:
+            out.append((cur, hi))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# flight-dump scanning
+
+
+def step_windows(dump: dict) -> List[dict]:
+    """Completed step windows of one rank's flight dump: STEP_BEGIN/END
+    events paired by step id (aux). An unmatched BEGIN (step still running
+    at dump time) or a BEGIN that fell off the ring is skipped."""
+    begins: Dict[int, float] = {}
+    out: List[dict] = []
+    for e in dump.get("events", []):
+        phase = e.get("phase")
+        if phase == "STEP_BEGIN":
+            begins[int(e.get("aux", -1))] = float(e.get("ts_us", 0))
+        elif phase == "STEP_END":
+            sid = int(e.get("aux", -1))
+            begin = begins.pop(sid, None)
+            if begin is not None:
+                out.append({"step": sid, "begin_us": begin,
+                            "end_us": float(e.get("ts_us", 0))})
+    return sorted(out, key=lambda w: w["step"])
+
+
+def _collective_spans(events: List[dict]):
+    """One pass over a rank's event stream → the raw material of the
+    decomposition: ENQUEUE timestamps, negotiation-wait spans
+    (ENQUEUE/NEGOTIATE → EXEC) and exec spans (EXEC → DONE/DESYNC, with
+    the DONE event's exec-span aux reconstructing a begin whose EXEC fell
+    off the ring)."""
+    enq: List[float] = []
+    neg_open: Dict[str, float] = {}
+    exec_open: Dict[str, float] = {}
+    negs: List[Tuple[float, float]] = []
+    execs: List[Tuple[float, float, str]] = []
+    for ev in sorted(events, key=lambda x: x.get("i", 0)):
+        phase = ev.get("phase", "")
+        name = ev.get("name", "")
+        if not name:
+            continue  # CYCLE / STEP marks carry no collective lifecycle
+        ts = float(ev.get("ts_us", 0))
+        if phase == "ENQUEUE":
+            enq.append(ts)
+            neg_open[name] = ts
+        elif phase == "NEGOTIATE":
+            neg_open.setdefault(name, ts)
+        elif phase == "EXEC":
+            start = neg_open.pop(name, None)
+            if start is not None:
+                negs.append((start, ts))
+            exec_open[name] = ts
+        elif phase in ("DONE", "DESYNC"):
+            start = exec_open.pop(name, None)
+            if start is None:
+                aux = float(ev.get("aux", 0))
+                start = ts - aux if phase == "DONE" and aux > 0 else ts
+            execs.append((start, ts, name))
+            waited = neg_open.pop(name, None)
+            if waited is not None:
+                negs.append((waited, min(start, ts)))
+    return enq, negs, execs
+
+
+def _decompose_window(w: dict, enq: List[float],
+                      negs: List[Tuple[float, float]],
+                      execs: List[Tuple[float, float, str]]) -> dict:
+    """One rank's decomposition of one step window (the model in the
+    module docstring). All durations in seconds; the four buckets sum to
+    ``step_s`` exactly."""
+    b, e = w["begin_us"], w["end_us"]
+    step_us = e - b
+    comm = _union([(s, t) for s, t, _ in execs])
+    comm = _clip(comm, b, e)
+    comm_busy = _span_len(comm)
+    in_enq = [t for t in enq if b <= t <= e]
+    if in_enq:
+        active_until = max(in_enq)
+    elif comm or _clip(_union(list(negs)), b, e):
+        # collectives from an earlier enqueue spill into this window: the
+        # frontend was waiting on them from the start
+        active_until = b
+    else:
+        # nothing engine-visible in the window — a pure-jit step (XLA owns
+        # the overlap) is honest 100% compute, not host
+        active_until = e
+    tail_us = e - active_until
+    exposed = _span_len(_clip(comm, active_until, e))
+    neg_u = _clip(_union(list(negs)), active_until, e)
+    stall = _span_len(_subtract(neg_u, comm))
+    host = max(0.0, tail_us - exposed - stall)
+    compute = step_us - tail_us
+    gating = None
+    gating_ts = None
+    for s, t, name in execs:
+        if b < t <= e and (gating_ts is None or t > gating_ts):
+            gating_ts, gating = t, name
+    sec = 1e-6
+    return {
+        "step": w["step"],
+        "step_s": round(step_us * sec, 6),
+        "compute_s": round(compute * sec, 6),
+        "exposed_comm_s": round(exposed * sec, 6),
+        "stall_s": round(stall * sec, 6),
+        "host_s": round(host * sec, 6),
+        "comm_busy_s": round(comm_busy * sec, 6),
+        "overlapped_comm_s": round((comm_busy - exposed) * sec, 6),
+        "collectives": sum(1 for s, t, _ in execs if t > b and s < e),
+        "gating_tensor": gating,
+    }
+
+
+def decompose_rank(dump: dict) -> List[dict]:
+    """Per-step decomposition of one rank's flight dump (rank-local
+    clock)."""
+    enq, negs, execs = _collective_spans(dump.get("events", []))
+    return [_decompose_window(w, enq, negs, execs)
+            for w in step_windows(dump)]
+
+
+def attribute(dumps: Dict[int, dict]) -> dict:
+    """Cross-rank step attribution over one job's per-rank flight dumps
+    (the ``flight_rank<R>.json`` files, or in-memory ``flight_dump()``
+    dicts keyed by rank).
+
+    Reuses the flight analyzer's CYCLE-anchor clock alignment so per-rank
+    step windows land on one axis; the rank whose window ends last is the
+    step's critical-path rank. Returns the machine-readable
+    ``step_attribution`` record::
+
+        {"clock_offsets_us": {rank: off},
+         "steps": [{"step", "critical_rank", "gating_tensor",
+                    "step_skew_us", "ranks": {rank: decomposition}}],
+         "summary": {"steps", "step_seconds_mean", "compute_frac",
+                     "exposed_comm_frac", "stall_frac", "host_frac",
+                     "overlapped_comm_frac", "critical_rank_counts",
+                     "gating_tensor_counts"}}
+    """
+    offsets = flight_mod.align_clocks(dumps)
+    by_step: Dict[int, Dict[int, dict]] = {}
+    for r, d in sorted(dumps.items()):
+        enq, negs, execs = _collective_spans(d.get("events", []))
+        for w in step_windows(d):
+            dec = _decompose_window(w, enq, negs, execs)
+            dec["rank"] = r
+            dec["end_aligned_us"] = round(
+                w["end_us"] + offsets.get(r, 0.0), 1)
+            by_step.setdefault(dec["step"], {})[r] = dec
+    steps: List[dict] = []
+    for sid, by_rank in sorted(by_step.items()):
+        ends = {r: d["end_aligned_us"] for r, d in by_rank.items()}
+        crit = max(ends, key=ends.get)
+        steps.append({
+            "step": sid,
+            "critical_rank": crit,
+            "gating_tensor": by_rank[crit]["gating_tensor"],
+            "step_skew_us": round(max(ends.values()) - min(ends.values()),
+                                  1),
+            "ranks": by_rank,
+        })
+    return {
+        "clock_offsets_us": {r: round(o, 1) for r, o in offsets.items()},
+        "steps": steps,
+        "summary": summarize(steps),
+    }
+
+
+def summarize(steps: List[dict]) -> dict:
+    """Fleet-level rollup of per-step records (fractions of total step
+    time, critical-path and gating-tensor counts)."""
+    decs = [d for s in steps for d in s["ranks"].values()]
+    total = sum(d["step_s"] for d in decs)
+    if not decs or total <= 0:
+        return {"steps": len(steps), "step_seconds_mean": None,
+                "compute_frac": None, "exposed_comm_frac": None,
+                "stall_frac": None, "host_frac": None,
+                "overlapped_comm_frac": None,
+                "critical_rank_counts": {}, "gating_tensor_counts": {}}
+
+    def frac(key):
+        return round(sum(d[key] for d in decs) / total, 4)
+
+    return {
+        "steps": len(steps),
+        "step_seconds_mean": round(total / len(decs), 6),
+        "compute_frac": frac("compute_s"),
+        "exposed_comm_frac": frac("exposed_comm_s"),
+        "stall_frac": frac("stall_s"),
+        "host_frac": frac("host_s"),
+        "overlapped_comm_frac": frac("overlapped_comm_s"),
+        "critical_rank_counts": dict(Counter(
+            s["critical_rank"] for s in steps)),
+        "gating_tensor_counts": dict(Counter(
+            s["gating_tensor"] for s in steps
+            if s["gating_tensor"] is not None)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# live attribution + anomaly detection
+
+
+class StepAttributor:
+    """Process-local rolling step-time attribution, fed by the frontend
+    step timer (one :meth:`step_begin`/:meth:`step_end` pair per train
+    step, or plain :meth:`observe` for frontends that own their timing).
+
+    Three jobs per step, all cheap enough for the hot path:
+
+    - bracket the step with engine STEP marks (one lock-free flight
+      Record each) so the flight ring carries the attribution windows;
+    - rolling anomaly detection: a step exceeding
+      ``mean + HOROVOD_ANOMALY_STDDEVS * sigma`` of the rolling window
+      fires a structured log event, bumps ``hvd_step_anomaly_total`` and
+      — when ``HOROVOD_FLIGHT_DIR`` is set — triggers an automatic flight
+      dump, so the spike's post-mortem evidence is on disk before the
+      ring wraps;
+    - every ``HOROVOD_ATTRIBUTION_EVERY`` steps, decompose the latest
+      completed window from the flight ring and export the result as
+      ``hvd_step_*_seconds`` / ``hvd_step_exposed_comm_ratio`` gauges —
+      what ``hvd-top`` and the elastic driver scrape. The refresh runs in
+      a background thread (a full-ring dump costs tens of ms); the
+      training thread only pays the thread kick.
+    """
+
+    def __init__(self, registry=None, engine=None, k: Optional[float] = None,
+                 window: Optional[int] = None,
+                 refresh_every: Optional[int] = None,
+                 flight_dir: Optional[str] = None,
+                 use_engine: bool = True):
+        if registry is None:
+            from horovod_tpu.metrics.registry import get_registry
+            registry = get_registry()
+        self._registry = registry
+        self._engine = engine
+        self._use_engine = use_engine
+        self._k = k if k is not None else env_float("HOROVOD_ANOMALY_STDDEVS")
+        self._window: deque = deque(
+            maxlen=window if window is not None
+            else max(MIN_ANOMALY_SAMPLES, env_int("HOROVOD_ANOMALY_WINDOW")))
+        self._every = refresh_every if refresh_every is not None \
+            else env_int("HOROVOD_ATTRIBUTION_EVERY")
+        self._flight_dir = flight_dir if flight_dir is not None \
+            else (env_str("HOROVOD_FLIGHT_DIR") or "")
+        self._steps = 0
+        # O(1) rolling mean/sigma over the window (statistics.pstdev's
+        # exact-rational arithmetic costs ~300us per call — two orders of
+        # magnitude over the whole per-step budget). Running float sums
+        # drift as evicted values are subtracted back out; recomputed
+        # exactly every window-length steps to bound the error.
+        self._sum = 0.0
+        self._sumsq = 0.0
+        self._steps_observed = 0
+        self._lock = threading.Lock()
+        # periodic flight-ring decomposition runs OFF the training thread:
+        # a full-ring dump + parse costs tens of ms, which would blow the
+        # <1% step budget if paid inline even once every _every steps
+        self._refresh_inflight = threading.Event()
+        # instrument handles are resolved once — the per-step path must not
+        # pay registry label-key lookups
+        self._g_last = self._registry.gauge(
+            "hvd_step_seconds_last",
+            help="wall time of the most recent frontend step")
+        self._c_anomalies = self._registry.counter(
+            "hvd_step_anomaly_total",
+            help="step-time spikes beyond HOROVOD_ANOMALY_STDDEVS "
+                 "rolling sigmas")
+        from horovod_tpu.common.hvd_logging import get_logger
+        self._log = get_logger("obs.attribution")
+        self.anomalies: List[dict] = []
+        self.last_decomposition: Optional[dict] = None
+
+    def _resolve_engine(self):
+        if self._engine is not None:
+            return self._engine
+        if not self._use_engine:
+            return None
+        from horovod_tpu.common import basics
+        return basics._context().engine
+
+    def next_step(self) -> int:
+        with self._lock:
+            self._steps += 1
+            return self._steps
+
+    # -- the timed_step hook points -----------------------------------------
+
+    def step_begin(self, step_id: int):
+        engine = self._resolve_engine()
+        if engine is not None:
+            engine.step_begin(step_id)
+
+    def step_end(self, step_id: int, seconds: float) -> Optional[dict]:
+        """Close the step: engine STEP_END mark, anomaly check, periodic
+        flight-ring decomposition refresh. Returns the anomaly event when
+        one fired (also logged + appended to :attr:`anomalies`)."""
+        engine = self._resolve_engine()
+        if engine is not None:
+            engine.step_end(step_id)
+        event = self._observe(step_id, seconds, engine)
+        if engine is not None and self._every > 0 and \
+                step_id % self._every == 0:
+            self._refresh_async(engine)
+        return event
+
+    def observe(self, seconds: float) -> Optional[dict]:
+        """Frontend-only entry (no engine marks): frontends that own their
+        timing — the torch optimizer, the keras callback — feed here."""
+        return self._observe(self.next_step(), seconds,
+                             self._resolve_engine())
+
+    # -- internals ----------------------------------------------------------
+
+    def _observe(self, step_id: int, seconds: float,
+                 engine) -> Optional[dict]:
+        event = None
+        with self._lock:
+            # the new sample is judged against the window that *precedes*
+            # it — a spike must not dilute its own baseline
+            n = len(self._window)
+            mean = self._sum / n if n else 0.0
+            var = max(0.0, self._sumsq / n - mean * mean) if n else 0.0
+            if n == self._window.maxlen:
+                old = self._window[0]
+                self._sum -= old
+                self._sumsq -= old * old
+            self._window.append(seconds)
+            self._sum += seconds
+            self._sumsq += seconds * seconds
+            self._steps_observed += 1
+            if self._steps_observed % (self._window.maxlen or 64) == 0:
+                self._sum = sum(self._window)
+                self._sumsq = sum(x * x for x in self._window)
+        if n >= MIN_ANOMALY_SAMPLES:
+            sigma = max(var ** 0.5, MIN_REL_SIGMA * mean, 1e-9)
+            threshold = mean + self._k * sigma
+            if seconds > threshold:
+                event = {
+                    "event": "step_anomaly",
+                    "step": step_id,
+                    "step_time_sec": round(seconds, 6),
+                    "rolling_mean_sec": round(mean, 6),
+                    "rolling_sigma_sec": round(sigma, 6),
+                    "threshold_sec": round(threshold, 6),
+                    "stddevs": round((seconds - mean) / sigma, 2),
+                }
+        self._g_last.set(seconds)
+        if event is not None:
+            self._c_anomalies.inc()
+            self.anomalies.append(event)
+            self._log.warning("step anomaly: %s", json.dumps(event))
+            if engine is not None and self._flight_dir:
+                # the spike's evidence must hit disk before the ring wraps
+                engine.flight_dump(self._flight_dir)
+        return event
+
+    def _refresh_async(self, engine):
+        """Kick one background decomposition refresh; a refresh still in
+        flight is simply skipped (the gauges are a sampled view, not a
+        ledger — fresher data is already on its way)."""
+        if self._refresh_inflight.is_set():
+            return
+        self._refresh_inflight.set()
+
+        def run():
+            try:
+                self.refresh_decomposition(engine)
+            except Exception:  # noqa: BLE001 — telemetry thread; a
+                pass  # malformed dump must not leak a traceback mid-train
+            finally:
+                self._refresh_inflight.clear()
+
+        threading.Thread(target=run, daemon=True,
+                         name="hvd-attribution-refresh").start()
+
+    def refresh_decomposition(self, engine=None) -> Optional[dict]:
+        """Decompose the latest completed step window from the engine's
+        flight ring and export it as gauges. Returns the decomposition (or
+        None without an engine / completed window)."""
+        engine = engine if engine is not None else self._resolve_engine()
+        if engine is None:
+            return None
+        dump = engine.flight_dump()
+        if not dump:
+            return None
+        windows = step_windows(dump)
+        if not windows:
+            return None
+        enq, negs, execs = _collective_spans(dump.get("events", []))
+        dec = _decompose_window(windows[-1], enq, negs, execs)
+        self.last_decomposition = dec
+        g = self._registry.gauge
+        g("hvd_step_compute_seconds",
+          help="per-step compute time (frontend still enqueueing)").set(
+              dec["compute_s"])
+        g("hvd_step_exposed_comm_seconds",
+          help="per-step exposed (non-overlapped) collective time").set(
+              dec["exposed_comm_s"])
+        g("hvd_step_stall_seconds",
+          help="per-step negotiation/straggler wait").set(dec["stall_s"])
+        g("hvd_step_host_seconds",
+          help="per-step host-side remainder").set(dec["host_s"])
+        g("hvd_step_exposed_comm_ratio",
+          help="exposed comm as a fraction of step time").set(
+              dec["exposed_comm_s"] / dec["step_s"]
+              if dec["step_s"] > 0 else 0.0)
+        return dec
+
+
+_attributor: Optional[StepAttributor] = None
+_attr_lock = threading.Lock()
+
+
+def get_attributor() -> Optional[StepAttributor]:
+    """The process-global attributor, or None when
+    ``HOROVOD_STEP_ATTRIBUTION=0``. Lazily created on first use (after
+    init, so the engine session resolves)."""
+    if not env_bool("HOROVOD_STEP_ATTRIBUTION"):
+        return None
+    global _attributor
+    with _attr_lock:
+        if _attributor is None:
+            _attributor = StepAttributor()
+        return _attributor
+
+
+# ---------------------------------------------------------------------------
+# BENCH json block
+
+
+def bench_block(step_seconds_by_model: Dict[str, float]) -> dict:
+    """The BENCH json ``step_attribution`` block: per-model decomposition
+    plus a measured attribution-overhead figure.
+
+    ``step_seconds_by_model`` maps model name → measured per-step wall
+    seconds. With a live engine session the per-model buckets come from
+    the flight ring's summary fractions; a single-process bench (no
+    engine — XLA owns the overlap inside the jitted step) decomposes as
+    100% compute with the source field saying so. Overhead: the
+    attributor's per-step observe cost (anomaly window + gauge update),
+    measured directly, as a percentage of each model's step — the <1%
+    acceptance budget."""
+    from horovod_tpu.metrics.registry import MetricsRegistry
+    probe = StepAttributor(registry=MetricsRegistry(), use_engine=False,
+                           flight_dir="")
+    iters = 5000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        probe.observe(0.1)
+    per_observe_s = (time.perf_counter() - t0) / iters
+
+    from horovod_tpu.common import basics
+    engine = basics._context().engine
+    record = None
+    refresh_s = None
+    if engine is not None:
+        t0 = time.perf_counter()
+        dump = engine.flight_dump()
+        if dump:
+            record = attribute({int(dump.get("rank", 0)): dump})
+        # one full dump + decomposition — the background refresh's cost
+        # (paid off the training thread, HOROVOD_ATTRIBUTION_EVERY apart)
+        refresh_s = time.perf_counter() - t0
+    summary = record["summary"] if record else None
+    live = bool(summary and summary["steps"])
+    source = ("flight-ring decomposition (this rank's engine; cross-rank "
+              "critical path needs every rank's dump — see "
+              "horovod_tpu.obs.attribute)" if live else
+              "frontend-only: no engine session in this process, in-jit "
+              "collectives are overlapped by XLA and invisible to the "
+              "engine, so the step decomposes as compute")
+
+    per_model = {}
+    for model, step_s in step_seconds_by_model.items():
+        if not step_s or step_s <= 0:
+            continue
+        if live:
+            entry = {
+                "step_seconds": round(step_s, 6),
+                "compute_s": round(step_s * summary["compute_frac"], 6),
+                "exposed_comm_s": round(
+                    step_s * summary["exposed_comm_frac"], 6),
+                "stall_s": round(step_s * summary["stall_frac"], 6),
+                "host_s": round(step_s * summary["host_frac"], 6),
+                "critical_rank": max(
+                    summary["critical_rank_counts"],
+                    key=summary["critical_rank_counts"].get),
+            }
+        else:
+            entry = {"step_seconds": round(step_s, 6),
+                     "compute_s": round(step_s, 6),
+                     "exposed_comm_s": 0.0, "stall_s": 0.0, "host_s": 0.0,
+                     "critical_rank": 0}
+        entry["attribution_overhead_pct_of_step"] = round(
+            100.0 * per_observe_s / step_s, 5)
+        per_model[model] = entry
+
+    return {
+        "source": source,
+        "per_model": per_model,
+        "summary": summary,
+        "attribution_overhead": {
+            "seconds_per_step_observe": round(per_observe_s, 9),
+            "seconds_per_ring_refresh": (round(refresh_s, 6)
+                                         if refresh_s is not None else None),
+            "refresh_note": "ring refresh runs on a background thread "
+                            "every HOROVOD_ATTRIBUTION_EVERY steps; the "
+                            "training thread pays only the per-step "
+                            "observe cost",
+            "budget_pct": 1.0,
+        },
+    }

@@ -1,0 +1,303 @@
+"""``hvd-top``: live per-rank cluster view over worker metrics endpoints.
+
+Scrapes every rank's ``/metrics.json`` (the endpoint
+``HOROVOD_METRICS_PORT`` turns on) and renders one row per rank:
+
+- **STEP ms** — mean frontend step time over the refresh window (the
+  shared ``hvd_frontend_step_seconds`` histogram, diffed between
+  scrapes; ``--once`` shows the lifetime mean);
+- **EXP%** / **STALL%** — exposed-comm and negotiation-stall fractions of
+  the step (the attribution gauges, :mod:`horovod_tpu.obs.attribution`);
+- **CACHE%** — engine response-cache hit rate;
+- **FUSE** — mean tensors per fused response;
+- **QD** — engine tensor-queue depth;
+- **STRAG** — peer-relative step-time skew in sigmas (the same
+  leave-one-out math the elastic driver uses, computed from the scraped
+  window means);
+- **ANOM** — step-anomaly count (``hvd_step_anomaly_total``).
+
+Targets, in priority order: ``--targets host:port[,host:port...]``; the
+rendezvous KV's ``metrics_targets`` key (published by the elastic driver
+every heartbeat) via ``--kv host:port`` or
+``HOROVOD_RENDEZVOUS_ADDR``/``PORT``; failing both, ``localhost`` with
+``HOROVOD_METRICS_PORT`` + local rank offsets.
+
+``--once`` prints a single snapshot and exits (CI/tests; exit 1 when no
+target answered). The live view refreshes every ``HOROVOD_TOP_INTERVAL``
+seconds, through curses when stdout is a TTY (``--plain`` forces the
+dumb redraw loop; no curses dependency is required anywhere).
+
+CLI::
+
+    hvd-top --targets 127.0.0.1:9090,127.0.0.1:9091
+    python -m horovod_tpu.obs.top --once --targets 127.0.0.1:9090
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.common.env_registry import (env_float, env_int, env_is_set,
+                                             env_str)
+from horovod_tpu.metrics import STEP_SECONDS, snapshot_value, step_stats
+from horovod_tpu.metrics.straggler import StragglerDetector
+
+COLUMNS = ("RANK", "STEP ms", "EXP%", "STALL%", "CACHE%", "FUSE", "QD",
+           "STRAG", "ANOM")
+_FMT = "{:>5} {:>9} {:>6} {:>7} {:>7} {:>6} {:>5} {:>7} {:>5}"
+
+
+def _parse_hostports(arg: str) -> List[dict]:
+    out = []
+    for item in arg.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        host, _, port = item.rpartition(":")
+        try:
+            out.append({"addr": host or "127.0.0.1", "port": int(port)})
+        except ValueError:
+            raise ValueError(
+                f"invalid metrics target {item!r} (want host:port or "
+                f"a bare port)") from None
+    return out
+
+
+def discover_targets(args) -> List[dict]:
+    """[{addr, port, rank?}] per the priority order in the module doc."""
+    if args.targets:
+        return _parse_hostports(args.targets)
+    kv = None
+    if args.kv:
+        host, _, port = args.kv.rpartition(":")
+        try:
+            kv = (host or "127.0.0.1", int(port))
+        except ValueError:
+            raise ValueError(
+                f"invalid --kv address {args.kv!r} (want host:port)") \
+                from None
+    elif env_str("HOROVOD_RENDEZVOUS_ADDR") and \
+            env_int("HOROVOD_RENDEZVOUS_PORT"):
+        kv = (env_str("HOROVOD_RENDEZVOUS_ADDR"),
+              env_int("HOROVOD_RENDEZVOUS_PORT"))
+    if kv is not None:
+        from horovod_tpu.runner.http_kv import KVClient
+        targets = KVClient(*kv).get_json("metrics_targets", timeout=3.0)
+        if targets:
+            return list(targets)
+    if env_is_set("HOROVOD_METRICS_PORT"):
+        base = env_int("HOROVOD_METRICS_PORT")
+        if base > 0:
+            return [{"addr": "127.0.0.1", "port": base + lr}
+                    for lr in range(max(1, env_int("HOROVOD_LOCAL_SIZE")))]
+    return []
+
+
+def scrape_target(target: dict, timeout: float = 1.0) -> Optional[dict]:
+    """One rank's /metrics.json snapshot, or None when unreachable (a
+    worker mid-restart must not take down the view)."""
+    from urllib import error as urlerror
+    from urllib import request as urlrequest
+    url = f"http://{target['addr']}:{target['port']}/metrics.json"
+    try:
+        with urlrequest.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urlerror.URLError, ConnectionError, OSError, ValueError):
+        return None
+
+
+def _rank_of(target: dict, snap: dict) -> str:
+    rank = snap.get("labels", {}).get("rank")
+    if rank in (None, ""):
+        rank = target.get("rank")
+    return str(rank) if rank is not None else f"?{target['port']}"
+
+
+def row_from_snapshot(target: dict, snap: dict,
+                      prev_steps: Optional[Tuple[int, float]]) -> dict:
+    """Extract one display row. ``prev_steps`` is the (count, sum) of the
+    step histogram at the previous refresh — None means lifetime mean."""
+    stats = step_stats(snap)
+    step_ms = None
+    if stats is not None:
+        count, total = stats
+        if prev_steps is not None and count > prev_steps[0]:
+            step_ms = 1e3 * (total - prev_steps[1]) / (count - prev_steps[0])
+        elif prev_steps is None and count:
+            step_ms = 1e3 * total / count
+    step_last = snapshot_value(snap, "hvd_step_seconds_last")
+    exp_ratio = snapshot_value(snap, "hvd_step_exposed_comm_ratio")
+    stall_s = snapshot_value(snap, "hvd_step_stall_seconds")
+    hits = snapshot_value(snap, "hvd_engine_cache_hits_total") or 0.0
+    misses = snapshot_value(snap, "hvd_engine_cache_misses_total") or 0.0
+    fused = snapshot_value(snap, "hvd_engine_fused_tensors_total")
+    responses = snapshot_value(snap, "hvd_engine_responses_total")
+    return {
+        "rank": _rank_of(target, snap),
+        "step_ms": step_ms,
+        "step_seconds": step_ms / 1e3 if step_ms is not None else None,
+        "exposed_pct": 100.0 * exp_ratio if exp_ratio is not None else None,
+        "stall_pct": (100.0 * stall_s / step_last
+                      if stall_s is not None and step_last else None),
+        "cache_pct": (100.0 * hits / (hits + misses)
+                      if hits + misses else None),
+        "fuse": (fused / responses if fused is not None and responses
+                 else None),
+        "queue_depth": snapshot_value(snap, "hvd_engine_queue_depth"),
+        "anomalies": snapshot_value(snap, "hvd_step_anomaly_total") or 0.0,
+        "steps_raw": stats,
+    }
+
+
+def _fmt(v, pattern="{:.1f}") -> str:
+    return pattern.format(v) if v is not None else "-"
+
+
+def render(rows: List[dict], unreachable: int = 0,
+           title: str = "") -> str:
+    """The table, straggler scores filled in from the rows' window step
+    times (leave-one-out skew — the elastic driver's math)."""
+    times = {i: r["step_seconds"] for i, r in enumerate(rows)
+             if r["step_seconds"]}
+    det = StragglerDetector(windows=1)
+    det.update(times)
+    lines = []
+    if title:
+        lines.append(title)
+    lines.append(_FMT.format(*COLUMNS))
+    for i, r in enumerate(rows):
+        score = det.last_scores.get(i)
+        lines.append(_FMT.format(
+            r["rank"], _fmt(r["step_ms"], "{:.2f}"),
+            _fmt(r["exposed_pct"]), _fmt(r["stall_pct"]),
+            _fmt(r["cache_pct"]), _fmt(r["fuse"], "{:.1f}"),
+            _fmt(r["queue_depth"], "{:.0f}"),
+            _fmt(score, "{:+.1f}"), _fmt(r["anomalies"], "{:.0f}")))
+    if unreachable:
+        lines.append(f"({unreachable} target(s) unreachable)")
+    return "\n".join(lines)
+
+
+class TopState:
+    """Scrape-window state for the live view (previous step-histogram
+    totals per target, so STEP ms is a window mean, not a lifetime one)."""
+
+    def __init__(self, targets: List[dict]):
+        self.targets = targets
+        self._prev: Dict[int, Tuple[int, float]] = {}
+
+    def refresh(self, window: bool = True) -> Tuple[List[dict], int]:
+        rows, unreachable = [], 0
+        for i, t in enumerate(self.targets):
+            snap = scrape_target(t)
+            if snap is None:
+                unreachable += 1
+                continue
+            row = row_from_snapshot(t, snap,
+                                    self._prev.get(i) if window else None)
+            if row["steps_raw"] is not None:
+                self._prev[i] = row["steps_raw"]
+            rows.append(row)
+        rows.sort(key=lambda r: (len(r["rank"]), r["rank"]))
+        return rows, unreachable
+
+
+def _title(n_rows: int, n_targets: int) -> str:
+    return (f"hvd-top  {time.strftime('%H:%M:%S')}  "
+            f"{n_rows}/{n_targets} ranks reporting  (q to quit)")
+
+
+def _loop_plain(state: TopState, interval: float):
+    while True:
+        rows, unreachable = state.refresh()
+        sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty() else "")
+        print(render(rows, unreachable,
+                     _title(len(rows), len(state.targets))))
+        sys.stdout.flush()
+        time.sleep(interval)
+
+
+def _loop_curses(scr, state: TopState, interval: float):
+    import curses
+    curses.curs_set(0)
+    scr.nodelay(True)
+    while True:
+        rows, unreachable = state.refresh()
+        scr.erase()
+        text = render(rows, unreachable,
+                      _title(len(rows), len(state.targets)))
+        maxy, maxx = scr.getmaxyx()
+        for y, line in enumerate(text.splitlines()[:maxy - 1]):
+            scr.addnstr(y, 0, line, maxx - 1)
+        scr.refresh()
+        deadline = time.monotonic() + interval
+        while time.monotonic() < deadline:
+            if scr.getch() in (ord("q"), ord("Q")):
+                return
+            time.sleep(0.05)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvd-top",
+        description="live per-rank cluster view over /metrics.json "
+                    "endpoints")
+    parser.add_argument("--targets",
+                        help="comma-separated host:port metrics endpoints")
+    parser.add_argument("--kv", help="rendezvous KV host:port publishing "
+                                     "the metrics_targets key")
+    parser.add_argument("--once", action="store_true",
+                        help="print one snapshot and exit")
+    parser.add_argument("--interval", type=float, default=None,
+                        help="refresh seconds (default "
+                             "HOROVOD_TOP_INTERVAL)")
+    parser.add_argument("--plain", action="store_true",
+                        help="no curses, dumb redraw loop")
+    args = parser.parse_args(argv)
+
+    try:
+        targets = discover_targets(args)
+    except ValueError as e:
+        print(f"hvd-top: {e}", file=sys.stderr)
+        return 2
+    if not targets:
+        print("hvd-top: no targets (pass --targets host:port, point --kv "
+              "at the rendezvous KV, or set HOROVOD_METRICS_PORT)",
+              file=sys.stderr)
+        return 2
+    state = TopState(targets)
+
+    if args.once:
+        rows, unreachable = state.refresh(window=False)
+        if not rows:
+            print(f"hvd-top: none of {len(targets)} target(s) answered",
+                  file=sys.stderr)
+            return 1
+        print(render(rows, unreachable,
+                     _title(len(rows), len(targets))))
+        return 0
+
+    interval = args.interval if args.interval is not None \
+        else env_float("HOROVOD_TOP_INTERVAL")
+    use_curses = not args.plain and sys.stdout.isatty()
+    if use_curses:
+        try:
+            import curses
+        except ImportError:
+            use_curses = False
+    try:
+        if use_curses:
+            curses.wrapper(lambda scr: _loop_curses(scr, state, interval))
+        else:
+            _loop_plain(state, interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

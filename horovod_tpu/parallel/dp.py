@@ -225,7 +225,10 @@ def make_train_step(loss_fn: Callable,
     donate_argnums = (0, 1) if donate else ()
     # Step-timer wrapper (metrics monitoring layer): records wall time per
     # invocation into the shared hvd_frontend_step_seconds histogram while
-    # forwarding .lower()/AOT attributes to the jitted function.
+    # forwarding .lower()/AOT attributes to the jitted function. Also the
+    # frontend half of step-time attribution (horovod_tpu/obs): each
+    # invocation is bracketed with engine STEP marks and fed to the rolling
+    # anomaly detector — HOROVOD_STEP_ATTRIBUTION=0 turns that off.
     from horovod_tpu.metrics import timed_step
     return timed_step(jax.jit(mapped, donate_argnums=donate_argnums),
                       framework="jax")

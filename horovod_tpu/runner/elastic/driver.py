@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Tuple
 from horovod_tpu.common.env_registry import (env_float, env_int, env_is_set,
                                              env_str)
 from horovod_tpu.common.hvd_logging import get_logger
-from horovod_tpu.metrics import step_stats
+from horovod_tpu.metrics import snapshot_value, step_stats
 from horovod_tpu.metrics.registry import get_registry
 from horovod_tpu.metrics.straggler import StragglerDetector
 
@@ -129,7 +129,13 @@ class ElasticDriver:
                     "driver metrics exporter disabled: %s", e)
         # (host, slot) -> last (step_count, step_seconds_sum) observed
         self._metrics_prev: Dict[Tuple[str, int], Tuple[int, float]] = {}
+        # (host, slot) -> last hvd_step_anomaly_total observed (the
+        # worker-side attributor's spike counter; a delta between scrapes
+        # becomes a driver-level anomaly event)
+        self._anomaly_prev: Dict[Tuple[str, int], float] = {}
         self.straggler_events: List[dict] = []
+        # step-time anomaly events relayed from worker attributors
+        self.anomaly_events: List[dict] = []
         # analyzer verdicts collected after worker failures (flight dumps)
         self.flight_verdicts: List[dict] = []
         self._lock = threading.Lock()
@@ -277,6 +283,15 @@ class ElasticDriver:
         with self._lock:
             self._generation += 1
             gen = self._generation
+            # Cluster-health state is per-topology: after a resize the
+            # rank→host mapping shifts, so pre-resize straggler streaks and
+            # step-histogram baselines would be charged to whichever rank
+            # inherited the number — a healthy worker flagged on another
+            # machine's history. Start every generation from a clean
+            # window.
+            self._straggler.reset()
+            self._metrics_prev.clear()
+            self._anomaly_prev.clear()
             if self._reset_limit is not None and gen > self._reset_limit:
                 self._log(f"reset limit {self._reset_limit} exceeded")
                 self._result = 1
@@ -325,6 +340,7 @@ class ElasticDriver:
                 self._kv.delete_prefix(f"rank_and_size/g{old}/")
                 self._kv.delete_prefix(f"worker_state/g{old}/")
                 self._kv.delete_prefix(f"straggler/g{old}/")
+                self._kv.delete_prefix(f"anomaly/g{old}/")
                 self._kv.delete(f"go/g{old}")
                 self._kv.delete(f"reset_request/g{old}")
                 self._go_published.discard(old)
@@ -450,14 +466,27 @@ class ElasticDriver:
         (endpoint published by the worker's exporter under
         ``metrics_addr/<host>/<slot>``), diff the step-time histogram, and
         feed the per-rank window means to the straggler detector. Workers
-        without an exporter (metrics off) are simply absent."""
+        without an exporter (metrics off) are simply absent.
+
+        Side outputs of the same pass: the scrape-target list is published
+        to the KV under ``metrics_targets`` (what ``hvd-top --kv`` reads to
+        discover the cluster), and each worker's ``hvd_step_anomaly_total``
+        counter is diffed so attributor-detected step-time spikes surface
+        as driver-level structured events."""
         with self._lock:
             slots = list(self._expected_slots)
         times: Dict[int, float] = {}
+        targets: List[dict] = []
+        anomalies: List[Tuple[Tuple[str, int], dict, float]] = []
         for host, local_rank in slots:
             info = self._kv.get_json(f"metrics_addr/{host}/{local_rank}")
-            if not info:
+            # a malformed/partial KV entry skips THIS worker only — it must
+            # not abort the whole scrape pass for the healthy ones
+            if not isinstance(info, dict) or not info.get("addr") \
+                    or not info.get("port"):
                 continue
+            targets.append({"addr": info["addr"], "port": info["port"],
+                            "rank": info.get("rank")})
             try:
                 # short per-attempt timeout and small backoff: the scrape is
                 # periodic and failure-tolerant (the next heartbeat is the
@@ -468,17 +497,59 @@ class ElasticDriver:
                     url, timeout=1.0, attempts=2, backoff=0.05))
             except Exception:  # noqa: BLE001 — worker mid-restart
                 continue
+            key = (host, local_rank)
+            count = snapshot_value(snap, "hvd_step_anomaly_total")
+            if count is not None:
+                # first sight of a slot is a baseline, not an event — a
+                # worker surviving a rebalance keeps its lifetime counter,
+                # and re-relaying it after the generation reset would
+                # invent anomalies
+                prev_count = self._anomaly_prev.get(key)
+                self._anomaly_prev[key] = count
+                if prev_count is not None and count > prev_count:
+                    anomalies.append((key, info, count - prev_count))
             stats = step_stats(snap)
             if stats is None:
                 continue
-            key = (host, local_rank)
             prev = self._metrics_prev.get(key)
             self._metrics_prev[key] = stats
             if prev is not None and stats[0] > prev[0]:
                 times[int(info.get("rank", -1))] = \
                     (stats[1] - prev[1]) / (stats[0] - prev[0])
+        if targets:
+            try:
+                self._kv.put_json("metrics_targets", targets)
+            except Exception:  # noqa: BLE001 — telemetry must not kill
+                pass  # the heartbeat
+        for key, info, delta in anomalies:
+            self._ingest_anomaly(key, info, delta)
         if times:
             self._ingest_step_times(times)
+
+    def _ingest_anomaly(self, key: Tuple[str, int], info: dict,
+                        delta: float):
+        """Relay a worker attributor's step-time spike (counter delta
+        between scrapes) as a driver-level structured event: logged,
+        appended to :attr:`anomaly_events`, published under
+        ``anomaly/g<N>/<rank>``. Split from the scraper so tests can drive
+        it without HTTP."""
+        with self._lock:
+            gen = self._generation
+        event = {
+            "event": "step_anomaly",
+            "rank": info.get("rank"),
+            "host": key[0],
+            "local_rank": key[1],
+            "new_anomalies": int(delta),
+            "generation": gen,
+        }
+        self.anomaly_events.append(event)
+        self._logger.warning("worker step anomaly: %s", json.dumps(event))
+        self._log(f"anomaly event: {json.dumps(event)}")
+        try:
+            self._kv.put_json(f"anomaly/g{gen}/{event['rank']}", event)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _ingest_step_times(self, step_times: Dict[int, float]):
         """Feed one window of per-rank mean step times; log/publish the

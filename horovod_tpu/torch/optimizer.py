@@ -37,9 +37,14 @@ def _record_torch_step(seconds: float):
         reg = _metrics.get_registry()
         _step_instruments = (
             reg.histogram(_metrics.STEP_SECONDS, framework="torch"),
-            reg.counter(_metrics.STEPS_TOTAL, framework="torch"))
+            reg.counter(_metrics.STEPS_TOTAL, framework="torch"),
+            _metrics._get_attributor())
     _step_instruments[0].observe(seconds)
     _step_instruments[1].inc()
+    if _step_instruments[2] is not None:
+        # optimizer.step() times after the fact — anomaly detection only,
+        # no engine STEP marks to bracket with
+        _step_instruments[2].observe(seconds)
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):

@@ -279,6 +279,69 @@ def test_driver_blacklist_cooldown_rejoin(monkeypatch):
         driver._kv.stop()
 
 
+def test_driver_resets_cluster_health_on_generation_change():
+    """ISSUE 7 satellite bugfix: after a resize the rank→host mapping
+    shifts, so pre-resize straggler streaks / scrape baselines would be
+    charged to whichever rank inherited the number. A rebalance must
+    start every detector window clean — driven through the real
+    ElasticDriver + real StragglerDetector."""
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    FakeWorker.spawned = []
+    disc = FixedHostDiscovery({"hostA": 2})
+    driver = ElasticDriver(disc, min_np=1, max_np=2,
+                           command=["true"], spawn_worker=FakeWorker)
+    try:
+        driver._hosts.refresh()
+        driver._rebalance(first=True)
+        # one window short of flagging rank 1 (windows defaults to 3)
+        for _ in range(driver._straggler.windows - 1):
+            driver._ingest_step_times({0: 0.1, 1: 0.9, 2: 0.1})
+        assert driver._straggler._streak.get(1, 0) == \
+            driver._straggler.windows - 1
+        assert driver._straggler.last_scores
+        driver._metrics_prev[("hostA", 0)] = (10, 1.0)
+        driver._anomaly_prev[("hostA", 0)] = 3.0
+
+        driver._rebalance()  # resize: everything rolling must clear
+
+        assert driver._straggler._streak == {}
+        assert driver._straggler.last_scores == {}
+        assert driver._straggler.flagged == set()
+        assert driver._metrics_prev == {}
+        assert driver._anomaly_prev == {}
+        # the stale streak may not carry over: the same skew pattern needs
+        # the full `windows` count again before flagging
+        events = []
+        for _ in range(driver._straggler.windows - 1):
+            events += driver._ingest_step_times({0: 0.1, 1: 0.9, 2: 0.1}) \
+                or []
+        assert not driver.straggler_events, \
+            "pre-resize samples leaked into the new generation"
+    finally:
+        driver._shutdown.set()
+        driver._kv.stop()
+
+
+def test_straggler_detector_reset_zeroes_gauges():
+    from horovod_tpu.metrics.registry import MetricsRegistry
+    from horovod_tpu.metrics.straggler import StragglerDetector
+    from horovod_tpu.metrics import snapshot_value
+
+    reg = MetricsRegistry()
+    det = StragglerDetector(k=3.0, windows=1, registry=reg)
+    det.update({0: 0.1, 1: 0.1, 2: 0.9})
+    assert det.flagged == {2}
+    assert snapshot_value(reg.snapshot(), "hvd_straggler_flagged",
+                          rank="2") == 1.0
+    det.reset()
+    assert det.flagged == set() and det.last_scores == {}
+    assert snapshot_value(reg.snapshot(), "hvd_straggler_flagged",
+                          rank="2") == 0.0
+    assert snapshot_value(reg.snapshot(), "hvd_straggler_score",
+                          rank="2") == 0.0
+
+
 def test_driver_clean_generation_clears_failure_counts(monkeypatch):
     """One failure (below threshold 2) followed by a clean generation must
     not leave the host one strike from blacklisting forever."""

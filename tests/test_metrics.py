@@ -164,6 +164,71 @@ def test_registry_concurrency_smoke():
 # engine counters over a live 2-rank loopback run, scraped via HTTP
 
 
+def test_prometheus_help_type_and_content_type():
+    """ISSUE 7 satellite: real Prometheus scrapers need a # HELP and
+    # TYPE line per family — including help-less registrations — and the
+    exposition content type ``text/plain; version=0.0.4``."""
+    reg = MetricsRegistry()
+    reg.counter("hvd_helpless_total").inc()          # no help given
+    reg.gauge("hvd_depth", help="queue depth").set(3)
+    reg.histogram("hvd_lat_seconds").observe(0.1)
+    exporter = MetricsExporter(reg, port=0, labels={"rank": "0"}).start()
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics", timeout=5)
+        ctype = resp.headers.get("Content-Type", "")
+        assert ctype.startswith("text/plain; version=0.0.4"), ctype
+        text = resp.read().decode()
+        families = {"hvd_helpless_total", "hvd_depth", "hvd_lat_seconds"}
+        for name in families:
+            assert f"# TYPE {name} " in text, name
+            help_lines = [ln for ln in text.splitlines()
+                          if ln.startswith(f"# HELP {name} ")]
+            assert help_lines, f"missing # HELP for {name}"
+            # the docstring is never empty, even for help-less families
+            assert help_lines[0].split(" ", 3)[3].strip(), name
+        # HELP precedes TYPE for each family (promtool ordering)
+        lines = text.splitlines()
+        for name in families:
+            h = lines.index(f"# HELP {name} " +
+                            [ln for ln in lines if
+                             ln.startswith(f"# HELP {name} ")][0]
+                            .split(" ", 3)[3])
+            t = lines.index([ln for ln in lines
+                             if ln.startswith(f"# TYPE {name} ")][0])
+            assert h < t, name
+    finally:
+        exporter.stop()
+
+
+def test_engine_scrape_every_family_has_help():
+    """The C++ MetricsStore families cross the boundary with real HELP
+    docstrings (the collector's doc map), not derived fallbacks."""
+    from horovod_tpu.engine import EngineSession
+
+    group = f"help-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=2, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(2)]
+    try:
+        reg = MetricsRegistry()
+        reg.register_collector(engine_collector(sessions[0]), name="engine")
+        text = prom.render(reg.collect())
+        for line in text.splitlines():
+            if not line.startswith("# TYPE hvd_engine_"):
+                continue
+            name = line.split()[2]
+            assert f"# HELP {name} " in text, name
+        # spot-check a mapped docstring (not the derived fallback)
+        assert "# HELP hvd_engine_cache_hits_total response-cache hits" \
+            in text
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+
 def test_engine_metrics_prometheus_scrape_2rank():
     from horovod_tpu.common.eager import EagerExecutor
     from horovod_tpu.engine import OP_ALLREDUCE, EngineSession
@@ -354,6 +419,51 @@ def test_driver_scrapes_worker_endpoint():
         window = ingested[-1]
         assert window[0] == pytest.approx(0.2)
         assert window[1] == pytest.approx(0.6)
+    finally:
+        for e in exporters:
+            e.stop()
+        driver._kv.stop()
+
+
+def test_driver_publishes_targets_and_relays_anomalies():
+    """The heartbeat scrape's ISSUE-7 side outputs: the target list lands
+    in the KV under metrics_targets (hvd-top's --kv discovery), and a
+    worker attributor's hvd_step_anomaly_total delta becomes a structured
+    driver event published under anomaly/g<N>/<rank>."""
+    from horovod_tpu.runner.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    driver = ElasticDriver(FixedHostDiscovery({"localhost": 2}),
+                           min_np=2, max_np=2, command=["true"])
+    regs = [MetricsRegistry() for _ in range(2)]
+    exporters = [MetricsExporter(regs[r], port=0).start() for r in range(2)]
+    try:
+        driver._expected_slots = [("localhost", 0), ("localhost", 1)]
+        for r in range(2):
+            regs[r].counter("hvd_step_anomaly_total")
+            driver._kv.put_json(f"metrics_addr/localhost/{r}",
+                                {"addr": "127.0.0.1",
+                                 "port": exporters[r].port, "rank": r})
+        driver._scrape_worker_metrics()  # baseline
+        targets = driver._kv.get_json("metrics_targets")
+        assert targets == [
+            {"addr": "127.0.0.1", "port": exporters[0].port, "rank": 0},
+            {"addr": "127.0.0.1", "port": exporters[1].port, "rank": 1}]
+        assert driver.anomaly_events == [], \
+            "first sight of a counter is a baseline, not an event"
+
+        regs[1].counter("hvd_step_anomaly_total").inc(2)
+        driver._scrape_worker_metrics()
+        assert len(driver.anomaly_events) == 1
+        ev = driver.anomaly_events[0]
+        assert ev["event"] == "step_anomaly" and ev["rank"] == 1
+        assert ev["new_anomalies"] == 2
+        key = f"anomaly/g{driver.generation}/1"
+        assert driver._kv.get_json(key)["rank"] == 1
+
+        # no new spikes -> no new events
+        driver._scrape_worker_metrics()
+        assert len(driver.anomaly_events) == 1
     finally:
         for e in exporters:
             e.stop()

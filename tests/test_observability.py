@@ -89,12 +89,13 @@ def test_stall_report_empty_before_any_warning():
 # ABI guard
 
 
-def test_abi_version_is_7():
-    # 6 → 7: hvdtpu_flight_dump + hvdtpu_bench_flight_record (flight
-    # recorder), Request wire format carries a signature hash
+def test_abi_version_is_8():
+    # 7 → 8: hvdtpu_step_begin/hvdtpu_step_end (frontend step-boundary
+    # marks for step-time attribution); DONE flight events carry the
+    # response's exec-callback span in aux
     lib = bindings.load_library()
-    assert bindings.ABI_VERSION == 7
-    assert lib.hvdtpu_abi_version() == 7
+    assert bindings.ABI_VERSION == 8
+    assert lib.hvdtpu_abi_version() == 8
 
 
 def test_stale_library_refused(monkeypatch):

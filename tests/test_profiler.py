@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from horovod_tpu.profiler import flight
 from horovod_tpu.profiler import flops as pflops
 from horovod_tpu.profiler import mfu as pmfu
 from horovod_tpu.profiler import trace_merge
@@ -178,6 +179,90 @@ def test_merge_normalizes_engine_lanes(tmp_path):
     metas = [e for e in evs if e.get("ph") == "M"]
     assert any(e["name"] == "thread_name" and
                e["args"]["name"] == "grad/w" for e in metas)
+
+
+def test_merge_with_empty_or_absent_jax_trace(tmp_path):
+    """Merging with no JAX side must still produce a loadable trace:
+    absent logdir, empty logdir, empty dict, empty list — none may crash
+    or drop the engine events (ISSUE 7 satellite)."""
+    timeline = tmp_path / "t.json"
+    timeline.write_text(ENGINE_EVENTS + "\n]\n")
+    empty_dir = tmp_path / "empty_logdir"
+    empty_dir.mkdir()
+    for jax_side in (None, str(tmp_path / "never_created"), str(empty_dir),
+                     {}, []):
+        merged = trace_merge.merge_traces(timeline, jax_side)
+        engine = [e for e in merged["traceEvents"] if e.get("ph") in "BEi"]
+        assert len(engine) == 3, f"jax_side={jax_side!r}"
+    # and a trace file that exists but holds no events
+    hollow = tmp_path / "hollow.trace.json"
+    hollow.write_text('{"traceEvents": []}')
+    merged = trace_merge.merge_traces(timeline, str(hollow))
+    assert [e for e in merged["traceEvents"] if e.get("ph") in "BEi"]
+
+
+def test_flight_perfetto_two_ranks_distinct_pids(tmp_path):
+    """Two ranks with IDENTICAL tensor names and raw pids must land in
+    distinct per-rank process groups — overlapping pids in the source
+    dumps may not collide in the merged trace (ISSUE 7 satellite)."""
+    def dump(rank):
+        return {"rank": rank, "size": 2, "origin_unix_us": 1_000_000,
+                "events": [
+                    {"i": 0, "phase": "CYCLE", "name": "", "ts_us": 0.0,
+                     "cycle": 1},
+                    {"i": 1, "phase": "ENQUEUE", "name": "grad/w",
+                     "ts_us": 10.0},
+                    {"i": 2, "phase": "NEGOTIATE", "name": "grad/w",
+                     "ts_us": 20.0},
+                    {"i": 3, "phase": "EXEC", "name": "grad/w",
+                     "ts_us": 30.0},
+                    {"i": 4, "phase": "DONE", "name": "grad/w",
+                     "ts_us": 40.0},
+                ]}
+
+    out = tmp_path / "flight.trace.json"
+    merged = flight.to_perfetto({0: dump(0), 1: dump(1)}, str(out))
+    assert json.loads(out.read_text()) == merged
+    span_pids = {e["pid"] for e in merged["traceEvents"]
+                 if e.get("ph") in "BEi"}
+    assert len(span_pids) == 2, "each rank needs its own process group"
+    # both process groups carry the shared lane name without clashing
+    names = [e for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"]
+    assert sum(e["args"]["name"] == "grad/w" for e in names) == 2
+
+
+def test_flight_alignment_degrades_without_cycle_anchors(tmp_path):
+    """A dump folder where one rank recorded zero CYCLE anchors (tiny
+    ring, wedged rank) must fall back to the wall-clock origin instead of
+    crashing, for the analyzer AND the Perfetto emitter."""
+    with_anchor = {"rank": 0, "size": 2, "origin_unix_us": 1_000_000,
+                   "events": [
+                       {"i": 0, "phase": "CYCLE", "name": "", "ts_us": 50.0,
+                        "cycle": 1},
+                       {"i": 1, "phase": "ENQUEUE", "name": "g",
+                        "ts_us": 60.0},
+                       {"i": 2, "phase": "DONE", "name": "g",
+                        "ts_us": 80.0},
+                   ]}
+    # rank 1 booted 2500us later (wall clock) and has no CYCLE events
+    anchorless = {"rank": 1, "size": 2, "origin_unix_us": 1_002_500,
+                  "events": [
+                      {"i": 0, "phase": "ENQUEUE", "name": "g",
+                       "ts_us": 10.0},
+                      {"i": 1, "phase": "DONE", "name": "g",
+                       "ts_us": 30.0},
+                  ]}
+    for rank, d in ((0, with_anchor), (1, anchorless)):
+        (tmp_path / f"flight_rank{rank}.json").write_text(json.dumps(d))
+    dumps = flight.load_dumps(tmp_path)
+    offsets = flight.align_clocks(dumps)
+    assert offsets[0] == 0.0
+    assert offsets[1] == pytest.approx(2500.0)
+    verdict = flight.analyze(dumps)
+    assert set(verdict["clock_offsets_us"]) == {0, 1}
+    merged = flight.to_perfetto(dumps, str(tmp_path / "out.trace.json"))
+    assert merged["traceEvents"]
 
 
 def test_merged_trace_engine_beside_device_activity(tmp_path):

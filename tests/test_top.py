@@ -1,0 +1,183 @@
+"""``hvd-top`` live cluster view (ISSUE 7).
+
+All tests are port-0 and poll-based: real ``MetricsExporter`` endpoints,
+no curses, no sleeps beyond the scrape itself. The ``--once`` snapshot
+mode is the tier-1 CI surface (also exercised as a subprocess so the
+``python -m horovod_tpu.obs.top`` front door stays wired).
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.metrics import MetricsExporter, record_step
+from horovod_tpu.metrics.registry import MetricsRegistry
+from horovod_tpu.obs import top
+
+
+def _populated_registry(rank, step_s=0.1, exposed_ratio=0.25,
+                        cache_hits=90.0, cache_misses=10.0):
+    reg = MetricsRegistry()
+    record_step("jax", step_s, registry=reg)
+    reg.gauge("hvd_step_exposed_comm_ratio").set(exposed_ratio)
+    reg.gauge("hvd_step_seconds_last").set(step_s)
+    reg.gauge("hvd_step_stall_seconds").set(step_s * 0.1)
+    # engine families normally come from the C++ collector; plain
+    # counters under the same names scrape identically
+    reg.counter("hvd_engine_cache_hits_total").inc(cache_hits)
+    reg.counter("hvd_engine_cache_misses_total").inc(cache_misses)
+    reg.counter("hvd_engine_responses_total").inc(10)
+    reg.counter("hvd_engine_fused_tensors_total").inc(30)
+    reg.gauge("hvd_engine_queue_depth").set(2)
+    reg.counter("hvd_step_anomaly_total").inc(1)
+    return reg
+
+
+@pytest.fixture
+def cluster():
+    """Two live worker endpoints with distinct step-time profiles."""
+    regs = [_populated_registry(0, step_s=0.1),
+            _populated_registry(1, step_s=0.4)]
+    exporters = [MetricsExporter(regs[r], port=0,
+                                 labels={"rank": str(r)}).start()
+                 for r in range(2)]
+    yield regs, exporters
+    for e in exporters:
+        e.stop()
+
+
+def _targets_arg(exporters):
+    return ",".join(f"127.0.0.1:{e.port}" for e in exporters)
+
+
+def test_row_extraction_from_live_snapshot(cluster):
+    regs, exporters = cluster
+    snap = top.scrape_target({"addr": "127.0.0.1",
+                              "port": exporters[0].port})
+    assert snap is not None
+    row = top.row_from_snapshot({"addr": "127.0.0.1",
+                                 "port": exporters[0].port}, snap, None)
+    assert row["rank"] == "0"
+    assert row["step_ms"] == pytest.approx(100.0)
+    assert row["exposed_pct"] == pytest.approx(25.0)
+    assert row["cache_pct"] == pytest.approx(90.0)
+    assert row["fuse"] == pytest.approx(3.0)
+    assert row["queue_depth"] == 2
+    assert row["anomalies"] == 1
+    assert row["stall_pct"] == pytest.approx(10.0)
+
+
+def test_refresh_windows_step_time(cluster):
+    regs, exporters = cluster
+    state = top.TopState([{"addr": "127.0.0.1", "port": e.port}
+                          for e in exporters])
+    rows, unreachable = state.refresh()
+    assert unreachable == 0 and len(rows) == 2
+    # lifetime mean on the first window
+    assert rows[0]["step_ms"] == pytest.approx(100.0)
+    # new steps land; the second refresh reports the WINDOW mean, not the
+    # lifetime one
+    record_step("jax", 0.3, registry=regs[0])
+    rows, _ = state.refresh()
+    assert rows[0]["step_ms"] == pytest.approx(300.0)
+
+
+def test_render_includes_columns_and_straggler_score(cluster):
+    regs, exporters = cluster
+    state = top.TopState([{"addr": "127.0.0.1", "port": e.port}
+                          for e in exporters])
+    rows, unreachable = state.refresh(window=False)
+    text = top.render(rows, unreachable, "title-line")
+    assert "title-line" in text.splitlines()[0]
+    for col in top.COLUMNS:
+        assert col in text.splitlines()[1]
+    # two rank rows, sorted
+    body = text.splitlines()[2:]
+    assert body[0].split()[0] == "0" and body[1].split()[0] == "1"
+
+
+def test_once_mode_exit_codes(cluster, capsys):
+    regs, exporters = cluster
+    rc = top.main(["--once", "--targets", _targets_arg(exporters)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "RANK" in out and "hvd-top" in out
+    # a dead-only target list answers nothing -> exit 1
+    rc = top.main(["--once", "--targets", "127.0.0.1:1"])
+    assert rc == 1
+
+
+def test_no_targets_is_usage_error(monkeypatch, capsys):
+    for var in ("HOROVOD_METRICS_PORT", "HOROVOD_RENDEZVOUS_ADDR",
+                "HOROVOD_RENDEZVOUS_PORT"):
+        monkeypatch.delenv(var, raising=False)
+    assert top.main(["--once"]) == 2
+    assert "no targets" in capsys.readouterr().err
+
+
+def test_unreachable_target_does_not_hide_live_ranks(cluster, capsys):
+    regs, exporters = cluster
+    rc = top.main(["--once", "--targets",
+                   _targets_arg(exporters) + ",127.0.0.1:1"])
+    assert rc == 0
+    assert "1 target(s) unreachable" in capsys.readouterr().out
+
+
+def test_kv_target_discovery(cluster):
+    """The elastic driver publishes metrics_targets to the rendezvous KV;
+    --kv (or HOROVOD_RENDEZVOUS_ADDR/PORT) reads it back."""
+    from horovod_tpu.runner.http_kv import KVServer
+    regs, exporters = cluster
+    kv = KVServer().start()
+    try:
+        kv.put_json("metrics_targets",
+                    [{"addr": "127.0.0.1", "port": e.port, "rank": r}
+                     for r, e in enumerate(exporters)])
+        parsed = top.discover_targets(
+            type("A", (), {"targets": None,
+                           "kv": f"127.0.0.1:{kv.port}"})())
+        assert [t["port"] for t in parsed] == \
+            [e.port for e in exporters]
+        assert top.main(["--once", "--kv", f"127.0.0.1:{kv.port}"]) == 0
+    finally:
+        kv.stop()
+
+
+def test_malformed_targets_are_usage_errors(capsys):
+    # a typo'd target or --kv must exit 2 with a message, not traceback
+    assert top.main(["--once", "--targets", "localhost"]) == 2
+    assert "invalid metrics target" in capsys.readouterr().err
+    assert top.main(["--once", "--kv", "justahost"]) == 2
+    assert "invalid --kv address" in capsys.readouterr().err
+
+
+def test_targets_parsing_defaults_host():
+    parsed = top._parse_hostports("9090,host2:9191, ")
+    assert parsed == [{"addr": "127.0.0.1", "port": 9090},
+                      {"addr": "host2", "port": 9191}]
+
+
+def test_metrics_port_fallback(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS_PORT", "9300")
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", "3")
+    monkeypatch.delenv("HOROVOD_RENDEZVOUS_ADDR", raising=False)
+    targets = top.discover_targets(
+        type("A", (), {"targets": None, "kv": None})())
+    assert [t["port"] for t in targets] == [9300, 9301, 9302]
+
+
+def test_cli_subprocess_once_smoke(cluster):
+    """The `python -m horovod_tpu.obs.top` front door (what the hvd-top
+    console script and `make top` resolve to), end to end in a clean
+    interpreter — no curses required for --once."""
+    regs, exporters = cluster
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.obs.top", "--once",
+         "--targets", _targets_arg(exporters)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "RANK" in proc.stdout
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert any(ln.split()[0] == "0" for ln in lines[2:])
