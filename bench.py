@@ -677,6 +677,20 @@ def main():
         print(f"step attribution failed: {e!r}", file=sys.stderr)
         step_attribution = {"error": repr(e)}
 
+    # Serving plane (ISSUE 8 acceptance: `serving` block with p50/p99 +
+    # throughput at >=3 offered-load points incl. one past saturation, and
+    # the int8-activation vs fp32 wire-byte savings). Local serving stack
+    # over this host's devices; the cross-host regime is the same code via
+    # serve/worker.py + HOROVOD_SERVING_MODE.
+    if "serving" in SKIP:
+        serving = {"skipped": True}
+    else:
+        try:
+            serving = _serving_bench()
+        except Exception as e:  # serving bench must not sink the training
+            print(f"serving bench failed: {e!r}", file=sys.stderr)
+            serving = {"error": repr(e)}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -697,8 +711,81 @@ def main():
         "engine_metrics": engine_metrics,
         "flight_recorder_overhead": flight_overhead,
         "step_attribution": step_attribution,
+        "serving": serving,
         "device_kind": jax.devices()[0].device_kind,
     }))
+
+
+def _serving_bench():
+    """The BENCH ``serving`` block: offered-load sweep over a local
+    continuous-batching stack running the tensor-parallel LM with int8
+    activation collectives.
+
+    Method: a high offered-load probe measures capacity (the achieved QPS
+    when arrivals far outrun the server), then three open-loop windows at
+    0.5x / 0.8x / well-past capacity (3x, floored at capacity + 25 qps —
+    the probe under-reports capacity when deadline expiry dominates)
+    record p50/p99 and throughput — the past-saturation point demonstrates
+    graceful backpressure (bounded queue, immediate rejects, completed
+    requests keep a deadline-bounded p99) rather than collapse. Wire-byte savings come from the shared TP accounting
+    (parallel/tp.py), and the small-tensor cliff microbench pins the
+    serving-mode express-lane win over fused-mode negotiation."""
+    from horovod_tpu.metrics.registry import MetricsRegistry
+    from horovod_tpu.serve import (ContinuousBatcher, ServingLoop,
+                                   make_tp_lm_step)
+    from horovod_tpu.serve import loadgen
+    from horovod_tpu.serve.batcher import AdmissionRejected
+
+    reg = MetricsRegistry()  # isolated: the training metrics stay clean
+    step_fn, info = make_tp_lm_step(compression="int8", vocab=512,
+                                    hidden=128, mlp_dim=512, layers=4)
+    batcher = ContinuousBatcher(max_batch=8, queue_depth=16,
+                                default_deadline_ms=1000.0, max_len=256,
+                                registry=reg)
+    loop = ServingLoop(step_fn, batcher, registry=reg).start()
+
+    def make_payload(i):
+        n = 8 * ((i % 3) + 1)  # 8/16/24-token prompts across buckets
+        return {"tokens": [(7 * i + j) % 509 for j in range(n)],
+                "max_new_tokens": 4}
+
+    def submit(payload):
+        try:
+            req = batcher.submit(payload["tokens"],
+                                 max_new_tokens=payload["max_new_tokens"])
+        except AdmissionRejected:
+            return {"status": "rejected"}
+        req.wait(5.0)
+        return req.result()
+
+    try:
+        loadgen.run_load(submit, 20.0, 1.0, make_payload)  # warm compiles
+        probe = loadgen.run_load(submit, 400.0, 2.0, make_payload)
+        capacity = max(probe["achieved_qps"], 1.0)
+        # sub-/near-/past-saturation. The probe's achieved rate
+        # under-reports capacity when deadline expiry dominates, so the
+        # past point gets a hard floor well above anything this stack
+        # sustains on a CPU host — the JSON must show the backpressure
+        # knee, not a third comfortable point.
+        points = [round(capacity * 0.5, 1), round(capacity * 0.8, 1),
+                  round(max(capacity * 3.0, capacity + 25.0), 1)]
+        sweep = loadgen.run_points(submit, make_payload, points,
+                                   duration_sec=3.0)
+    finally:
+        loop.drain(timeout=10.0)
+        loop.stop()
+    past = sweep[-1]
+    return {
+        "model": {k: info[k] for k in ("vocab", "hidden", "mlp_dim",
+                                       "layers", "tp_world",
+                                       "compression")},
+        "capacity_qps": capacity,
+        "offered_load_sweep": sweep,
+        "past_saturation_graceful": bool(
+            past["rejected"] > 0 and past["completed_ok"] > 0),
+        "activation_wire_bytes": info["wire"],
+        "small_tensor_cliff": loadgen.small_tensor_cliff_report(iters=10),
+    }
 
 
 def _host_microbench():

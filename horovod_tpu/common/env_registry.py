@@ -99,6 +99,37 @@ _decl("HOROVOD_HIERARCHICAL_ALLREDUCE", "bool", False,
       "two-level gradient reduction (reduce-scatter over fast axes, "
       "cross-slice allreduce, all-gather back)")
 
+# -- serving plane / low-latency collectives --
+_decl("HOROVOD_SERVING_MODE", "bool", False,
+      "online-serving collective regime: sub-threshold tensors skip the "
+      "fusion buffer (express lane, executed ahead of bulk traffic) and "
+      "the idle cycle wait is clamped to HOROVOD_SERVING_CYCLE_TIME",
+      "both")
+_decl("HOROVOD_LOW_LATENCY_THRESHOLD", "int", 4096,
+      "payload bytes at or below which a response rides the serving-mode "
+      "express lane instead of the fusion buffer", "cpp")
+_decl("HOROVOD_SERVING_CYCLE_TIME", "float", 0.1,
+      "cycle-time ceiling (ms) while serving mode is on (the autotuner "
+      "may not stretch past it)", "cpp")
+_decl("HOROVOD_SERVE_PORT", "int", None,
+      "serving frontend HTTP port (0 = ephemeral; unset = off)")
+_decl("HOROVOD_SERVE_MAX_BATCH", "int", 8,
+      "continuous-batching slot count (max in-flight requests per step)")
+_decl("HOROVOD_SERVE_QUEUE_DEPTH", "int", 64,
+      "bounded admission queue length; a full queue rejects (backpressure)")
+_decl("HOROVOD_SERVE_DEADLINE_MS", "float", 1000.0,
+      "default per-request deadline when the client sends none")
+_decl("HOROVOD_SERVE_MAX_NEW_TOKENS", "int", 32,
+      "cap on generated tokens per request")
+_decl("HOROVOD_SERVE_ACT_COMPRESSION", "str", "int8",
+      "activation wire format for tensor-parallel inference collectives "
+      "(none | int8 — EQuARX block-quantized)")
+_decl("HOROVOD_SERVE_DRAIN_TIMEOUT_SECONDS", "float", 10.0,
+      "drain grace: how long a departing worker may finish in-flight "
+      "requests before they are re-routed")
+_decl("HOROVOD_SERVE_RETRY_LIMIT", "int", 3,
+      "re-route attempts per accepted request before it fails loudly")
+
 # -- autotuner --
 _decl("HOROVOD_AUTOTUNE", "bool", False,
       "online Bayesian tuning of cycle time / fusion threshold / cache",

@@ -180,6 +180,20 @@ int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,
   if (timeline_path != nullptr) opts.timeline_path = timeline_path;
   opts.timeline_mark_cycles = timeline_mark_cycles != 0;
 
+  // Serving / low-latency mode knobs, straight from env like the autotune
+  // family below (scope=cpp in the Python env registry). Read at session
+  // creation so one process can host serving and training sessions with
+  // different modes (tests flip the env between creates).
+  const char* sm = std::getenv("HOROVOD_SERVING_MODE");
+  opts.serving_mode = sm != nullptr && std::strcmp(sm, "0") != 0 &&
+                      std::strcmp(sm, "") != 0;
+  if (const char* v = std::getenv("HOROVOD_LOW_LATENCY_THRESHOLD")) {
+    opts.low_latency_threshold_bytes = std::atoll(v);
+  }
+  if (const char* v = std::getenv("HOROVOD_SERVING_CYCLE_TIME")) {
+    opts.serving_cycle_time_ms = std::atof(v);
+  }
+
   // Autotune knobs come straight from env (reference parses these in C++
   // too, operations.cc:521-530 + utils/env_parser).
   const char* at = std::getenv("HOROVOD_AUTOTUNE");

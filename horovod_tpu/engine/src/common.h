@@ -194,6 +194,14 @@ struct EngineOptions {
   std::string timeline_path;               // HOROVOD_TIMELINE
   bool timeline_mark_cycles = false;       // HOROVOD_TIMELINE_MARK_CYCLES
   bool elastic = false;                    // HOROVOD_ELASTIC
+  // Serving / low-latency mode (HOROVOD_SERVING_MODE): online inference
+  // collectives are latency-bound, not bandwidth-bound — sub-threshold
+  // responses skip the fusion buffer entirely and execute ahead of bulk
+  // traffic, and the idle cycle wait is clamped to serving_cycle_time_ms
+  // so a lone small tensor never waits out a training-tuned cycle.
+  bool serving_mode = false;               // HOROVOD_SERVING_MODE
+  int64_t low_latency_threshold_bytes = 4096;  // HOROVOD_LOW_LATENCY_THRESHOLD
+  double serving_cycle_time_ms = 0.1;      // HOROVOD_SERVING_CYCLE_TIME
   bool autotune = false;                   // HOROVOD_AUTOTUNE
   std::string autotune_log_path;           // HOROVOD_AUTOTUNE_LOG
   int autotune_warmup_samples = 3;         // HOROVOD_AUTOTUNE_WARMUP_SAMPLES

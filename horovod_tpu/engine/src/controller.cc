@@ -245,12 +245,58 @@ int64_t Controller::ResponseBytes(const Response& r) const {
   return total;
 }
 
+bool Controller::LowLatencyEligible(const Response& r) const {
+  // The serving-mode express lane: small, ungrouped, data-bearing
+  // responses. Grouped tensors keep their fusion atomicity (a group member
+  // peeled off alone would break the all-or-nothing contract), and ERROR/
+  // JOIN/BARRIER responses carry no payload worth re-ordering.
+  if (!opts_.serving_mode) return false;
+  if (r.group_id >= 0) return false;
+  if (!r.error_message.empty()) return false;
+  switch (r.type) {
+    case Response::Type::ALLREDUCE:
+    case Response::Type::ALLGATHER:
+    case Response::Type::BROADCAST:
+    case Response::Type::ALLTOALL:
+      break;
+    default:
+      return false;
+  }
+  return ResponseBytes(r) <= opts_.low_latency_threshold_bytes;
+}
+
 void Controller::FuseResponses(std::vector<Response>* responses) {
   // Greedy fusion with look-ahead (reference: controller.cc:777-914):
   // merge ALLREDUCE responses sharing reduce params until the threshold;
   // same-group responses merge unconditionally (atomicity). Mixed dtypes
   // are allowed in one fused response — the data plane packs per dtype.
-  std::vector<Response> fused;
+  //
+  // Serving mode first peels off the low-latency lane: sub-threshold
+  // responses never enter the fusion buffer (batching a 1 KiB activation
+  // allreduce behind a 64 MiB gradient batch charges the small tensor the
+  // big one's exec time) and are emitted AHEAD of the bulk responses so
+  // PerformOperation runs them first. Every rank computes the same
+  // partition from the same response list, so execution order stays
+  // identical across ranks.
+  std::vector<Response> express;
+  if (opts_.serving_mode) {
+    std::vector<Response> rest;
+    rest.reserve(responses->size());
+    for (auto& r : *responses) {
+      if (LowLatencyEligible(r)) {
+        express.push_back(std::move(r));
+      } else {
+        rest.push_back(std::move(r));
+      }
+    }
+    *responses = std::move(rest);
+    if (metrics_ != nullptr && !express.empty()) {
+      metrics_->low_latency_responses.fetch_add(
+          static_cast<int64_t>(express.size()), std::memory_order_relaxed);
+    }
+  }
+  std::vector<Response> fused = std::move(express);
+  fused.reserve(fused.size() + responses->size());
   std::vector<bool> used(responses->size(), false);
   for (size_t i = 0; i < responses->size(); ++i) {
     if (used[i]) continue;

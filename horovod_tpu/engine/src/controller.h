@@ -98,6 +98,9 @@ class Controller {
 
   Response ConstructResponse(const std::string& name);
   void FuseResponses(std::vector<Response>* responses);
+  // Serving mode: true when a response qualifies for the low-latency lane
+  // (sub-threshold, ungrouped, data-bearing) and must skip fusion.
+  bool LowLatencyEligible(const Response& r) const;
   int64_t ResponseBytes(const Response& r) const;
 
   // Autotune synchronization: broadcast the coordinator's current params

@@ -1,5 +1,6 @@
 #include "engine.h"
 
+#include <algorithm>
 #include <chrono>
 #include <csignal>
 #include <cstdlib>
@@ -115,7 +116,14 @@ void HandleManager::FailAll(const std::string& error) {
 Engine::Engine(int rank, int size, int local_rank, int local_size,
                const EngineOptions& opts, const TransportConfig& tcfg)
     : rank_(rank), size_(size), local_rank_(local_rank),
-      local_size_(local_size), opts_(opts), tcfg_(tcfg) {}
+      local_size_(local_size), opts_(opts), tcfg_(tcfg) {
+  if (opts_.serving_mode) {
+    // Latency-bound regime: the idle wait between cycles is bounded by the
+    // serving cycle time, never the (throughput-tuned) training one.
+    opts_.cycle_time_ms =
+        std::min(opts_.cycle_time_ms, opts_.serving_cycle_time_ms);
+  }
+}
 
 Engine::~Engine() { Finalize(); }
 
@@ -633,7 +641,11 @@ void Engine::BackgroundLoopImpl() {
         std::chrono::duration_cast<std::chrono::microseconds>(
             std::chrono::steady_clock::now() - cycle_t0).count());
     if (out.tuned_cycle_time_ms > 0) {
-      opts_.cycle_time_ms = out.tuned_cycle_time_ms;  // autotuner pacing
+      // autotuner pacing; serving mode keeps its latency bound — the
+      // tuner optimizes training throughput and may stretch the cycle
+      opts_.cycle_time_ms = opts_.serving_mode
+          ? std::min(out.tuned_cycle_time_ms, opts_.serving_cycle_time_ms)
+          : out.tuned_cycle_time_ms;
     }
     if (out.join_completed && join_pending_.load()) {
       last_joined_rank_.store(out.last_joined_rank);

@@ -104,6 +104,7 @@ std::string MetricsStore::SnapshotJson(int rank) const {
   AppendKV(&out, "crc_failures", v(crc_failures), &first);
   AppendKV(&out, "faults_injected", v(faults_injected), &first);
   AppendKV(&out, "steps_marked", v(steps_marked), &first);
+  AppendKV(&out, "low_latency_responses", v(low_latency_responses), &first);
   out += "},\"gauges\":{";
   first = true;
   AppendKV(&out, "queue_depth", v(queue_depth), &first);

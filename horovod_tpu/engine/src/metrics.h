@@ -87,6 +87,7 @@ struct MetricsStore {
   std::atomic<int64_t> crc_failures{0};         // frames rejected by CRC32C
   std::atomic<int64_t> faults_injected{0};      // HOROVOD_FAULT_SPEC firings
   std::atomic<int64_t> steps_marked{0};         // frontend STEP_END marks
+  std::atomic<int64_t> low_latency_responses{0};  // serving express lane
 
   // -- gauges ---------------------------------------------------------------
   std::atomic<int64_t> queue_depth{0};          // staged, not yet negotiated

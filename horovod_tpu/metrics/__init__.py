@@ -151,6 +151,59 @@ def snapshot_value(snapshot: dict, name: str, **labels) -> Optional[float]:
     return total if found else None
 
 
+def snapshot_histogram(snapshot: dict, name: str, **labels) -> Optional[dict]:
+    """Merged histogram of a family in a ``/metrics.json`` snapshot:
+    ``{"bounds": [...], "counts": [...], "sum": s, "count": n}`` with
+    per-bucket (non-cumulative) counts, summed over samples matching
+    ``labels``. None when absent/empty. Samples must share bucket bounds
+    (true for every family one process exports)."""
+    want = {str(k): str(v) for k, v in labels.items()}
+    merged: Optional[dict] = None
+    for m in snapshot.get("metrics", []):
+        if m.get("name") != name:
+            continue
+        for s in m.get("samples", []):
+            if "counts" not in s:
+                continue
+            got = s.get("labels", {})
+            if not all(got.get(k) == v for k, v in want.items()):
+                continue
+            if merged is None:
+                merged = {"bounds": list(s["bounds"]),
+                          "counts": list(s["counts"]),
+                          "sum": float(s.get("sum", 0.0)),
+                          "count": int(s.get("count", 0))}
+            elif list(s["bounds"]) == merged["bounds"]:
+                merged["counts"] = [a + b for a, b in
+                                    zip(merged["counts"], s["counts"])]
+                merged["sum"] += float(s.get("sum", 0.0))
+                merged["count"] += int(s.get("count", 0))
+    return merged if merged and merged["count"] else None
+
+
+def histogram_quantile(hist: dict, q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile (0..1) of a merged histogram
+    (:func:`snapshot_histogram` shape) by linear interpolation inside the
+    landing bucket — the standard Prometheus ``histogram_quantile``
+    estimate. The overflow bucket clamps to its lower bound (no upper edge
+    to interpolate toward). None for an empty histogram."""
+    if not hist or not hist.get("count"):
+        return None
+    bounds, counts = hist["bounds"], hist["counts"]
+    target = q * hist["count"]
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if cum + c >= target and c > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            if i >= len(bounds):
+                return float(bounds[-1]) if bounds else None
+            hi = bounds[i]
+            frac = (target - cum) / c
+            return lo + (hi - lo) * frac
+        cum += c
+    return float(bounds[-1]) if bounds else None
+
+
 def step_stats(snapshot: dict) -> Optional[tuple]:
     """(count, sum_seconds) of the step-time histogram across frameworks
     from a ``/metrics.json`` snapshot — what the driver diffs per window.

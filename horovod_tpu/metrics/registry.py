@@ -252,6 +252,8 @@ _ENGINE_HELP = {
     "crc_failures": "frames rejected by CRC32C",
     "faults_injected": "HOROVOD_FAULT_SPEC firings",
     "steps_marked": "frontend STEP_END marks (step attribution)",
+    "low_latency_responses": "responses that rode the serving-mode "
+                             "express lane (skipped fusion)",
     "queue_depth": "tensors staged but not yet negotiated",
     "cache_size": "response-cache entries resident",
     "fusion_batch_tensors": "tensors per fused response",

@@ -27,9 +27,15 @@ target answered). The live view refreshes every ``HOROVOD_TOP_INTERVAL``
 seconds, through curses when stdout is a TTY (``--plain`` forces the
 dumb redraw loop; no curses dependency is required anywhere).
 
+``--serving`` switches to the request-plane view (per-rank QPS over the
+refresh window, queue depth, in-flight count, mean batch occupancy,
+p50/p99 request latency, ok/reject/expired totals — the ``hvd_serve_*``
+families the serving plane exports on the same endpoints).
+
 CLI::
 
     hvd-top --targets 127.0.0.1:9090,127.0.0.1:9091
+    hvd-top --serving --kv 127.0.0.1:8888
     python -m horovod_tpu.obs.top --once --targets 127.0.0.1:9090
 """
 
@@ -49,6 +55,15 @@ from horovod_tpu.metrics.straggler import StragglerDetector
 COLUMNS = ("RANK", "STEP ms", "EXP%", "STALL%", "CACHE%", "FUSE", "QD",
            "STRAG", "ANOM")
 _FMT = "{:>5} {:>9} {:>6} {:>7} {:>7} {:>6} {:>5} {:>7} {:>5}"
+
+# Serving view (--serving): the request-plane health of each rank, scraped
+# from the same /metrics.json endpoints — QPS is the ok-request rate over
+# the refresh window (lifetime totals on --once show as OK), OCC the mean
+# batch occupancy, p50/p99 from the request-latency histogram, REJ/EXP the
+# backpressure and deadline counters.
+SERVING_COLUMNS = ("RANK", "QPS", "QD", "INFL", "OCC", "p50ms", "p99ms",
+                   "OK", "REJ", "EXP")
+_SERVING_FMT = "{:>5} {:>7} {:>4} {:>5} {:>5} {:>8} {:>8} {:>7} {:>6} {:>6}"
 
 
 def _parse_hostports(arg: str) -> List[dict]:
@@ -153,6 +168,58 @@ def row_from_snapshot(target: dict, snap: dict,
     }
 
 
+def serving_row_from_snapshot(target: dict, snap: dict,
+                              prev: Optional[Tuple[float, float]]) -> dict:
+    """One serving-view row. ``prev`` is (monotonic_ts, ok_count) at the
+    previous refresh; None (--once) leaves QPS blank and shows lifetime
+    totals instead."""
+    from horovod_tpu.metrics import histogram_quantile, snapshot_histogram
+    now = time.monotonic()
+    ok = snapshot_value(snap, "hvd_serve_requests_total", status="ok") or 0.0
+    qps = None
+    if prev is not None and now > prev[0]:
+        qps = max(0.0, ok - prev[1]) / (now - prev[0])
+    lat = snapshot_histogram(snap, "hvd_serve_request_latency_seconds")
+    occ = snapshot_histogram(snap, "hvd_serve_batch_occupancy")
+    p50 = histogram_quantile(lat, 0.5) if lat else None
+    p99 = histogram_quantile(lat, 0.99) if lat else None
+    return {
+        "rank": _rank_of(target, snap),
+        "qps": qps,
+        "queue_depth": snapshot_value(snap, "hvd_serve_queue_depth"),
+        "inflight": snapshot_value(snap, "hvd_serve_inflight"),
+        "occupancy": occ["sum"] / occ["count"] if occ else None,
+        "p50_ms": p50 * 1e3 if p50 is not None else None,
+        "p99_ms": p99 * 1e3 if p99 is not None else None,
+        "ok": ok,
+        "rejected": snapshot_value(snap, "hvd_serve_requests_total",
+                                   status="rejected") or 0.0,
+        "expired": snapshot_value(snap, "hvd_serve_requests_total",
+                                  status="expired") or 0.0,
+        "qps_raw": (now, ok),
+    }
+
+
+def render_serving(rows: List[dict], unreachable: int = 0,
+                   title: str = "") -> str:
+    lines = []
+    if title:
+        lines.append(title)
+    lines.append(_SERVING_FMT.format(*SERVING_COLUMNS))
+    for r in rows:
+        lines.append(_SERVING_FMT.format(
+            r["rank"], _fmt(r["qps"], "{:.1f}"),
+            _fmt(r["queue_depth"], "{:.0f}"),
+            _fmt(r["inflight"], "{:.0f}"),
+            _fmt(r["occupancy"], "{:.1f}"),
+            _fmt(r["p50_ms"], "{:.2f}"), _fmt(r["p99_ms"], "{:.2f}"),
+            _fmt(r["ok"], "{:.0f}"), _fmt(r["rejected"], "{:.0f}"),
+            _fmt(r["expired"], "{:.0f}")))
+    if unreachable:
+        lines.append(f"({unreachable} target(s) unreachable)")
+    return "\n".join(lines)
+
+
 def _fmt(v, pattern="{:.1f}") -> str:
     return pattern.format(v) if v is not None else "-"
 
@@ -186,9 +253,10 @@ class TopState:
     """Scrape-window state for the live view (previous step-histogram
     totals per target, so STEP ms is a window mean, not a lifetime one)."""
 
-    def __init__(self, targets: List[dict]):
+    def __init__(self, targets: List[dict], serving: bool = False):
         self.targets = targets
-        self._prev: Dict[int, Tuple[int, float]] = {}
+        self.serving = serving
+        self._prev: Dict[int, Tuple] = {}
 
     def refresh(self, window: bool = True) -> Tuple[List[dict], int]:
         rows, unreachable = [], 0
@@ -197,13 +265,23 @@ class TopState:
             if snap is None:
                 unreachable += 1
                 continue
-            row = row_from_snapshot(t, snap,
-                                    self._prev.get(i) if window else None)
-            if row["steps_raw"] is not None:
-                self._prev[i] = row["steps_raw"]
+            prev = self._prev.get(i) if window else None
+            if self.serving:
+                row = serving_row_from_snapshot(t, snap, prev)
+                self._prev[i] = row["qps_raw"]
+            else:
+                row = row_from_snapshot(t, snap, prev)
+                if row["steps_raw"] is not None:
+                    self._prev[i] = row["steps_raw"]
             rows.append(row)
         rows.sort(key=lambda r: (len(r["rank"]), r["rank"]))
         return rows, unreachable
+
+    def render(self, rows: List[dict], unreachable: int,
+               title: str) -> str:
+        if self.serving:
+            return render_serving(rows, unreachable, title)
+        return render(rows, unreachable, title)
 
 
 def _title(n_rows: int, n_targets: int) -> str:
@@ -215,8 +293,8 @@ def _loop_plain(state: TopState, interval: float):
     while True:
         rows, unreachable = state.refresh()
         sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty() else "")
-        print(render(rows, unreachable,
-                     _title(len(rows), len(state.targets))))
+        print(state.render(rows, unreachable,
+                           _title(len(rows), len(state.targets))))
         sys.stdout.flush()
         time.sleep(interval)
 
@@ -228,8 +306,8 @@ def _loop_curses(scr, state: TopState, interval: float):
     while True:
         rows, unreachable = state.refresh()
         scr.erase()
-        text = render(rows, unreachable,
-                      _title(len(rows), len(state.targets)))
+        text = state.render(rows, unreachable,
+                            _title(len(rows), len(state.targets)))
         maxy, maxx = scr.getmaxyx()
         for y, line in enumerate(text.splitlines()[:maxy - 1]):
             scr.addnstr(y, 0, line, maxx - 1)
@@ -257,6 +335,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "HOROVOD_TOP_INTERVAL)")
     parser.add_argument("--plain", action="store_true",
                         help="no curses, dumb redraw loop")
+    parser.add_argument("--serving", action="store_true",
+                        help="serving view: per-rank QPS, queue depth, "
+                             "batch occupancy, p50/p99 latency")
     args = parser.parse_args(argv)
 
     try:
@@ -269,7 +350,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               "at the rendezvous KV, or set HOROVOD_METRICS_PORT)",
               file=sys.stderr)
         return 2
-    state = TopState(targets)
+    state = TopState(targets, serving=args.serving)
 
     if args.once:
         rows, unreachable = state.refresh(window=False)
@@ -277,8 +358,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"hvd-top: none of {len(targets)} target(s) answered",
                   file=sys.stderr)
             return 1
-        print(render(rows, unreachable,
-                     _title(len(rows), len(targets))))
+        print(state.render(rows, unreachable,
+                           _title(len(rows), len(targets))))
         return 0
 
     interval = args.interval if args.interval is not None \

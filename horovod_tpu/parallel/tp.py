@@ -83,3 +83,70 @@ def tp_mlp(x: jax.Array, w_in_shard: jax.Array, w_out_shard: jax.Array,
     psum for the whole block."""
     h = activation(column_parallel(x, w_in_shard, axis=axis))
     return row_parallel(h, w_out_shard, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Inference path: forward-only TP with compressed activation collectives.
+#
+# Training reserved the int8 quantized collectives (EQuARX,
+# arXiv:2506.17615) for gradients; serving applies them to *activations* —
+# the row-parallel partial-product reduction is the only wire traffic of a
+# Megatron block, and at decode batch sizes it is latency- not
+# bandwidth-bound, so quartering its bytes shrinks the exposed-comm tail
+# directly. Forward-only: no custom_vjp wrappers (quantization is not
+# usefully differentiable, and serving never runs backward).
+
+
+def row_parallel_inference(x_shard: jax.Array, w_shard: jax.Array,
+                           b: Optional[jax.Array] = None,
+                           axis: str = "model",
+                           compression=None) -> jax.Array:
+    """Forward-only :func:`row_parallel` whose reduction can ride the int8
+    quantized wire. ``compression`` follows the
+    :class:`horovod_tpu.jax.compression.Compression` convention: a
+    compressor with ``quantized = True`` routes the partial-product sum
+    through ``quantized_allreduce`` (dequantize-reduce-requantize); anything
+    else is a plain psum. Bias is replicated, added after the reduction."""
+    from horovod_tpu.common.reduce_ops import Sum
+    from horovod_tpu.parallel.collectives import quantized_allreduce
+    y = jnp.einsum("...h,hd->...d", x_shard, w_shard)
+    if compression is not None and getattr(compression, "quantized", False):
+        y = quantized_allreduce(
+            y, op=Sum, axis=axis,
+            block_size=getattr(compression, "block_size", 256))
+    else:
+        y = lax.psum(y, axis)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp_inference(x: jax.Array, w_in_shard: jax.Array,
+                     w_out_shard: jax.Array,
+                     activation: Callable = jax.nn.gelu,
+                     axis: str = "model",
+                     compression=None) -> jax.Array:
+    """Forward-only :func:`tp_mlp` with a selectable activation wire format
+    for its single reduction (the serving executor's building block)."""
+    h = activation(jnp.einsum("...d,dh->...h", x, w_in_shard))
+    return row_parallel_inference(h, w_out_shard, axis=axis,
+                                  compression=compression)
+
+
+def tp_activation_wire_bytes(n_elements: int, world: int,
+                             compression=None,
+                             wire_bytes_per_elem: float = 4.0) -> int:
+    """Ring-allreduce wire bytes per rank for one activation reduction of
+    ``n_elements`` — the serving BENCH's int8-vs-fp32 savings accounting.
+    fp32 psum moves ``2*(world-1)/world * 4`` bytes/element (reduce-scatter
+    + all-gather phases); the quantized path moves int8 payloads plus one
+    fp32 scale per block on each phase."""
+    if world <= 1:
+        return 0
+    phase = 2.0 * (world - 1) / world
+    if compression is not None and getattr(compression, "quantized", False):
+        block = getattr(compression, "block_size", 256)
+        per_elem = 1.0 + 4.0 / block
+    else:
+        per_elem = wire_bytes_per_elem
+    return int(phase * per_elem * n_elements)

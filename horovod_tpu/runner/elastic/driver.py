@@ -475,10 +475,23 @@ class ElasticDriver:
         as driver-level structured events."""
         with self._lock:
             slots = list(self._expected_slots)
+            gen = self._generation
         times: Dict[int, float] = {}
         targets: List[dict] = []
+        serve_targets: List[dict] = []
         anomalies: List[Tuple[Tuple[str, int], dict, float]] = []
         for host, local_rank in slots:
+            # serving plane: aggregate worker-published serve endpoints
+            # into one key (the ingress router's discovery input — the
+            # serving analog of metrics_targets below)
+            sinfo = self._kv.get_json(f"serve_addr/{host}/{local_rank}")
+            if isinstance(sinfo, dict) and sinfo.get("addr") \
+                    and sinfo.get("port"):
+                serve_targets.append(
+                    {"id": sinfo.get("id") or f"{host}/{local_rank}",
+                     "addr": sinfo["addr"], "port": sinfo["port"],
+                     "rank": sinfo.get("rank"),
+                     "generation": sinfo.get("generation")})
             info = self._kv.get_json(f"metrics_addr/{host}/{local_rank}")
             # a malformed/partial KV entry skips THIS worker only — it must
             # not abort the whole scrape pass for the healthy ones
@@ -521,6 +534,18 @@ class ElasticDriver:
                 self._kv.put_json("metrics_targets", targets)
             except Exception:  # noqa: BLE001 — telemetry must not kill
                 pass  # the heartbeat
+        if serve_targets or getattr(self, "_serve_published", False):
+            # keep publishing once any serve worker has ever registered:
+            # an EMPTY table is routing information too (all workers gone
+            # -> ingress routers must drain, not keep a stale set), while
+            # pure-training jobs never touch the key
+            self._serve_published = True
+            try:
+                self._kv.put_json("serve_targets",
+                                  {"generation": gen,
+                                   "workers": serve_targets})
+            except Exception:  # noqa: BLE001 — routing discovery must not
+                pass  # kill the heartbeat either
         for key, info, delta in anomalies:
             self._ingest_anomaly(key, info, delta)
         if times:

@@ -1,0 +1,55 @@
+"""Online inference serving plane (docs/DESIGN.md "Serving plane").
+
+Everything through PR 7 served the *training* half of the north star; this
+package is the "heavy traffic from millions of users" half, built on the
+same engine, elastic runner, and metrics stack:
+
+- :mod:`~horovod_tpu.serve.batcher` — continuous-batching admission:
+  bounded queue with backpressure, per-request deadlines, length buckets
+  shared with the flash-attention router so every batch keeps one static
+  shape (and one kernel route) for its whole lifetime;
+- :mod:`~horovod_tpu.serve.executor` — the decode loop plus tensor-parallel
+  forward passes whose activation reductions ride the EQuARX int8 quantized
+  collectives (PR 1 built them for gradients; serving applies them to
+  activations);
+- :mod:`~horovod_tpu.serve.router` — request routing over the elastic
+  rendezvous KV: least-loaded placement, generation-change re-routing, and
+  drain-on-death with a no-silent-loss contract for accepted requests;
+- :mod:`~horovod_tpu.serve.frontend` — stdlib HTTP ingress (the
+  ``metrics/exporter.py`` server pattern): ``POST /v1/generate``,
+  ``GET /healthz``, ``GET /stats``;
+- :mod:`~horovod_tpu.serve.worker` — the per-process serving worker the
+  elastic driver spawns: registers its endpoint in the KV, heartbeats the
+  engine with small serving-mode collectives, drains instead of dropping
+  on membership changes;
+- :mod:`~horovod_tpu.serve.loadgen` — open-loop load generation behind the
+  BENCH ``serving`` block (p50/p99 vs offered load) and the small-tensor
+  latency microbench.
+
+The engine side is ``HOROVOD_SERVING_MODE``: sub-threshold collectives skip
+the fusion buffer (they are latency- not bandwidth-bound — the regime the
+MPI characterization work, arXiv:1810.11112, shows behaves nothing like
+gradient exchange) and the cycle wait is clamped to
+``HOROVOD_SERVING_CYCLE_TIME``.
+"""
+
+from horovod_tpu.serve.batcher import (  # noqa: F401
+    AdmissionRejected,
+    ContinuousBatcher,
+    InferenceRequest,
+    bucket_for,
+    bucket_plan,
+    default_buckets,
+)
+from horovod_tpu.serve.executor import (  # noqa: F401
+    ServingLoop,
+    activation_wire_report,
+    make_toy_step,
+    make_tp_lm_step,
+)
+from horovod_tpu.serve.frontend import ServeFrontend  # noqa: F401
+from horovod_tpu.serve.router import (  # noqa: F401
+    NoWorkersError,
+    RequestRouter,
+    WorkerHandle,
+)

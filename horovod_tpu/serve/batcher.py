@@ -1,0 +1,306 @@
+"""Continuous-batching admission control and scheduling.
+
+The serving-side counterpart of the engine's fusion buffer: where training
+batches *tensors* to amortize collective launch cost, serving batches
+*requests* to amortize forward-pass launch cost — but under a latency
+budget, so the scheduler is deadline- and occupancy-driven rather than
+byte-driven:
+
+- **bounded admission queue**: ``HOROVOD_SERVE_QUEUE_DEPTH`` requests may
+  wait; a full queue rejects immediately (backpressure — the caller gets a
+  429-shaped error *now* instead of a timeout later, and offered load past
+  saturation degrades gracefully instead of collapsing);
+- **per-request deadlines**: every request carries an absolute deadline
+  (client-supplied or ``HOROVOD_SERVE_DEADLINE_MS``); queued requests whose
+  deadline passes are expired without ever costing a forward pass, and
+  running ones are expired at the next step boundary;
+- **length buckets shared with the flash-attention router**: a request is
+  padded to the smallest power-of-two bucket that fits prompt + budget, and
+  a batch only ever contains one bucket — so each bucket compiles exactly
+  one executable for its whole lifetime, and the bucket's attention kernel
+  route (XLA dot below ``HOROVOD_FLASH_MIN_SEQ``, flash at/above — the PR-2
+  crossover) is a static property of the bucket, not a per-step surprise;
+- **continuous (in-flight) batching**: finished requests free their slots
+  at every decode-step boundary and queued same-bucket requests are
+  admitted into them immediately — no drain-the-batch barrier.
+
+All counters/histograms land in the process metrics registry
+(``hvd_serve_*`` families), so the Prometheus exporter, ``hvd-top
+--serving`` and the elastic driver see serving health with zero extra
+plumbing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+from horovod_tpu.common.env_registry import env_float, env_int
+from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+
+# Latency buckets for request-level histograms: serving targets live in the
+# 1ms..10s decade.
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+# Occupancy buckets (requests per step).
+OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+_TERMINAL = ("ok", "expired", "rejected", "failed")
+
+
+class AdmissionRejected(RuntimeError):
+    """The bounded admission queue is full (backpressure) or the request
+    cannot fit any bucket; the caller should shed or retry elsewhere."""
+
+
+def default_buckets(max_len: int = 2048, min_bucket: int = 32) -> Tuple[int,
+                                                                        ...]:
+    """Power-of-two padded lengths from ``min_bucket`` through ``max_len``
+    — the same geometric ladder the flash-attention block sizes assume, so
+    bucketed batches tile the kernel grid exactly."""
+    out = []
+    b = min_bucket
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits ``length``; raises
+    :class:`AdmissionRejected` when none does (the request could never
+    complete within the configured context)."""
+    for b in buckets:
+        if length <= b:
+            return b
+    raise AdmissionRejected(
+        f"request needs {length} tokens; largest bucket is {buckets[-1]}")
+
+
+def bucket_plan(buckets: Optional[Sequence[int]] = None,
+                max_len: int = 2048) -> List[dict]:
+    """Static routing plan per bucket: which attention kernel the PR-2
+    length router (:func:`horovod_tpu.ops.flash_attention.attention`) picks
+    for sequences padded to that bucket. Because a batch is single-bucket,
+    this is decided once per bucket — serving never flips kernels
+    mid-request."""
+    from horovod_tpu.ops.flash_attention import flash_min_seq
+    crossover = flash_min_seq()
+    return [{"bucket": b,
+             "attention_kernel": "flash" if b >= crossover else "xla"}
+            for b in (buckets or default_buckets(max_len))]
+
+
+class InferenceRequest:
+    """One admitted generation request.
+
+    Completion is signalled through a per-request event; the HTTP frontend
+    thread blocks on :meth:`wait` while the serving loop advances the
+    request one token per step. Terminal states: ``ok`` (budget or EOS
+    reached), ``expired`` (deadline passed — partial output is returned),
+    ``failed`` (executor error).
+    """
+
+    __slots__ = ("id", "tokens", "max_new_tokens", "deadline", "arrival",
+                 "bucket", "generated", "status", "error", "finished_at",
+                 "_done")
+
+    def __init__(self, tokens: Sequence[int], max_new_tokens: int,
+                 deadline: float, bucket: int,
+                 request_id: Optional[str] = None):
+        self.id = request_id or uuid.uuid4().hex[:16]
+        self.tokens = [int(t) for t in tokens]
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = float(deadline)  # absolute time.monotonic()
+        self.arrival = time.monotonic()
+        self.bucket = int(bucket)
+        self.generated: List[int] = []
+        self.status = "queued"
+        self.error = ""
+        self.finished_at: Optional[float] = None
+        self._done = threading.Event()
+
+    @property
+    def length(self) -> int:
+        """Current true (unpadded) sequence length."""
+        return len(self.tokens) + len(self.generated)
+
+    @property
+    def done(self) -> bool:
+        return self.status in _TERMINAL
+
+    def finish(self, status: str, error: str = ""):
+        if self.done:
+            return
+        self.status = status
+        self.error = error
+        self.finished_at = time.monotonic()
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self) -> dict:
+        latency = (self.finished_at - self.arrival) \
+            if self.finished_at is not None else None
+        return {"id": self.id, "status": self.status,
+                "tokens": list(self.generated),
+                "error": self.error or None,
+                "latency_ms": round(latency * 1e3, 3)
+                if latency is not None else None}
+
+
+class ContinuousBatcher:
+    """Admission queue + slot scheduler for the serving loop.
+
+    Thread contract: any number of producer threads call :meth:`submit`;
+    exactly one consumer (the serving loop) calls :meth:`fill`,
+    :meth:`observe_step` and :meth:`complete`.
+    """
+
+    def __init__(self, max_batch: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 max_len: int = 2048,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_new_tokens_cap: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.max_batch = max_batch if max_batch is not None \
+            else env_int("HOROVOD_SERVE_MAX_BATCH")
+        self.queue_depth = queue_depth if queue_depth is not None \
+            else env_int("HOROVOD_SERVE_QUEUE_DEPTH")
+        self.default_deadline_ms = default_deadline_ms \
+            if default_deadline_ms is not None \
+            else env_float("HOROVOD_SERVE_DEADLINE_MS")
+        self.max_new_tokens_cap = max_new_tokens_cap \
+            if max_new_tokens_cap is not None \
+            else env_int("HOROVOD_SERVE_MAX_NEW_TOKENS")
+        self.buckets = tuple(buckets) if buckets is not None \
+            else default_buckets(max_len)
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        reg = registry if registry is not None else get_registry()
+        self._requests = {s: reg.counter("hvd_serve_requests_total",
+                                         status=s)
+                          for s in _TERMINAL}
+        self._admitted = reg.counter("hvd_serve_admitted_total")
+        self._tokens_out = reg.counter("hvd_serve_tokens_total")
+        self._depth = reg.gauge("hvd_serve_queue_depth")
+        self._occupancy = reg.histogram("hvd_serve_batch_occupancy",
+                                        buckets=OCCUPANCY_BUCKETS)
+        self._latency = reg.histogram("hvd_serve_request_latency_seconds",
+                                      buckets=LATENCY_BUCKETS)
+        self._queue_wait = reg.histogram("hvd_serve_queue_wait_seconds",
+                                         buckets=LATENCY_BUCKETS)
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, tokens: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None) -> InferenceRequest:
+        """Admit a request or raise :class:`AdmissionRejected`.
+
+        Rejections are counted and *immediate* — backpressure is the
+        defined behavior past saturation, never an unbounded queue. The
+        bucket is fixed here (prompt + token budget), so a request's batch
+        shape and kernel route never change mid-flight."""
+        budget = min(int(max_new_tokens) if max_new_tokens is not None
+                     else self.max_new_tokens_cap, self.max_new_tokens_cap)
+        budget = max(budget, 1)
+        try:
+            bucket = bucket_for(len(tokens) + budget, self.buckets)
+        except AdmissionRejected:
+            self._requests["rejected"].inc()
+            raise
+        ddl_ms = float(deadline_ms) if deadline_ms is not None \
+            else self.default_deadline_ms
+        req = InferenceRequest(tokens, budget,
+                               time.monotonic() + ddl_ms / 1e3, bucket,
+                               request_id=request_id)
+        with self._lock:
+            if len(self._queue) >= self.queue_depth:
+                self._requests["rejected"].inc()
+                req.finish("rejected", "admission queue full (backpressure)")
+                raise AdmissionRejected(
+                    f"admission queue full ({self.queue_depth} waiting)")
+            self._queue.append(req)
+            self._depth.set(len(self._queue))
+            self._admitted.inc()
+            self._work.notify()
+        return req
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- consumer (serving loop) side ----------------------------------------
+
+    def wait_for_work(self, timeout: float) -> bool:
+        """Block until something is queued (or timeout); True when work
+        exists."""
+        with self._lock:
+            if self._queue:
+                return True
+            self._work.wait(timeout)
+            return bool(self._queue)
+
+    def _expire_queued_locked(self, now: float):
+        kept: deque = deque()
+        while self._queue:
+            req = self._queue.popleft()
+            if req.deadline <= now:
+                self._finish(req, "expired",
+                             "deadline passed while queued")
+            else:
+                kept.append(req)
+        self._queue = kept
+
+    def fill(self, running: List[InferenceRequest]) -> List[InferenceRequest]:
+        """One scheduling pass: expire stale queued requests, then admit
+        queued requests into free slots. Single-bucket batches: the first
+        admitted request pins the bucket; only same-bucket requests join
+        (others keep their arrival order for the next batch — skipped, not
+        reordered past each other)."""
+        now = time.monotonic()
+        out = [r for r in running if not r.done]
+        with self._lock:
+            self._expire_queued_locked(now)
+            bucket = out[0].bucket if out else None
+            if self._queue and bucket is None:
+                bucket = self._queue[0].bucket
+            skipped: List[InferenceRequest] = []
+            while self._queue and len(out) < self.max_batch:
+                req = self._queue.popleft()
+                if req.bucket != bucket:
+                    skipped.append(req)
+                    continue
+                req.status = "running"
+                self._queue_wait.observe(now - req.arrival)
+                out.append(req)
+            for req in reversed(skipped):
+                self._queue.appendleft(req)
+            self._depth.set(len(self._queue))
+        return out
+
+    def observe_step(self, occupancy: int):
+        if occupancy > 0:
+            self._occupancy.observe(occupancy)
+
+    def complete(self, req: InferenceRequest, status: str = "ok",
+                 error: str = ""):
+        self._finish(req, status, error)
+
+    def _finish(self, req: InferenceRequest, status: str, error: str = ""):
+        if req.done:
+            return
+        req.finish(status, error)
+        self._requests[status].inc()
+        if status == "ok":
+            self._tokens_out.inc(len(req.generated))
+        self._latency.observe(req.finished_at - req.arrival)

@@ -1,0 +1,280 @@
+"""Decode loop + tensor-parallel inference execution.
+
+The serving loop is a single consumer thread over a
+:class:`~horovod_tpu.serve.batcher.ContinuousBatcher`: every iteration it
+(1) frees finished slots and admits queued same-bucket requests into them,
+(2) runs ONE decode step for the whole in-flight batch, (3) appends each
+request's next token and completes any that reached their budget, EOS, or
+deadline. Continuous batching falls out of doing admission at every step
+boundary rather than per batch.
+
+``step_fn`` is the execution contract::
+
+    step_fn(tokens [B, L] int32, lengths [B] int32) -> next_token [B] int
+
+with ``B`` fixed at ``max_batch`` (inactive rows padded) and ``L`` the
+batch's bucket — so each bucket compiles exactly one executable.
+
+Two built-in step functions:
+
+- :func:`make_toy_step` — deterministic numpy-only model for tests and
+  subprocess serve workers (no jax import, instant startup);
+- :func:`make_tp_lm_step` — a tensor-parallel decoder over the ``model``
+  mesh axis whose per-layer row-parallel reduction rides the EQuARX int8
+  quantized allreduce when ``compression="int8"``
+  (:func:`horovod_tpu.parallel.tp.tp_mlp_inference`). This is the int8
+  *activation* path the ROADMAP calls out: PR 1 built the quantized
+  collectives for gradients; serving is where they meet activations.
+
+Decode here is prefill-style recompute (the full forward re-runs per
+token over the padded bucket). That keeps shapes static and the executor
+tiny; a KV-cache is an orthogonal follow-up and does not change any
+interface above ``step_fn``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+from horovod_tpu.serve.batcher import ContinuousBatcher, InferenceRequest
+
+StepFn = Callable[[np.ndarray, np.ndarray], np.ndarray]
+
+# Step-latency histogram bounds (seconds): decode steps live in 100us..1s.
+STEP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+
+
+class ServingLoop:
+    """Owns the decode thread; start/stop/drain lifecycle."""
+
+    def __init__(self, step_fn: StepFn, batcher: ContinuousBatcher,
+                 eos_token: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 idle_wait: float = 0.02):
+        self._step_fn = step_fn
+        self._batcher = batcher
+        self._eos = eos_token
+        self._idle_wait = idle_wait
+        reg = registry if registry is not None else get_registry()
+        self._inflight = reg.gauge("hvd_serve_inflight")
+        self._steps = reg.counter("hvd_serve_decode_steps_total")
+        self._step_seconds = reg.histogram("hvd_serve_step_seconds",
+                                           buckets=STEP_BUCKETS)
+        self._failures = reg.counter("hvd_serve_step_failures_total")
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServingLoop":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hvd-serve-loop")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop pulling new work but finish everything already accepted
+        (queued AND running) — the membership-change contract: a departing
+        worker completes what it admitted instead of dropping it. Returns
+        True when fully drained within ``timeout``."""
+        self._draining.set()
+        return self._idle.wait(timeout)
+
+    # -- the loop ------------------------------------------------------------
+
+    def _run(self):
+        running: List[InferenceRequest] = []
+        while not self._stop.is_set():
+            running = self._batcher.fill(running)
+            if not running:
+                self._idle.set()
+                self._inflight.set(0)
+                if self._draining.is_set() and not self._batcher.pending():
+                    break
+                self._batcher.wait_for_work(self._idle_wait)
+                continue
+            self._idle.clear()
+            self._inflight.set(len(running))
+            self._batcher.observe_step(len(running))
+            bucket = running[0].bucket
+            batch = self._batcher.max_batch
+            tokens = np.zeros((batch, bucket), np.int32)
+            lengths = np.ones(batch, np.int32)  # padded rows: 1 dummy token
+            for i, r in enumerate(running):
+                seq = r.tokens + r.generated
+                tokens[i, :len(seq)] = seq
+                lengths[i] = len(seq)
+            t0 = time.perf_counter()
+            try:
+                next_ids = np.asarray(self._step_fn(tokens, lengths))
+            except Exception as e:  # noqa: BLE001 — a broken executor must
+                # fail the requests it carried, loudly, not hang them
+                self._failures.inc()
+                for r in running:
+                    self._batcher.complete(r, "failed",
+                                           f"decode step failed: {e!r}")
+                running = []
+                continue
+            self._step_seconds.observe(time.perf_counter() - t0)
+            self._steps.inc()
+            now = time.monotonic()
+            still: List[InferenceRequest] = []
+            for i, r in enumerate(running):
+                r.generated.append(int(next_ids[i]))
+                if (self._eos is not None and
+                        r.generated[-1] == self._eos) or \
+                        len(r.generated) >= r.max_new_tokens or \
+                        r.length >= r.bucket:
+                    self._batcher.complete(r, "ok")
+                elif r.deadline <= now:
+                    self._batcher.complete(r, "expired",
+                                           "deadline passed mid-generation")
+                else:
+                    still.append(r)
+            running = still
+        self._inflight.set(0)
+        self._idle.set()
+
+
+# ---------------------------------------------------------------------------
+# step functions
+
+
+def make_toy_step(vocab: int = 256) -> StepFn:
+    """Deterministic numpy model: next token = (sum of live tokens +
+    length) mod vocab. Zero dependencies and microsecond steps — the
+    fixture for batcher/router/frontend tests and for subprocess serve
+    workers where importing jax would dominate startup."""
+
+    def step(tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        b, L = tokens.shape
+        mask = np.arange(L)[None, :] < lengths[:, None]
+        s = (tokens * mask).sum(axis=1) + lengths
+        return (s % vocab).astype(np.int32)
+
+    return step
+
+
+def _resolve_compression(compression):
+    if compression in (None, "none", ""):
+        return None
+    if compression == "int8":
+        from horovod_tpu.jax.compression import Compression
+        return Compression.int8
+    return compression  # a Compressor class
+
+
+def make_tp_lm_step(mesh=None, *, vocab: int = 256, hidden: int = 64,
+                    mlp_dim: int = 256, layers: int = 2, seed: int = 0,
+                    compression=None):
+    """Build a greedy-decode step over a small tensor-parallel decoder.
+
+    Returns ``(step_fn, info)``. The model is embeddings → ``layers`` ×
+    [LayerNorm → TP MLP (column/row parallel over the ``model`` axis) →
+    residual] → LayerNorm → tied logits, with the per-layer row-parallel
+    reduction in the wire format picked by ``compression`` (``None``/
+    ``"none"`` → fp32 psum, ``"int8"`` → EQuARX quantized allreduce).
+    Weights are deterministic from ``seed`` so every rank (and the
+    bit-exactness tests) build identical shards.
+
+    ``info`` carries the activation wire-byte accounting
+    (:func:`activation_wire_report`) — the BENCH ``serving`` block's
+    int8-vs-fp32 savings line."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.parallel import mesh as mesh_lib
+    from horovod_tpu.parallel.tp import tp_mlp_inference
+
+    comp = _resolve_compression(compression)
+    if mesh is None:
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshSpec(data=1, model=len(jax.devices())))
+    world = int(np.prod([mesh.shape[a] for a in ("model",)]))
+
+    rng = np.random.RandomState(seed)
+    embed = jnp.asarray(rng.randn(vocab, hidden) * 0.05, jnp.float32)
+    ws = []
+    for _ in range(layers):
+        ws.append(jnp.asarray(rng.randn(hidden, mlp_dim) * 0.05,
+                              jnp.float32))
+        ws.append(jnp.asarray(rng.randn(mlp_dim, hidden) * 0.05,
+                              jnp.float32))
+
+    def _ln(x):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-6)
+
+    def local(tokens, lengths, embed, *ws):
+        x = embed[tokens]  # [B, L, d]
+        for li in range(layers):
+            w_in, w_out = ws[2 * li], ws[2 * li + 1]
+            x = x + tp_mlp_inference(_ln(x), w_in, w_out,
+                                     activation=jnp.tanh, axis="model",
+                                     compression=comp)
+        logits = jnp.einsum("bld,vd->blv", _ln(x), embed)
+        idx = jnp.clip(lengths - 1, 0, tokens.shape[1] - 1)
+        last = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1)[:, 0]  # [B, V]
+        return jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    w_specs = []
+    for _ in range(layers):
+        w_specs.append(P(None, "model"))  # column-parallel up-projection
+        w_specs.append(P("model", None))  # row-parallel down-projection
+    mapped = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P(), *w_specs),
+        out_specs=P(), check_vma=False)
+    jitted = jax.jit(mapped)
+
+    def step_fn(tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        return np.asarray(jitted(jnp.asarray(tokens, jnp.int32),
+                                 jnp.asarray(lengths, jnp.int32),
+                                 embed, *ws))
+
+    info = {
+        "vocab": vocab, "hidden": hidden, "mlp_dim": mlp_dim,
+        "layers": layers, "tp_world": world,
+        "compression": "int8" if comp is not None and
+        getattr(comp, "quantized", False) else "none",
+        "wire": activation_wire_report(hidden, layers, world),
+    }
+    return step_fn, info
+
+
+def activation_wire_report(hidden: int, layers: int, world: int) -> dict:
+    """Per-token activation wire bytes of the TP forward (one row-parallel
+    reduction of ``hidden`` elements per layer) in fp32 vs int8 — the
+    measured-savings line of the BENCH ``serving`` block."""
+    from horovod_tpu.jax.compression import Compression
+    from horovod_tpu.parallel.tp import tp_activation_wire_bytes
+    n = hidden * layers
+    fp32 = tp_activation_wire_bytes(n, world, None)
+    int8 = tp_activation_wire_bytes(n, world, Compression.int8)
+    return {
+        "world": world,
+        "reduced_elems_per_token": n,
+        "fp32_bytes_per_token": fp32,
+        "int8_bytes_per_token": int8,
+        "int8_savings_x": round(fp32 / int8, 2) if int8 else None,
+    }

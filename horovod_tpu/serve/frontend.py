@@ -1,0 +1,262 @@
+"""Stdlib HTTP ingress for the serving plane.
+
+Same server pattern as the metrics exporter and the rendezvous KV
+(daemonized ``ThreadingHTTPServer``, port 0 for tests). Two modes:
+
+- **local** (``batcher=``): requests are admitted into this process's
+  continuous batcher and the handler thread blocks on the request event
+  until the serving loop completes it — this is what every serve *worker*
+  runs;
+- **routed** (``router=``): requests are forwarded to the least-loaded
+  registered worker with the router's no-silent-loss retry — this is the
+  cluster *ingress* in front of the elastic worker pool.
+
+Routes::
+
+    POST /v1/generate   {"tokens": [...] | "prompt": "text",
+                         "max_new_tokens": N, "deadline_ms": D, "id": ...}
+        -> 200 {"id", "status": "ok"|"expired"|"failed", "tokens", ...}
+        -> 429 on admission rejection (backpressure)
+        -> 503 when no worker accepts (routed mode)
+    GET /healthz        {"status": "ok"|"draining"}  (503 while draining —
+                        load balancers stop sending before the drain ends)
+    GET /stats          serving counters + p50/p99 snapshot
+
+``"prompt"`` strings are byte-level tokenized (UTF-8 bytes), which keeps
+the demo/example path dependency-free; real deployments submit token ids.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from horovod_tpu.metrics import histogram_quantile, snapshot_histogram, \
+    snapshot_value
+from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+from horovod_tpu.serve.batcher import AdmissionRejected, ContinuousBatcher
+from horovod_tpu.serve.router import (NoWorkersError, RequestRouter,
+                                      post_json)
+
+# extra grace past the request deadline before the handler gives up on the
+# serving loop delivering the completion event (it expires the request at
+# the next step boundary, which needs one in-flight step to pass)
+_WAIT_SLACK_SEC = 30.0
+
+
+def serving_stats(snapshot: dict) -> dict:
+    """Serving health summary from a ``/metrics.json`` snapshot — shared by
+    ``GET /stats``, ``hvd-top --serving`` and the BENCH serving block."""
+    lat = snapshot_histogram(snapshot, "hvd_serve_request_latency_seconds")
+    occ = snapshot_histogram(snapshot, "hvd_serve_batch_occupancy")
+    out = {
+        "requests_ok": snapshot_value(snapshot, "hvd_serve_requests_total",
+                                      status="ok") or 0,
+        "requests_rejected": snapshot_value(
+            snapshot, "hvd_serve_requests_total", status="rejected") or 0,
+        "requests_expired": snapshot_value(
+            snapshot, "hvd_serve_requests_total", status="expired") or 0,
+        "requests_failed": snapshot_value(
+            snapshot, "hvd_serve_requests_total", status="failed") or 0,
+        "queue_depth": snapshot_value(snapshot, "hvd_serve_queue_depth"),
+        "inflight": snapshot_value(snapshot, "hvd_serve_inflight"),
+        "tokens_out": snapshot_value(snapshot, "hvd_serve_tokens_total")
+        or 0,
+        "decode_steps": snapshot_value(snapshot,
+                                       "hvd_serve_decode_steps_total") or 0,
+    }
+    out["batch_occupancy_mean"] = round(occ["sum"] / occ["count"], 3) \
+        if occ else None
+    for q, key in ((0.5, "latency_p50_ms"), (0.99, "latency_p99_ms")):
+        v = histogram_quantile(lat, q) if lat else None
+        out[key] = round(v * 1e3, 3) if v is not None else None
+    return out
+
+
+def tokenize(body: dict) -> list:
+    """Token ids from a request body: ``tokens`` verbatim, else byte-level
+    of ``prompt``."""
+    if body.get("tokens") is not None:
+        return [int(t) for t in body["tokens"]]
+    return list(str(body.get("prompt", "")).encode())
+
+
+class ServeFrontend:
+    """Threaded ingress over a local batcher or a cluster router."""
+
+    def __init__(self, batcher: Optional[ContinuousBatcher] = None,
+                 router: Optional[RequestRouter] = None,
+                 port: int = 0, addr: str = "0.0.0.0",
+                 registry: Optional[MetricsRegistry] = None,
+                 dispatch_timeout: float = 60.0):
+        if (batcher is None) == (router is None):
+            raise ValueError("pass exactly one of batcher= (local worker "
+                             "mode) or router= (cluster ingress mode)")
+        self.batcher = batcher
+        self.router = router
+        self.registry = registry if registry is not None else get_registry()
+        self._dispatch_timeout = dispatch_timeout
+        self._draining = threading.Event()
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    if frontend.draining:
+                        self._reply(503, {"status": "draining"})
+                    else:
+                        self._reply(200, {"status": "ok"})
+                elif path == "/stats":
+                    self._reply(200, serving_stats(
+                        frontend.registry.snapshot()))
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                if path != "/v1/generate":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request body: {e}"})
+                    return
+                code, payload = frontend.handle_generate(body)
+                self._reply(code, payload)
+
+        self._httpd = ThreadingHTTPServer((addr, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServeFrontend":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="hvd-serve-frontend")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def set_draining(self, draining: bool = True):
+        """Flip the health state a load balancer keys on: /healthz returns
+        503 while the worker finishes what it already accepted."""
+        if draining:
+            self._draining.set()
+        else:
+            self._draining.clear()
+
+    # -- request handling (transport-free, test-drivable) --------------------
+
+    def handle_generate(self, body: dict):
+        """(status_code, payload) for one generate request."""
+        if self.batcher is not None:
+            return self._handle_local(body)
+        return self._handle_routed(body)
+
+    def _handle_local(self, body: dict):
+        if self.draining:
+            return 503, {"error": "worker draining", "status": "rejected"}
+        try:
+            req = self.batcher.submit(
+                tokenize(body),
+                max_new_tokens=body.get("max_new_tokens"),
+                deadline_ms=body.get("deadline_ms"),
+                request_id=body.get("id"))
+        except AdmissionRejected as e:
+            return 429, {"error": str(e), "status": "rejected"}
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is None:  # an explicit 0 means "already due",
+            deadline_ms = self.batcher.default_deadline_ms  # not default
+        if not req.wait(deadline_ms / 1e3 + _WAIT_SLACK_SEC):
+            # the loop should have expired it long before this fires; a
+            # hung executor must still not wedge the handler thread
+            self.batcher.complete(req, "failed", "serving loop unresponsive")
+            return 500, req.result()
+        code = {"ok": 200, "expired": 504, "failed": 500,
+                "rejected": 429}.get(req.status, 500)
+        return code, req.result()
+
+    def _handle_routed(self, body: dict):
+        rid = str(body.get("id") or id(body))
+        body = dict(body, id=rid)
+        try:
+            resp = self.router.submit(
+                rid, body,
+                lambda w, payload: post_json(
+                    w.addr, w.port, "/v1/generate", payload,
+                    timeout=self._dispatch_timeout))
+        except NoWorkersError as e:
+            return 503, {"error": str(e), "status": "failed", "id": rid}
+        code = {"ok": 200, "expired": 504, "failed": 500,
+                "rejected": 429}.get(resp.get("status"), 200)
+        return code, resp
+
+
+def main(argv=None) -> int:
+    """``hvd-serve``: boot a demo local serving worker (tiny TP LM over
+    every visible device, int8 activation collectives) and serve until
+    interrupted. Production deployments embed :class:`ServeFrontend` /
+    :mod:`horovod_tpu.serve.worker` instead."""
+    import argparse
+    from horovod_tpu.common.env_registry import env_int
+    from horovod_tpu.serve.executor import ServingLoop, make_tp_lm_step
+
+    parser = argparse.ArgumentParser(
+        prog="hvd-serve", description="demo serving worker (tiny TP LM)")
+    parser.add_argument("--port", type=int,
+                        default=env_int("HOROVOD_SERVE_PORT", 0) or 0)
+    parser.add_argument("--compression", default=None,
+                        help="activation wire format: none | int8 "
+                             "(default HOROVOD_SERVE_ACT_COMPRESSION)")
+    args = parser.parse_args(argv)
+    from horovod_tpu.common.env_registry import env_str
+    compression = args.compression if args.compression is not None \
+        else env_str("HOROVOD_SERVE_ACT_COMPRESSION")
+
+    step_fn, info = make_tp_lm_step(compression=compression)
+    batcher = ContinuousBatcher()
+    loop = ServingLoop(step_fn, batcher).start()
+    frontend = ServeFrontend(batcher=batcher, port=args.port).start()
+    print(f"hvd-serve: listening on :{frontend.port} "
+          f"(tp_world={info['tp_world']}, "
+          f"compression={info['compression']})", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        loop.drain(timeout=10.0)
+        loop.stop()
+        frontend.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,268 @@
+"""Request routing over the elastic worker registry.
+
+The router is the traffic-direction half of the serving plane: it holds the
+live worker table (fed from the elastic rendezvous KV, where serve workers
+publish their HTTP endpoints and the driver aggregates them into the
+``serve_targets`` key each heartbeat), places each request on the
+least-loaded accepting worker, and enforces the serving plane's central
+durability contract:
+
+    **an accepted request is never silently lost.**
+
+Concretely:
+
+- a worker absent from a new generation is *drained* — no new placements,
+  in-flight requests get ``HOROVOD_SERVE_DRAIN_TIMEOUT_SECONDS`` to finish
+  on the departing worker before the router re-routes them;
+- a worker that *dies* (connection refused / reset mid-request) is marked
+  dead immediately and the failed dispatch is retried on a surviving
+  worker, up to ``HOROVOD_SERVE_RETRY_LIMIT`` times; only an exhausted
+  retry budget surfaces an error to the caller (loud, counted in
+  ``hvd_serve_lost_total`` — which a healthy cluster keeps at zero);
+- generation changes (elastic resize) swap the worker table atomically:
+  re-registered workers keep serving, new ones join the rotation, departed
+  ones drain.
+
+Transport is pluggable: :meth:`RequestRouter.submit` takes a ``send``
+callable, so tests drive routing with in-process functions and production
+uses :func:`post_json` against worker frontends.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+from horovod_tpu.common.env_registry import env_int
+from horovod_tpu.common.hvd_logging import get_logger
+from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+
+UP = "up"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class NoWorkersError(RuntimeError):
+    """No accepting worker is registered (all dead/draining or none yet)."""
+
+
+def post_json(addr: str, port: int, path: str, payload: dict,
+              timeout: float = 30.0) -> dict:
+    """POST a JSON body, return the decoded JSON response.
+
+    Only *transport* failures raise (connection refused/reset, timeout —
+    the router's he's-dead retry path). An HTTP error status means the
+    worker answered — a 429 is backpressure from a live worker, not a
+    death — so its JSON body is returned like any other response and the
+    ``status`` field carries the verdict."""
+    body = json.dumps(payload).encode()
+    req = urlrequest.Request(f"http://{addr}:{port}{path}", data=body,
+                             method="POST",
+                             headers={"Content-Type": "application/json"})
+    try:
+        with urlrequest.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urlerror.HTTPError as e:
+        return json.loads(e.read())
+
+
+class WorkerHandle:
+    """One registered serving worker as the router sees it."""
+
+    __slots__ = ("id", "addr", "port", "rank", "generation", "state",
+                 "inflight")
+
+    def __init__(self, id: str, addr: str, port: int, rank: Optional[int],
+                 generation: int):
+        self.id = id
+        self.addr = addr
+        self.port = int(port)
+        self.rank = rank
+        self.generation = generation
+        self.state = UP
+        self.inflight: set = set()
+
+    @property
+    def accepting(self) -> bool:
+        return self.state == UP
+
+    def describe(self) -> dict:
+        return {"id": self.id, "addr": self.addr, "port": self.port,
+                "rank": self.rank, "generation": self.generation,
+                "state": self.state, "inflight": len(self.inflight)}
+
+
+class RequestRouter:
+    def __init__(self, retry_limit: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.retry_limit = retry_limit if retry_limit is not None \
+            else env_int("HOROVOD_SERVE_RETRY_LIMIT")
+        self._lock = threading.Lock()
+        self._workers: Dict[str, WorkerHandle] = {}
+        self.generation = -1
+        self._log = get_logger("serve.router")
+        reg = registry if registry is not None else get_registry()
+        self._routed = reg.counter("hvd_serve_routed_total")
+        self._rerouted = reg.counter("hvd_serve_rerouted_total")
+        self._lost = reg.counter("hvd_serve_lost_total")
+        self._workers_up = reg.gauge("hvd_serve_workers_up")
+
+    # -- registry maintenance -----------------------------------------------
+
+    def update_workers(self, entries: List[dict], generation: int):
+        """Install the worker set of ``generation``. Entries:
+        ``{"id", "addr", "port", "rank"?}``. Workers absent from the new
+        set begin draining (their in-flight requests finish or get
+        re-routed by their own dispatch threads); dead ones stay dead."""
+        with self._lock:
+            seen = set()
+            for e in entries:
+                wid = str(e.get("id") or f"{e['addr']}:{e['port']}")
+                # `or` would coerce an explicit generation 0 to the table
+                # generation and revive a gen-0 corpse from its own stale
+                # record — only a MISSING field inherits the table's
+                eg = e.get("generation")
+                entry_gen = int(eg) if eg is not None else int(generation)
+                seen.add(wid)
+                w = self._workers.get(wid)
+                if w is None:
+                    self._workers[wid] = w = WorkerHandle(
+                        wid, e["addr"], e["port"], e.get("rank"),
+                        entry_gen)
+                else:
+                    w.addr, w.port = e["addr"], int(e["port"])
+                    w.rank = e.get("rank", w.rank)
+                    if w.state == DRAINING:
+                        # re-registered in the new generation: it stayed
+                        w.state = UP
+                    elif w.state == DEAD and entry_gen > w.generation:
+                        # a respawned slot reuses its id: only a STRICTLY
+                        # newer registration revives it — the dead
+                        # worker's stale KV record (same generation)
+                        # must not resurrect a corpse into the rotation
+                        w.state = UP
+                        w.inflight.clear()
+                    w.generation = max(w.generation, entry_gen)
+            for wid_, w_ in list(self._workers.items()):
+                if wid_ not in seen:
+                    if w_.state == UP:
+                        w_.state = DRAINING
+                        self._log.info(
+                            "worker %s absent from generation %d: draining "
+                            "(%d in flight)", wid_, generation,
+                            len(w_.inflight))
+                    if not w_.inflight and w_.state == DRAINING:
+                        del self._workers[wid_]
+            self.generation = generation
+            self._refresh_gauge_locked()
+
+    def refresh_from_kv(self, kv_get_json: Callable[[str], Optional[dict]]):
+        """Pull the driver-published ``serve_targets`` key (same pattern as
+        ``hvd-top``'s ``metrics_targets``) and install it. ``kv_get_json``
+        is any ``key -> dict|None`` getter (KVServer.get_json,
+        KVClient.get_json)."""
+        info = kv_get_json("serve_targets")
+        if not isinstance(info, dict) or "workers" not in info:
+            return
+        self.update_workers(info["workers"],
+                            int(info.get("generation", 0)))
+
+    def fail_worker(self, worker_id: str) -> List[str]:
+        """Mark a worker dead; returns the request ids that were in flight
+        on it (each owning dispatch thread re-routes its own)."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None:
+                return []
+            w.state = DEAD
+            orphans = list(w.inflight)
+            w.inflight.clear()
+            self._refresh_gauge_locked()
+        if orphans:
+            self._log.warning("worker %s died with %d request(s) in "
+                              "flight; re-routing", worker_id, len(orphans))
+        return orphans
+
+    def drain_worker(self, worker_id: str) -> List[str]:
+        """Administrative drain: stop new placements, report in-flight."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None:
+                return []
+            if w.state == UP:
+                w.state = DRAINING
+            self._refresh_gauge_locked()
+            return list(w.inflight)
+
+    def workers(self) -> List[dict]:
+        with self._lock:
+            return [w.describe() for w in self._workers.values()]
+
+    def _refresh_gauge_locked(self):
+        self._workers_up.set(
+            sum(1 for w in self._workers.values() if w.accepting))
+
+    # -- placement -----------------------------------------------------------
+
+    def pick(self, exclude: Optional[set] = None) -> WorkerHandle:
+        """Least-loaded accepting worker (ties by id for determinism)."""
+        with self._lock:
+            candidates = [w for w in self._workers.values()
+                          if w.accepting and
+                          (not exclude or w.id not in exclude)]
+            if not candidates:
+                raise NoWorkersError(
+                    "no accepting serving worker registered")
+            return min(candidates, key=lambda w: (len(w.inflight), w.id))
+
+    def assign(self, worker: WorkerHandle, request_id: str):
+        with self._lock:
+            worker.inflight.add(request_id)
+
+    def complete(self, worker: WorkerHandle, request_id: str):
+        with self._lock:
+            worker.inflight.discard(request_id)
+            if worker.state == DRAINING and not worker.inflight:
+                self._workers.pop(worker.id, None)
+                self._log.info("worker %s fully drained", worker.id)
+
+    def submit(self, request_id: str, payload: dict,
+               send: Callable[[WorkerHandle, dict], dict]) -> dict:
+        """Dispatch with the no-silent-loss contract: pick → send; a
+        transport failure marks the worker dead and retries on a survivor
+        (``hvd_serve_rerouted_total``), up to ``retry_limit`` extra
+        attempts. Exhaustion raises — counted in ``hvd_serve_lost_total``,
+        which a healthy cluster pins at zero."""
+        last: Optional[Exception] = None
+        tried: set = set()
+        for attempt in range(self.retry_limit + 1):
+            try:
+                worker = self.pick(exclude=tried)
+            except NoWorkersError:
+                # every known worker already failed this request — widen
+                # back out in case a replacement registered meanwhile
+                try:
+                    worker = self.pick()
+                except NoWorkersError:
+                    break
+            self.assign(worker, request_id)
+            try:
+                resp = send(worker, payload)
+            except Exception as e:  # noqa: BLE001 — transport failure is
+                # the retry path, not a crash
+                last = e
+                tried.add(worker.id)
+                self.fail_worker(worker.id)
+                if attempt < self.retry_limit:
+                    self._rerouted.inc()
+                continue
+            self.complete(worker, request_id)
+            self._routed.inc()
+            return resp
+        self._lost.inc()
+        raise NoWorkersError(
+            f"request {request_id} failed after {self.retry_limit + 1} "
+            f"attempt(s): {last!r}")

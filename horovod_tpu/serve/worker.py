@@ -1,0 +1,267 @@
+"""Per-process serving worker (``python -m horovod_tpu.serve.worker``).
+
+What the elastic driver spawns for the serving plane. Each worker:
+
+1. rendezvouses through the standard elastic handshake (READY/go barrier,
+   :mod:`horovod_tpu.runner.elastic.worker`) when driver-spawned;
+2. boots a local continuous-batching serving stack (batcher → serving loop
+   → HTTP frontend) and **registers its endpoint** in the rendezvous KV
+   under ``serve_addr/<host>/<local_rank>`` — the driver aggregates these
+   into ``serve_targets`` each heartbeat, which is what the ingress
+   router's :meth:`~horovod_tpu.serve.router.RequestRouter.refresh_from_kv`
+   consumes;
+3. when the job has peers and a controller, opens an engine session and
+   exchanges a small **heartbeat allreduce** between decode steps — real
+   serving-regime traffic: sub-4-KiB, latency-bound, riding the
+   serving-mode express lane and recorded by the flight recorder like any
+   other collective. A peer death therefore surfaces as a fast-abort
+   within one cycle, not a 30 s timeout;
+4. on a generation change (driver notify key, or an engine abort after a
+   peer death) it **drains instead of dropping**: /healthz flips to 503,
+   accepted requests finish, then the worker re-rendezvouses and
+   re-registers under the new generation — or exits cleanly if its slot
+   was removed;
+5. exits 0 when the KV publishes ``serve_stop`` (job teardown).
+
+The default model is the numpy toy step (instant startup — what the
+subprocess fault tests spawn); ``--model tp`` boots the tensor-parallel LM
+with int8 activation collectives instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.common.env_registry import (env_int, env_is_set, env_str)
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.common.hvd_logging import get_logger
+from horovod_tpu.serve.batcher import ContinuousBatcher
+from horovod_tpu.serve.executor import ServingLoop, make_toy_step
+from horovod_tpu.serve.frontend import ServeFrontend
+
+HB_INTERVAL_SEC = 0.25
+POLL_INTERVAL_SEC = 0.05
+# serve_stop / resize notifications are HTTP round trips against the one
+# rendezvous KV server every worker shares — poll them at heartbeat-ish
+# cadence, not the loop tick (40 req/s/worker of pure polling otherwise)
+KV_POLL_INTERVAL_SEC = 1.0
+
+
+class EngineHeartbeat:
+    """Small-tensor liveness collective between serving peers.
+
+    One 16-element fp32 allreduce (64 bytes — deep inside the low-latency
+    threshold) per interval, named per generation so every rank of a
+    generation advances the same sequence. Failure means a peer died or
+    aborted: the caller tears the session down and re-rendezvouses."""
+
+    def __init__(self, rank: int, size: int, generation: int):
+        from horovod_tpu.engine import bindings
+        self._bindings = bindings
+        self._lib = bindings.load_library()
+        self.session = bindings.EngineSession(
+            rank=rank, size=size, transport="tcp",
+            local_rank=env_int("HOROVOD_LOCAL_RANK"),
+            local_size=env_int("HOROVOD_LOCAL_SIZE"))
+        self._gen = generation
+        self._seq = 0
+        session = self.session
+
+        def cb(resp):
+            buf = np.ones(16, np.float32)
+            return self._lib.hvdtpu_data_allreduce(
+                session._session, buf.ctypes.data, 16,
+                bindings.DTYPE_IDS["float32"], 0, 1.0, 1.0)
+
+        self.session.set_execute_callback(cb)
+
+    def beat(self, timeout: float = 30.0):
+        """One heartbeat collective; raises HorovodInternalError on peer
+        failure (fast abort)."""
+        from horovod_tpu.engine.bindings import OP_ALLREDUCE
+        name = f"serve.hb.g{self._gen}.{self._seq}"
+        self._seq += 1
+        h = self.session.enqueue(name, OP_ALLREDUCE, "float32", [16])
+        self.session.wait(h, timeout=timeout)
+
+    def close(self):
+        try:
+            self.session.shutdown()
+        except Exception:  # noqa: BLE001 — already aborted/dead is fine
+            try:
+                self.session.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class ServeWorker:
+    """Local serving stack + KV registration for one process."""
+
+    def __init__(self, step_fn=None, port: Optional[int] = None,
+                 batcher: Optional[ContinuousBatcher] = None):
+        self.batcher = batcher or ContinuousBatcher()
+        self.loop = ServingLoop(step_fn or make_toy_step(), self.batcher)
+        self.frontend = ServeFrontend(
+            batcher=self.batcher,
+            port=port if port is not None
+            else (env_int("HOROVOD_SERVE_PORT") or 0))
+        self._log = get_logger("serve.worker")
+        self._kv = None
+
+    def start(self) -> "ServeWorker":
+        self.loop.start()
+        self.frontend.start()
+        return self
+
+    def stop(self):
+        self.loop.stop()
+        self.frontend.stop()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Flip health to draining and finish everything accepted."""
+        self.frontend.set_draining(True)
+        drained = self.loop.drain(timeout)
+        if not drained:
+            self._log.warning("drain timed out with work still in flight")
+        return drained
+
+    # -- KV registration -----------------------------------------------------
+
+    def _slot(self):
+        return (env_str("HOROVOD_HOSTNAME", socket.gethostname()),
+                str(env_int("HOROVOD_LOCAL_RANK")))
+
+    def register(self, kv_client, generation: int):
+        """Publish this worker's endpoint for the driver's serve_targets
+        aggregation (exporter._publish_endpoint pattern)."""
+        self._kv = kv_client
+        host, local_rank = self._slot()
+        addr = "127.0.0.1" if host == "localhost" else host
+        kv_client.put_json(
+            f"serve_addr/{host}/{local_rank}",
+            {"id": f"{host}/{local_rank}", "addr": addr,
+             "port": self.frontend.port, "rank": env_int("HOROVOD_RANK"),
+             "generation": generation}, timeout=5.0)
+        self._log.info("registered serve endpoint :%d (generation %d)",
+                       self.frontend.port, generation)
+
+    def deregister(self):
+        if self._kv is None:
+            return
+        host, local_rank = self._slot()
+        try:
+            self._kv.delete(f"serve_addr/{host}/{local_rank}")
+        except Exception:  # noqa: BLE001 — KV may already be gone at exit
+            pass
+
+
+def _build_step(model: str, compression: Optional[str]):
+    if model == "tp":
+        from horovod_tpu.serve.executor import make_tp_lm_step
+        step_fn, info = make_tp_lm_step(
+            compression=compression
+            if compression is not None
+            else env_str("HOROVOD_SERVE_ACT_COMPRESSION"))
+        return step_fn
+    return make_toy_step()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="hvd-serve-worker")
+    parser.add_argument("--model", choices=("toy", "tp"), default="toy")
+    parser.add_argument("--compression", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    log = get_logger("serve.worker")
+    from horovod_tpu.runner.elastic import worker as elastic_worker
+
+    elastic = elastic_worker.is_elastic_worker()
+    generation = 0
+    if elastic:
+        generation = elastic_worker.rendezvous()
+    kv = elastic_worker.kv_client() \
+        if env_is_set("HOROVOD_RENDEZVOUS_ADDR") else None
+
+    worker = ServeWorker(_build_step(args.model, args.compression),
+                         port=args.port).start()
+    if kv is not None:
+        worker.register(kv, generation)
+
+    def make_heartbeat() -> Optional[EngineHeartbeat]:
+        size = env_int("HOROVOD_SIZE")
+        if size <= 1 or not env_int("HOROVOD_CONTROLLER_PORT"):
+            return None
+        return EngineHeartbeat(env_int("HOROVOD_RANK"), size, generation)
+
+    hb = make_heartbeat()
+    last_beat = 0.0
+    last_kv_poll = 0.0
+    try:
+        while True:
+            time.sleep(POLL_INTERVAL_SEC)
+            now = time.monotonic()
+            kv_due = kv is not None and \
+                now - last_kv_poll >= KV_POLL_INTERVAL_SEC
+            if kv_due:
+                last_kv_poll = now
+                if kv.get_json("serve_stop", timeout=1.0) is not None:
+                    log.info("serve_stop published; draining and exiting")
+                    worker.drain(timeout=30.0)
+                    if elastic:
+                        elastic_worker.record_state(
+                            generation, elastic_worker.SUCCESS, kv)
+                    return 0
+            reset_needed = False
+            heartbeat_failed = False
+            if hb is not None and now - last_beat >= HB_INTERVAL_SEC:
+                last_beat = now
+                try:
+                    hb.beat()
+                except HorovodInternalError as e:
+                    # peer death/abort: fast abort delivered this within
+                    # one cycle. Keep serving what we accepted; rejoin the
+                    # next generation (elastic) or exit loudly (static).
+                    log.warning("heartbeat collective failed (%s)", e)
+                    reset_needed = heartbeat_failed = True
+            if elastic and not reset_needed and kv_due:
+                new_gen = elastic_worker.poll_notification(kv)
+                reset_needed = new_gen is not None
+            if reset_needed:
+                if hb is not None:
+                    hb.close()
+                    hb = None
+                if not elastic:
+                    # no rendezvous to rejoin: a static job cannot heal —
+                    # finish what we accepted, then fail loudly so the
+                    # launcher sees a dead worker instead of a silent
+                    # heartbeat-retry spin
+                    log.error("peer failure in a static job; draining "
+                              "and exiting")
+                    worker.drain(timeout=30.0)
+                    worker.deregister()
+                    return 1
+                if heartbeat_failed:
+                    elastic_worker.request_new_generation()
+                try:
+                    generation = elastic_worker.rendezvous()
+                except SystemExit:
+                    # this slot was removed: drain instead of dropping
+                    log.info("slot removed at resize; draining")
+                    worker.drain(timeout=30.0)
+                    worker.deregister()
+                    return 0
+                worker.register(kv, generation)
+                hb = make_heartbeat()
+    finally:
+        worker.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

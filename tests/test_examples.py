@@ -84,6 +84,16 @@ def test_gpt_train_example():
     assert "done: final loss" in text, text
 
 
+def test_jax_serve_example():
+    """The serving-plane walkthrough (batcher -> router -> drain) runs
+    end-to-end over real HTTP on the virtual mesh."""
+    text = _run_script(
+        "examples/jax/jax_serve.py",
+        env_extra={"XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=8"})
+    assert "done: serving plane OK" in text, text
+
+
 def test_spark_estimator_example():
     """The estimator workflow example runs end-to-end on the pandas path
     (no Spark session needed). The example seeds TF weight init, so its

@@ -1,0 +1,794 @@
+"""Serving-plane tests: continuous batching, routing, drain-on-death, and
+the engine's low-latency (serving-mode) collective path.
+
+Tier-1 discipline: every HTTP server binds port 0, subprocess tests are
+deadline-bounded, and sustained-load soaks are ``slow``-marked. Each test
+that counts metrics uses its own MetricsRegistry so parallel test history
+can't leak across assertions.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+import uuid
+from urllib import error as urlerror
+
+import numpy as np
+import pytest
+
+from horovod_tpu.metrics.registry import MetricsRegistry
+from horovod_tpu.serve.batcher import (AdmissionRejected, ContinuousBatcher,
+                                       bucket_for, bucket_plan,
+                                       default_buckets)
+from horovod_tpu.serve.executor import ServingLoop, make_toy_step
+from horovod_tpu.serve.frontend import ServeFrontend, serving_stats
+from horovod_tpu.serve.router import (NoWorkersError, RequestRouter,
+                                      post_json)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stack(step_fn=None, **kw):
+    """Fresh batcher + serving loop on an isolated registry."""
+    reg = MetricsRegistry()
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("default_deadline_ms", 2000.0)
+    kw.setdefault("max_len", 128)
+    batcher = ContinuousBatcher(registry=reg, **kw)
+    loop = ServingLoop(step_fn or make_toy_step(), batcher, registry=reg)
+    return reg, batcher, loop
+
+
+def _toy_reference(tokens, n_new, vocab=256):
+    """The toy model's expected greedy continuation."""
+    seq = list(tokens)
+    out = []
+    for _ in range(n_new):
+        nxt = (sum(seq) + len(seq)) % vocab
+        out.append(nxt)
+        seq.append(nxt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+
+
+def test_default_buckets_and_bucket_for():
+    buckets = default_buckets(max_len=256, min_bucket=32)
+    assert buckets == (32, 64, 128, 256)
+    assert bucket_for(1, buckets) == 32
+    assert bucket_for(32, buckets) == 32
+    assert bucket_for(33, buckets) == 64
+    assert bucket_for(256, buckets) == 256
+    with pytest.raises(AdmissionRejected):
+        bucket_for(257, buckets)
+
+
+def test_bucket_plan_reuses_flash_length_router(monkeypatch):
+    """The per-bucket attention route is the PR-2 length router's
+    crossover: buckets below HOROVOD_FLASH_MIN_SEQ plan the XLA kernel,
+    the rest flash — and moving the env knob moves the plan."""
+    monkeypatch.setenv("HOROVOD_FLASH_MIN_SEQ", "128")
+    plan = {p["bucket"]: p["attention_kernel"]
+            for p in bucket_plan(default_buckets(256, 32))}
+    assert plan == {32: "xla", 64: "xla", 128: "flash", 256: "flash"}
+    monkeypatch.setenv("HOROVOD_FLASH_MIN_SEQ", "1024")
+    plan = {p["bucket"]: p["attention_kernel"]
+            for p in bucket_plan(default_buckets(256, 32))}
+    assert set(plan.values()) == {"xla"}
+
+
+# ---------------------------------------------------------------------------
+# batcher: deadlines, backpressure, scheduling
+
+
+def test_queued_deadline_expires_without_execution():
+    reg, batcher, _ = _stack()  # no loop running: requests sit queued
+    req = batcher.submit([1, 2, 3], max_new_tokens=4, deadline_ms=10.0)
+    time.sleep(0.05)
+    assert batcher.fill([]) == []  # expired at scheduling time, never admitted
+    assert req.status == "expired"
+    assert req.generated == []
+    from horovod_tpu.metrics import snapshot_value
+    assert snapshot_value(reg.snapshot(), "hvd_serve_requests_total",
+                          status="expired") == 1
+
+
+def test_backpressure_rejects_when_queue_full():
+    reg, batcher, _ = _stack(queue_depth=3)
+    for i in range(3):
+        batcher.submit([i], max_new_tokens=1)
+    with pytest.raises(AdmissionRejected):
+        batcher.submit([99], max_new_tokens=1)
+    from horovod_tpu.metrics import snapshot_value
+    snap = reg.snapshot()
+    assert snapshot_value(snap, "hvd_serve_requests_total",
+                          status="rejected") == 1
+    assert snapshot_value(snap, "hvd_serve_queue_depth") == 3
+
+
+def test_explicit_zero_budget_is_not_the_default_cap():
+    """max_new_tokens=0 is a tiny request (floored to 1 token), NOT a
+    fall-through to the 32-token default cap (falsy-zero regression)."""
+    _, batcher, _ = _stack()
+    req = batcher.submit([1, 2, 3], max_new_tokens=0)
+    assert req.max_new_tokens == 1
+
+
+def test_single_bucket_batches():
+    """fill() never mixes buckets: a 32-bucket and a 128-bucket request
+    are scheduled in separate batches, in arrival order per bucket."""
+    _, batcher, _ = _stack(max_len=128)
+    small = batcher.submit([1] * 4, max_new_tokens=4)        # bucket 32
+    big = batcher.submit([1] * 100, max_new_tokens=4)        # bucket 128
+    small2 = batcher.submit([2] * 5, max_new_tokens=4)       # bucket 32
+    batch1 = batcher.fill([])
+    assert {r.id for r in batch1} == {small.id, small2.id}
+    for r in batch1:
+        batcher.complete(r, "ok")
+    batch2 = batcher.fill([])
+    assert [r.id for r in batch2] == [big.id]
+
+
+def test_decode_completes_and_matches_toy_reference():
+    _, batcher, loop = _stack()
+    loop.start()
+    try:
+        reqs = [batcher.submit([i, i + 1, i + 2], max_new_tokens=5)
+                for i in range(3)]
+        for r in reqs:
+            assert r.wait(10.0), r.status
+            assert r.status == "ok"
+        for i, r in enumerate(reqs):
+            assert r.generated == _toy_reference([i, i + 1, i + 2], 5)
+    finally:
+        loop.stop()
+
+
+def test_continuous_batching_admits_into_inflight_batch():
+    """A request submitted while a batch is mid-generation joins it at a
+    step boundary (occupancy reaches 2) instead of waiting for a drain."""
+    reg, batcher, _ = _stack()
+    step_base = make_toy_step()
+
+    def slow_step(tokens, lengths):
+        time.sleep(0.02)
+        return step_base(tokens, lengths)
+
+    loop = ServingLoop(slow_step, batcher, registry=reg).start()
+    try:
+        first = batcher.submit([1, 2], max_new_tokens=30)
+        time.sleep(0.06)  # a few steps in flight
+        second = batcher.submit([3, 4], max_new_tokens=2)
+        assert second.wait(10.0) and second.status == "ok"
+        assert not first.done  # joined and finished while first still ran
+        assert first.wait(10.0) and first.status == "ok"
+        from horovod_tpu.metrics import snapshot_histogram
+        occ = snapshot_histogram(reg.snapshot(), "hvd_serve_batch_occupancy")
+        # some steps carried both requests (occupancy bucket > 1)
+        assert sum(occ["counts"][1:]) > 0, occ
+    finally:
+        loop.stop()
+
+
+def test_mid_generation_deadline_returns_partial():
+    _, batcher, _ = _stack()
+    step_base = make_toy_step()
+
+    def slow_step(tokens, lengths):
+        time.sleep(0.03)
+        return step_base(tokens, lengths)
+
+    reg2 = MetricsRegistry()
+    loop = ServingLoop(slow_step, batcher, registry=reg2).start()
+    try:
+        req = batcher.submit([5, 6], max_new_tokens=64, deadline_ms=120.0)
+        assert req.wait(10.0)
+        assert req.status == "expired"
+        assert 0 < len(req.generated) < 64  # partial output, not dropped
+    finally:
+        loop.stop()
+
+
+# ---------------------------------------------------------------------------
+# TP inference executor (8 virtual devices via conftest)
+
+
+def test_tp_lm_int8_activations_match_fp32_argmax():
+    from horovod_tpu.serve.executor import make_tp_lm_step
+    step_f, info_f = make_tp_lm_step(compression=None, vocab=64, hidden=32,
+                                     mlp_dim=64, layers=2)
+    step_q, info_q = make_tp_lm_step(compression="int8", vocab=64,
+                                     hidden=32, mlp_dim=64, layers=2)
+    rng = np.random.RandomState(0)
+    tokens = np.zeros((4, 16), np.int32)
+    lengths = np.ones(4, np.int32)
+    for i in range(4):
+        n = rng.randint(1, 12)
+        tokens[i, :n] = rng.randint(0, 64, n)
+        lengths[i] = n
+    a, b = step_f(tokens, lengths), step_q(tokens, lengths)
+    # int8 activation quantization perturbs logits by ~max|block|/127 —
+    # far below the argmax margins of this model
+    assert np.array_equal(a, b), (a, b)
+    assert info_q["compression"] == "int8"
+    assert info_f["compression"] == "none"
+
+
+def test_activation_wire_report_savings():
+    from horovod_tpu.serve.executor import activation_wire_report
+    rep = activation_wire_report(hidden=256, layers=4, world=8)
+    # fp32: 2*(7/8)*4 B/elem; int8: 2*(7/8)*(1+4/256) B/elem -> ~3.94x
+    assert rep["fp32_bytes_per_token"] == int(2 * 7 / 8 * 4 * 1024)
+    assert 3.8 < rep["int8_savings_x"] < 4.0
+    from horovod_tpu.parallel.tp import tp_activation_wire_bytes
+    assert tp_activation_wire_bytes(100, 1, None) == 0  # single rank: free
+
+
+def test_serving_loop_executor_failure_fails_requests_loudly():
+    reg, batcher, _ = _stack()
+
+    def broken_step(tokens, lengths):
+        raise RuntimeError("kaboom")
+
+    loop = ServingLoop(broken_step, batcher, registry=reg).start()
+    try:
+        req = batcher.submit([1], max_new_tokens=2)
+        assert req.wait(10.0)
+        assert req.status == "failed"
+        assert "kaboom" in req.error
+    finally:
+        loop.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine low-latency path (serving mode)
+
+
+def _eager_group(n, serving_mode, monkeypatch):
+    from horovod_tpu.engine.bindings import EngineSession
+    from horovod_tpu.common.eager import EagerExecutor
+    monkeypatch.setenv("HOROVOD_SERVING_MODE", "1" if serving_mode else "0")
+    group = f"serve-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=n, transport="loopback",
+                              group=group, cycle_time_ms=1.0,
+                              stall_warning_sec=60.0)
+                for r in range(n)]
+    return sessions, [EagerExecutor(s) for s in sessions]
+
+
+def _destroy(sessions):
+    for s in sessions:
+        s._lib.hvdtpu_shutdown(s._session)
+    for s in sessions:
+        s.destroy()
+
+
+def _run_pairs(sessions, execs, iters, small_n=64, big_n=65536):
+    """Each rank submits (small, big) fp32 allreduces per iteration;
+    returns ({name: result}, [(small_done, big_done) times on rank 0])."""
+    from horovod_tpu.engine.bindings import OP_ALLREDUCE
+    from horovod_tpu.common.reduce_ops import Sum
+    results = {}
+    times = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(len(sessions))
+
+    def run(rank, s, ex):
+        rng = np.random.RandomState(100 + rank)
+        for i in range(iters):
+            small = rng.randn(small_n).astype(np.float32)
+            big = rng.randn(big_n).astype(np.float32)
+            barrier.wait()
+            hs = ex.submit(f"small.{i}", OP_ALLREDUCE, small, reduce_op=Sum)
+            hb = ex.submit(f"big.{i}", OP_ALLREDUCE, big, reduce_op=Sum)
+            s.wait(hs, timeout=30.0)
+            t_small = time.perf_counter()
+            rs = ex.take_result(f"small.{i}")
+            s.wait(hb, timeout=30.0)
+            t_big = time.perf_counter()
+            rb = ex.take_result(f"big.{i}")
+            if rank == 0:
+                with lock:
+                    results[f"small.{i}"] = rs
+                    results[f"big.{i}"] = rb
+                    times.append((t_small, t_big))
+
+    threads = [threading.Thread(target=run, args=(r, s, e), daemon=True)
+               for r, (s, e) in enumerate(zip(sessions, execs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, times
+
+
+def test_low_latency_path_bit_exact_vs_fused(monkeypatch):
+    """Acceptance: serving-mode (express lane) allreduce results are
+    bit-exact against the fused path on identical inputs — the express
+    lane reorders execution, it must not touch the math."""
+    iters = 4
+    sessions, execs = _eager_group(2, False, monkeypatch)
+    try:
+        fused, _ = _run_pairs(sessions, execs, iters)
+    finally:
+        _destroy(sessions)
+    sessions, execs = _eager_group(2, True, monkeypatch)
+    try:
+        express, _ = _run_pairs(sessions, execs, iters)
+        counters = sessions[0].metrics()["counters"]
+        # every small tensor rode the express lane
+        assert counters["low_latency_responses"] >= iters
+        # flight-recorder coverage: inference-regime collectives are in
+        # the black box like any training collective
+        dump = sessions[0].flight_dump()
+        names = {e.get("name") for e in dump["events"]}
+        assert any(n and n.startswith("small.") for n in names)
+    finally:
+        _destroy(sessions)
+    assert fused.keys() == express.keys()
+    for name in fused:
+        assert np.array_equal(fused[name], express[name]), name
+
+
+def test_serving_mode_small_completes_ahead_of_bulk(monkeypatch):
+    """The cost-cliff regression: with serving mode on, a sub-threshold
+    allreduce submitted alongside a bulk one completes ahead of it (the
+    express response executes first), so it no longer pays the fused
+    batch's exec time."""
+    iters = 6
+    sessions, execs = _eager_group(2, True, monkeypatch)
+    try:
+        _, times = _run_pairs(sessions, execs, iters, big_n=1 << 21)
+        counters = sessions[0].metrics()["counters"]
+    finally:
+        _destroy(sessions)
+    assert counters["low_latency_responses"] >= iters
+    assert counters["fused_responses"] == 0
+    # small strictly precedes big on every iteration
+    assert all(ts <= tb for ts, tb in times), times
+
+
+def test_small_tensor_cliff_microbench_runs():
+    """The regression microbench the BENCH serving block embeds: counters
+    prove the express lane engaged (on) and fusion engaged (off)."""
+    from horovod_tpu.serve.loadgen import small_tensor_cliff_report
+    rep = small_tensor_cliff_report(iters=6, big_elems=1 << 20)
+    assert rep["serving_mode"]["low_latency_responses"] == 6
+    assert rep["fused_mode"]["low_latency_responses"] == 0
+    assert rep["serving_mode"]["p50_ms"] is not None
+    assert rep["mean_speedup_x"] is not None
+
+
+# ---------------------------------------------------------------------------
+# router
+
+
+def _entries(*specs):
+    return [{"id": i, "addr": "127.0.0.1", "port": p, "rank": r}
+            for i, p, r in specs]
+
+
+def test_router_least_loaded_and_reroute_on_death():
+    reg = MetricsRegistry()
+    router = RequestRouter(retry_limit=2, registry=reg)
+    router.update_workers(_entries(("a", 1001, 0), ("b", 1002, 1)), 0)
+    dead = {"a"}
+    served = []
+
+    def send(worker, payload):
+        if worker.id in dead:
+            raise ConnectionRefusedError("worker gone")
+        served.append(worker.id)
+        return {"status": "ok", "id": payload["id"]}
+
+    out = router.submit("r1", {"id": "r1"}, send)
+    assert out["status"] == "ok"
+    assert served == ["b"]  # a died, b absorbed the re-route
+    from horovod_tpu.metrics import snapshot_value
+    snap = reg.snapshot()
+    assert snapshot_value(snap, "hvd_serve_rerouted_total") == 1
+    assert snapshot_value(snap, "hvd_serve_lost_total") == 0
+    states = {w["id"]: w["state"] for w in router.workers()}
+    assert states["a"] == "dead" and states["b"] == "up"
+
+
+def test_router_exhausted_retries_is_loud_not_silent():
+    reg = MetricsRegistry()
+    router = RequestRouter(retry_limit=1, registry=reg)
+    router.update_workers(_entries(("a", 1001, 0)), 0)
+
+    def send(worker, payload):
+        raise ConnectionResetError("down")
+
+    with pytest.raises(NoWorkersError):
+        router.submit("r1", {"id": "r1"}, send)
+    from horovod_tpu.metrics import snapshot_value
+    assert snapshot_value(reg.snapshot(), "hvd_serve_lost_total") == 1
+
+
+def test_router_generation_change_drains_and_reroutes():
+    router = RequestRouter(retry_limit=1, registry=MetricsRegistry())
+    router.update_workers(_entries(("a", 1001, 0), ("b", 1002, 1)), 0)
+    wa = router.pick()  # least-loaded, tie by id -> a
+    assert wa.id == "a"
+    router.assign(wa, "req-a")
+    # generation change: a is gone from the topology, c joined
+    router.update_workers(_entries(("b", 1002, 1), ("c", 1003, 2)), 1)
+    states = {w["id"]: w["state"] for w in router.workers()}
+    assert states["a"] == "draining"
+    # draining workers take no new traffic
+    assert {router.pick().id for _ in range(4)} <= {"b", "c"}
+    # its in-flight request finishes on the departing worker, then the
+    # worker leaves the table entirely
+    router.complete(wa, "req-a")
+    assert "a" not in {w["id"] for w in router.workers()}
+    assert router.generation == 1
+
+
+def test_router_reregistered_worker_resumes():
+    router = RequestRouter(registry=MetricsRegistry())
+    router.update_workers(_entries(("a", 1001, 0), ("b", 1002, 1)), 0)
+    router.update_workers(_entries(("b", 1002, 1)), 1)  # a drains
+    router.update_workers(_entries(("a", 1001, 0), ("b", 1002, 1)), 2)
+    states = {w["id"]: w["state"] for w in router.workers()}
+    assert states["a"] == "up"  # rejoined the rotation
+
+
+def test_router_stale_gen0_record_cannot_revive_corpse():
+    """A dead worker's own stale KV record — explicit generation 0, the
+    falsy one — must not resurrect it when the table moves to a later
+    generation; only a strictly newer *registration* revives the id."""
+    router = RequestRouter(registry=MetricsRegistry())
+    e = dict(_entries(("a", 1001, 0))[0], generation=0)
+    router.update_workers([e], 0)
+    router.fail_worker("a")
+    # the driver republishes the stale gen-0 record under table gen 1
+    router.update_workers([e], 1)
+    assert {w["id"]: w["state"] for w in router.workers()}["a"] == "dead"
+    # the respawned slot re-registers under generation 1: revived
+    router.update_workers([dict(e, generation=1)], 1)
+    assert {w["id"]: w["state"] for w in router.workers()}["a"] == "up"
+
+
+def test_router_refresh_from_kv():
+    from horovod_tpu.runner.http_kv import KVServer
+    kv = KVServer().start()
+    try:
+        router = RequestRouter(registry=MetricsRegistry())
+        kv.put_json("serve_targets",
+                    {"generation": 3,
+                     "workers": _entries(("x", 1009, 0))})
+        router.refresh_from_kv(kv.get_json)
+        assert router.generation == 3
+        assert [w["id"] for w in router.workers()] == ["x"]
+    finally:
+        kv.stop()
+
+
+# ---------------------------------------------------------------------------
+# frontend
+
+
+def _http(url, payload=None, timeout=10.0):
+    if payload is None:
+        req = url
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urlerror.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_frontend_local_roundtrip_reject_and_drain():
+    reg, batcher, loop = _stack(queue_depth=2)
+    loop.start()
+    fe = ServeFrontend(batcher=batcher, port=0, addr="127.0.0.1",
+                       registry=reg).start()
+    base = f"http://127.0.0.1:{fe.port}"
+    try:
+        code, out = _http(base + "/v1/generate",
+                          {"tokens": [1, 2, 3], "max_new_tokens": 3})
+        assert code == 200 and out["status"] == "ok"
+        assert out["tokens"] == _toy_reference([1, 2, 3], 3)
+        code, health = _http(base + "/healthz")
+        assert code == 200 and health["status"] == "ok"
+        code, stats = _http(base + "/stats")
+        assert code == 200 and stats["requests_ok"] == 1
+        assert stats["latency_p50_ms"] is not None
+        # drain flips health to 503 and rejects new work
+        fe.set_draining(True)
+        code, health = _http(base + "/healthz")
+        assert code == 503 and health["status"] == "draining"
+        code, out = _http(base + "/v1/generate", {"tokens": [1]})
+        assert code == 503
+    finally:
+        fe.stop()
+        loop.stop()
+
+
+def test_routed_frontend_end_to_end_with_drain_on_death():
+    """Cluster shape in one process: two local worker stacks behind an
+    ingress router frontend. Killing one worker's HTTP server mid-run
+    re-routes to the survivor; nothing accepted is lost."""
+    workers = []
+    for _ in range(2):
+        reg, batcher, loop = _stack()
+        loop.start()
+        fe = ServeFrontend(batcher=batcher, port=0, addr="127.0.0.1",
+                           registry=reg).start()
+        workers.append((batcher, loop, fe))
+    reg_r = MetricsRegistry()
+    router = RequestRouter(retry_limit=2, registry=reg_r)
+    router.update_workers(
+        [{"id": f"w{i}", "addr": "127.0.0.1", "port": w[2].port, "rank": i}
+         for i, w in enumerate(workers)], 0)
+    ingress = ServeFrontend(router=router, port=0, addr="127.0.0.1",
+                            registry=reg_r).start()
+    base = f"http://127.0.0.1:{ingress.port}"
+    try:
+        oks = 0
+        for i in range(6):
+            code, out = _http(base + "/v1/generate",
+                              {"tokens": [i], "max_new_tokens": 2,
+                               "id": f"req{i}"})
+            assert code == 200 and out["status"] == "ok", out
+            oks += 1
+            if i == 2:  # kill worker 0's HTTP server mid-load
+                workers[0][2].stop()
+                workers[0][1].stop()
+        assert oks == 6
+        from horovod_tpu.metrics import snapshot_value
+        assert snapshot_value(reg_r.snapshot(),
+                              "hvd_serve_lost_total") in (None, 0.0)
+        states = {w["id"]: w["state"] for w in router.workers()}
+        assert states.get("w0", "dead") == "dead"
+    finally:
+        ingress.stop()
+        for _, loop, fe in workers[1:]:
+            fe.stop()
+            loop.stop()
+
+
+def test_serving_stats_summary():
+    reg, batcher, loop = _stack()
+    loop.start()
+    try:
+        for i in range(3):
+            r = batcher.submit([i, i], max_new_tokens=2)
+            assert r.wait(10.0)
+        stats = serving_stats(reg.snapshot())
+        assert stats["requests_ok"] == 3
+        assert stats["tokens_out"] == 6
+        assert stats["latency_p99_ms"] is not None
+        assert stats["batch_occupancy_mean"] is not None
+    finally:
+        loop.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve worker drain + driver serve_targets aggregation
+
+
+def test_serve_worker_drains_instead_of_dropping():
+    from horovod_tpu.serve.worker import ServeWorker
+    step_base = make_toy_step()
+
+    def slow_step(tokens, lengths):
+        time.sleep(0.02)
+        return step_base(tokens, lengths)
+
+    w = ServeWorker(step_fn=slow_step)
+    w.start()
+    base = f"http://127.0.0.1:{w.frontend.port}"
+    results = {}
+
+    def client(i):
+        results[i] = _http(base + "/v1/generate",
+                           {"tokens": [i], "max_new_tokens": 8,
+                            "deadline_ms": 5000})
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.08)  # requests admitted and mid-generation
+    assert w.drain(timeout=15.0)
+    for t in threads:
+        t.join(timeout=15.0)
+    try:
+        # every accepted request completed despite the drain
+        assert all(code == 200 and out["status"] == "ok"
+                   for code, out in results.values()), results
+        code, health = _http(base + "/healthz")
+        assert code == 503
+    finally:
+        w.stop()
+
+
+def test_driver_aggregates_serve_targets():
+    """The driver's heartbeat publishes worker serve endpoints as one
+    ``serve_targets`` key — the router's discovery input."""
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.discovery import FixedHostDiscovery
+
+    class FakeWorker:
+        def __init__(self, hostname, rank, command, env):
+            pass
+
+        def poll(self):
+            return None
+
+        def terminate(self):
+            pass
+
+    driver = ElasticDriver(FixedHostDiscovery({"hostA": 2}), min_np=1,
+                           max_np=2, command=["true"],
+                           spawn_worker=FakeWorker)
+    try:
+        driver._hosts.refresh()
+        driver._rebalance(first=True)
+        driver._kv.put_json("serve_addr/hostA/0",
+                            {"id": "hostA/0", "addr": "hostA", "port": 7001,
+                             "rank": 0, "generation": 0})
+        driver._kv.put_json("serve_addr/hostA/1",
+                            {"id": "hostA/1", "addr": "hostA", "port": 7002,
+                             "rank": 1, "generation": 0})
+        driver._scrape_worker_metrics()
+        info = driver._kv.get_json("serve_targets")
+        assert info["generation"] == 0
+        assert {w["id"] for w in info["workers"]} == {"hostA/0", "hostA/1"}
+        router = RequestRouter(registry=MetricsRegistry())
+        router.refresh_from_kv(driver._kv.get_json)
+        assert len(router.workers()) == 2
+    finally:
+        driver._shutdown.set()
+        driver._kv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault injection: kill a rank mid-load (die action + elastic driver)
+
+
+def test_kill_rank_mid_load_drains_and_reroutes(tmp_path):
+    """The serving-plane incident drill: two elastic serve workers under
+    the real driver; rank 1's engine heartbeat dies mid-run via the
+    HOROVOD_FAULT_SPEC ``die`` action (a real exit(137) at an exact frame
+    boundary). The router must re-route around the death with zero lost
+    accepted requests (bounded error budget below covers requests that
+    race the brief pre-detection window), and the driver must respawn the
+    slot into a new generation whose worker re-registers."""
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.runner.exec_utils import WorkerProcess
+
+    injected = {"done": False}
+
+    def spawn(hostname, rank, command, env):
+        env = dict(env)
+        env["PYTHONPATH"] = REPO
+        if rank == 1 and not injected["done"]:
+            injected["done"] = True
+            # die mid control-channel traffic (~4 s of 5 ms cycles in),
+            # which lands squarely inside the load window below
+            env["HOROVOD_FAULT_SPEC"] = "control.send:die@frame=800"
+        return WorkerProcess(hostname, rank, command, env)
+
+    driver = ElasticDriver(
+        FixedHostDiscovery({"localhost": 2}), min_np=2, max_np=2,
+        command=[sys.executable, "-m", "horovod_tpu.serve.worker"],
+        extra_env={"HOROVOD_SERVE_PORT": "0", "HOROVOD_CYCLE_TIME": "5",
+                   "JAX_PLATFORMS": "cpu"},
+        spawn_worker=spawn)
+    result = {}
+    runner = threading.Thread(
+        target=lambda: result.update(rc=driver.run(start_timeout=60)),
+        daemon=True)
+    runner.start()
+
+    reg = MetricsRegistry()
+    router = RequestRouter(retry_limit=3, registry=reg)
+    outcomes = {"ok": 0, "other": 0}
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            router.refresh_from_kv(driver._kv.get_json)
+            if len([w for w in router.workers()
+                    if w["state"] == "up"]) >= 2:
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("serve workers never registered")
+
+        def send(worker, payload):
+            return post_json(worker.addr, worker.port, "/v1/generate",
+                             payload, timeout=15.0)
+
+        i = 0
+        while i < 60:
+            i += 1
+            router.refresh_from_kv(driver._kv.get_json)
+            try:
+                out = router.submit(
+                    f"req{i}", {"tokens": [i % 7, 3], "max_new_tokens": 2,
+                                "deadline_ms": 5000, "id": f"req{i}"},
+                    send)
+                outcomes["ok" if out.get("status") == "ok"
+                         else "other"] += 1
+            except NoWorkersError:
+                outcomes["other"] += 1
+            # pace the load so the death + recovery land mid-stream
+            time.sleep(0.15)
+
+        # the driver re-routed: a new generation exists and its workers
+        # re-registered (respawned rank included)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if driver.generation >= 1:
+                router.refresh_from_kv(driver._kv.get_json)
+                up = [w for w in router.workers() if w["state"] == "up"]
+                if len(up) >= 2 and router.generation >= 1:
+                    break
+            time.sleep(0.25)
+        else:
+            pytest.fail(f"no recovery: generation={driver.generation}, "
+                        f"workers={router.workers()}")
+
+        from horovod_tpu.metrics import snapshot_value
+        snap = reg.snapshot()
+        # the no-silent-loss contract: nothing exhausted its retries
+        assert (snapshot_value(snap, "hvd_serve_lost_total") or 0) == 0
+        # bounded error budget: the kill may eat the requests that raced
+        # the detection window, nothing more
+        assert outcomes["other"] <= 5, outcomes
+        assert outcomes["ok"] >= 55, outcomes
+    finally:
+        driver._kv.put_json("serve_stop", {"ts": time.time()})
+        runner.join(timeout=90)
+        if runner.is_alive():
+            driver._shutdown.set()
+            runner.join(timeout=30)
+    assert result.get("rc") == 0, result
+
+
+# ---------------------------------------------------------------------------
+# sustained-load soak (slow)
+
+
+@pytest.mark.slow
+def test_sustained_load_soak():
+    """20 s of steady offered load on the local stack: no failures, no
+    unbounded queue, p99 under the deadline."""
+    from horovod_tpu.serve import loadgen
+    reg, batcher, loop = _stack(max_batch=8, queue_depth=32,
+                                default_deadline_ms=2000.0)
+    loop.start()
+
+    def submit(payload):
+        try:
+            req = batcher.submit(payload["tokens"],
+                                 max_new_tokens=payload["max_new_tokens"])
+        except AdmissionRejected:
+            return {"status": "rejected"}
+        req.wait(10.0)
+        return req.result()
+
+    try:
+        window = loadgen.run_load(
+            submit, offered_qps=50.0, duration_sec=20.0,
+            make_payload=lambda i: {"tokens": [i % 17, 1, 2],
+                                    "max_new_tokens": 4})
+    finally:
+        loop.drain(10.0)
+        loop.stop()
+    assert window["failed"] == 0
+    assert window["completed_ok"] > 0
+    assert window["p99_ms"] is not None and window["p99_ms"] < 2000.0

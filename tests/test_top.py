@@ -168,6 +168,87 @@ def test_metrics_port_fallback(monkeypatch):
     assert [t["port"] for t in targets] == [9300, 9301, 9302]
 
 
+def _serving_registry(ok=20.0, lat_s=0.05, occupancy=4):
+    """A registry carrying the ``hvd_serve_*`` families a serve worker
+    exports (batcher + serving loop), populated directly."""
+    from horovod_tpu.serve.batcher import LATENCY_BUCKETS, OCCUPANCY_BUCKETS
+    reg = MetricsRegistry()
+    reg.counter("hvd_serve_requests_total", status="ok").inc(ok)
+    reg.counter("hvd_serve_requests_total", status="rejected").inc(3)
+    reg.counter("hvd_serve_requests_total", status="expired").inc(1)
+    reg.gauge("hvd_serve_queue_depth").set(4)
+    reg.gauge("hvd_serve_inflight").set(2)
+    lat = reg.histogram("hvd_serve_request_latency_seconds",
+                        buckets=LATENCY_BUCKETS)
+    for _ in range(int(ok)):
+        lat.observe(lat_s)
+    reg.histogram("hvd_serve_batch_occupancy",
+                  buckets=OCCUPANCY_BUCKETS).observe(occupancy)
+    return reg
+
+
+@pytest.fixture
+def serving_cluster():
+    regs = [_serving_registry(ok=20.0), _serving_registry(ok=40.0)]
+    exporters = [MetricsExporter(regs[r], port=0,
+                                 labels={"rank": str(r)}).start()
+                 for r in range(2)]
+    yield regs, exporters
+    for e in exporters:
+        e.stop()
+
+
+def test_serving_row_extraction(serving_cluster):
+    regs, exporters = serving_cluster
+    target = {"addr": "127.0.0.1", "port": exporters[0].port}
+    snap = top.scrape_target(target)
+    assert snap is not None
+    row = top.serving_row_from_snapshot(target, snap, None)
+    assert row["rank"] == "0"
+    assert row["ok"] == 20.0 and row["rejected"] == 3.0
+    assert row["expired"] == 1.0
+    assert row["queue_depth"] == 4 and row["inflight"] == 2
+    assert row["occupancy"] == pytest.approx(4.0)
+    # 50ms observations land in the (0.025, 0.05] latency bucket
+    assert 25.0 <= row["p50_ms"] <= 50.0
+    assert 25.0 <= row["p99_ms"] <= 50.0
+    assert row["qps"] is None  # no previous window (--once)
+    # window QPS: 10 more ok requests between refreshes
+    prev = row["qps_raw"]
+    regs[0].counter("hvd_serve_requests_total", status="ok").inc(10)
+    snap = top.scrape_target(target)
+    row = top.serving_row_from_snapshot(target, snap, prev)
+    assert row["qps"] is not None and row["qps"] > 0
+
+
+def test_serving_render_columns(serving_cluster):
+    regs, exporters = serving_cluster
+    state = top.TopState([{"addr": "127.0.0.1", "port": e.port}
+                          for e in exporters], serving=True)
+    rows, unreachable = state.refresh(window=False)
+    assert unreachable == 0 and len(rows) == 2
+    text = state.render(rows, unreachable, "serving-title")
+    assert "serving-title" in text.splitlines()[0]
+    for col in top.SERVING_COLUMNS:
+        assert col in text.splitlines()[1]
+    body = text.splitlines()[2:]
+    assert body[0].split()[0] == "0" and body[1].split()[0] == "1"
+
+
+def test_cli_serving_once_smoke(serving_cluster):
+    """`hvd-top --serving --once` end to end in a clean interpreter — the
+    serving-view CI surface."""
+    regs, exporters = serving_cluster
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.obs.top", "--serving",
+         "--once", "--targets", _targets_arg(exporters)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "QPS" in proc.stdout and "p99ms" in proc.stdout
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert any(ln.split()[0] == "0" for ln in lines[2:])
+
+
 def test_cli_subprocess_once_smoke(cluster):
     """The `python -m horovod_tpu.obs.top` front door (what the hvd-top
     console script and `make top` resolve to), end to end in a clean
