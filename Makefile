@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all lint lock-graph engine top tsan asan ubsan sanitizers test test-fast clean
+.PHONY: all lint lock-graph engine top tsan asan ubsan sanitizers test test-fast soak clean
 
 all: engine
 
@@ -48,6 +48,15 @@ test-fast:
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q
+
+# The slow-marked elastic chaos soak (64 simulated ranks: kills,
+# preemption drains, partitions, rejoins; plus the subprocess drain
+# acceptance) under a hard wall-clock budget. SOAK_BUDGET is seconds.
+SOAK_BUDGET ?= 900
+soak:
+	timeout -k 10 $(SOAK_BUDGET) env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	    tests/test_chaos_soak.py tests/test_elastic_recovery.py \
+	    -q -m slow
 
 clean:
 	$(MAKE) -C horovod_tpu/engine clean

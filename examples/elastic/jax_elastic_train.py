@@ -8,6 +8,16 @@ Run (membership = discover.sh output, editable live):
 ``python -m horovod_tpu.runner.launch --min-np 2 --max-np 4
 --host-discovery-script examples/elastic/discover.sh
 python examples/elastic/jax_elastic_train.py``
+
+This demo keeps optimizer state REPLICATED, so ``elastic.State`` (sync =
+broadcast from the most recent holder) is the right tool. A job using the
+ZeRO-1 sharded update should hold its per-rank optimizer shards in
+``elastic.ShardedState(template=params, sharded={"opt": shards})``
+instead: on a resize the shards transfer live (``zero.reshard_plan``
+over the eager alltoall) and training resumes from the live step — no
+rollback to the last commit, and a SIGTERM'd spot worker drains cleanly,
+handing its shard off through the rendezvous KV (docs/DESIGN.md
+"Elastic resize & preemption draining").
 """
 
 import time

@@ -232,6 +232,28 @@ _decl("HOROVOD_BLACKLIST_COOLDOWN_SECONDS", "float", 300.0,
 _decl("HOROVOD_FAILURES_TO_BLACKLIST", "int", 3,
       "worker failures on a host before blacklisting")
 
+# -- elastic resize / preemption draining --
+_decl("HOROVOD_PREEMPT_SIGNAL", "str", "SIGTERM",
+      "signal an elastic worker treats as a preemption notice (drain: "
+      "announce, finish the step, hand off the shard, exit cleanly)")
+_decl("HOROVOD_PREEMPT_COOLDOWN_SECONDS", "float", 300.0,
+      "drained hosts are held out of new topologies this long "
+      "(the preempted machine is expected to die; <=0 = until removed "
+      "from discovery)")
+_decl("HOROVOD_PREEMPT_HANDOFF", "bool", True,
+      "drained workers publish their live ZeRO shard to the rendezvous KV "
+      "so the resize resumes with zero state loss")
+_decl("HOROVOD_RESHARD_COMPRESSION", "str", "none",
+      "wire format for live shard transfer on resize (none | int8 — "
+      "block-quantized, ~4x fewer resize bytes)")
+_decl("HOROVOD_ELASTIC_SHARD_REDUNDANCY", "int", 1,
+      "replicate each rank's committed shard on its ring buddy at every "
+      "commit (1) so a hard kill loses no committed state; 0 disables "
+      "(killed shards resume with fresh moments)")
+_decl("HOROVOD_ELASTIC_RECOVERY_BOUND_SECONDS", "float", 60.0,
+      "recovery-time budget the chaos soak asserts and the BENCH elastic "
+      "block reports against (informational elsewhere)")
+
 
 def _lookup(name: str) -> EnvVar:
     try:

@@ -215,6 +215,290 @@ def sharded_opt_init(optimizer,
     return jax.jit(mapped)(params)
 
 
+# ---------------------------------------------------------------------------
+# Checkpoint-free elastic resize: old-shards -> new-shards transfer plan.
+#
+# On a topology generation change the world size moves N_old -> N_new, so the
+# ZeRO flat-group geometry changes (padded length is a multiple of
+# world * block_size) and every rank's contiguous shard boundary moves. The
+# optimizer state is NOT replicated — no rank can broadcast it — so a resize
+# re-partitions the live shards instead: `reshard_plan` computes the exact
+# (src old rank, dst new rank, offset, length) segment set, and `reshard`
+# executes it over an injected exchange (the eager ragged alltoall in
+# production, an in-memory exchange in the chaos simulator). Only real
+# elements move; padding is reconstructed as zeros on the receiver.
+
+
+class ShardSegment(NamedTuple):
+    """One contiguous transfer: ``length`` elements of group ``group`` that
+    live at ``src_offset`` in old rank ``src``'s shard and land at
+    ``dst_offset`` in new rank ``dst``'s shard."""
+    group: str
+    src: int
+    dst: int
+    src_offset: int
+    dst_offset: int
+    length: int
+
+
+class ReshardPlan(NamedTuple):
+    old_world: int
+    new_world: int
+    block_size: int
+    old_groups: Tuple[_DtypeGroup, ...]
+    new_groups: Tuple[_DtypeGroup, ...]
+    segments: Tuple[ShardSegment, ...]
+
+    def _ordered(self, segs):
+        order = {g.key: i for i, g in enumerate(self.old_groups)}
+        return tuple(sorted(
+            segs, key=lambda s: (order[s.group], s.src, s.src_offset)))
+
+    def segments_for_pair(self, serving: int, dst: int,
+                          sources) -> Tuple[ShardSegment, ...]:
+        """The segments rank ``serving`` transmits to ``dst`` under the
+        runtime source assignment ``sources`` (old rank -> serving new
+        rank), in the canonical pack order both sides derive
+        independently."""
+        return self._ordered(
+            s for s in self.segments
+            if s.dst == dst and sources.get(s.src) == serving)
+
+    def group(self, key: str) -> _DtypeGroup:
+        for g in self.old_groups:
+            if g.key == key:
+                return g
+        raise KeyError(key)
+
+    def new_group(self, key: str) -> _DtypeGroup:
+        for g in self.new_groups:
+            if g.key == key:
+                return g
+        raise KeyError(key)
+
+    def element_bytes(self, segs) -> int:
+        groups = {g.key: jnp.dtype(g.dtype).itemsize for g in self.old_groups}
+        return sum(s.length * groups[s.group] for s in segs)
+
+
+def reshard_plan(template, old_world: int, new_world: int,
+                 block_size: int = LANE) -> ReshardPlan:
+    """Old-shards -> new-shards transfer plan for a resize.
+
+    ``template`` is the replicated params pytree (or leaf list) whose
+    per-dtype flat-group geometry defines the shard layout at BOTH world
+    sizes — the state itself never needs to be materialized to plan. Pure
+    function of (template shapes, old_world, new_world): every rank computes
+    the identical plan locally, nothing is negotiated.
+
+    Segments cover exactly the REAL elements (the group's unpadded total) of
+    every new shard; the zero padding that squares the new layout off to a
+    multiple of ``new_world * block_size`` is recreated locally. Segments
+    with ``src == dst`` are local copies and cost no wire bytes.
+    """
+    if old_world < 1 or new_world < 1:
+        raise ValueError(
+            f"world sizes must be >= 1, got {old_world} -> {new_world}")
+    leaves = jax.tree_util.tree_leaves(template)
+    if not leaves:
+        raise ValueError("reshard_plan needs a non-empty template")
+    old_groups = _group_leaves(leaves, old_world, block_size)
+    new_groups = _group_leaves(leaves, new_world, block_size)
+    segments = []
+    for og, ng in zip(old_groups, new_groups):
+        total = sum(og.sizes)  # real elements; the rest is padding
+        for dst in range(new_world):
+            lo = dst * ng.shard
+            hi = min(lo + ng.shard, total)
+            src = lo // og.shard if og.shard else 0
+            while lo < hi:
+                src_hi = min((src + 1) * og.shard, total)
+                take = min(hi, src_hi) - lo
+                if take > 0:
+                    segments.append(ShardSegment(
+                        group=og.key, src=src, dst=dst,
+                        src_offset=lo - src * og.shard,
+                        dst_offset=lo - dst * ng.shard, length=take))
+                lo += max(take, 0)
+                src += 1
+    return ReshardPlan(old_world=old_world, new_world=new_world,
+                       block_size=block_size, old_groups=old_groups,
+                       new_groups=new_groups, segments=tuple(segments))
+
+
+# -- host-side int8 block codec (the PR-1 EQuARX wire format, numpy form) --
+# The resize path moves concrete host buffers through the eager data plane,
+# so the quantized wire rides a numpy implementation of the same
+# block-scaled int8 scheme the in-jit quantized collectives use: one fp32
+# absmax scale per `block_size` elements, values rounded into [-127, 127].
+
+
+def quantize_blocks_np(arr, block_size: int = LANE):
+    """``arr`` (1-D float) -> (int8 values, fp32 per-block scales)."""
+    import numpy as np
+    flat = np.asarray(arr, dtype=np.float32).ravel()
+    pad = (-flat.size) % block_size
+    padded = np.pad(flat, (0, pad)) if pad else flat
+    blocks = padded.reshape(-1, block_size)
+    scales = np.abs(blocks).max(axis=1).astype(np.float32)
+    denom = np.where(scales > 0, scales, 1.0)
+    q = np.clip(np.rint(blocks / denom[:, None] * 127.0), -127, 127)
+    return q.astype(np.int8).reshape(-1)[:flat.size], scales
+
+
+def dequantize_blocks_np(q, scales, dtype, block_size: int = LANE):
+    import numpy as np
+    q = np.asarray(q, dtype=np.int8).ravel()
+    pad = (-q.size) % block_size
+    padded = np.pad(q, (0, pad)) if pad else q
+    blocks = padded.astype(np.float32).reshape(-1, block_size)
+    out = blocks * (np.asarray(scales, np.float32)[:, None] / 127.0)
+    return out.reshape(-1)[:q.size].astype(dtype)
+
+
+def _seg_wire_nbytes(plan: ReshardPlan, seg: ShardSegment,
+                     rows: int, quantized: bool) -> int:
+    dtype = jnp.dtype(plan.group(seg.group).dtype)
+    if quantized and dtype.kind == "f":
+        n_blocks = -(-seg.length // plan.block_size)
+        return rows * (seg.length + 4 * n_blocks)
+    return rows * seg.length * dtype.itemsize
+
+
+def pack_segments(plan: ReshardPlan, segs, shard_lookup,
+                  quantized: bool = False):
+    """Serialize ``segs`` (canonical order) into one uint8 wire buffer.
+
+    ``shard_lookup(group_key, old_rank)`` returns that old rank's shard as a
+    ``[rows, shard]`` float/int array — ``rows`` is the number of state
+    leaves sharing the group's geometry (Adam: mu and nu = 2 rows). With
+    ``quantized`` each float row-segment is block-int8 coded (scales then
+    values); integer groups always travel raw."""
+    import numpy as np
+    parts = []
+    for seg in segs:
+        shard = np.asarray(shard_lookup(seg.group, seg.src))
+        if shard.ndim == 1:
+            shard = shard[None, :]
+        chunk = shard[:, seg.src_offset:seg.src_offset + seg.length]
+        dtype = jnp.dtype(plan.group(seg.group).dtype)
+        if quantized and dtype.kind == "f":
+            for row in chunk:
+                q, scales = quantize_blocks_np(row, plan.block_size)
+                parts.append(scales.tobytes())
+                parts.append(q.tobytes())
+        else:
+            parts.append(np.ascontiguousarray(
+                chunk.astype(dtype)).tobytes())
+    return np.frombuffer(b"".join(parts), np.uint8).copy()
+
+
+def unpack_segments(plan: ReshardPlan, segs, buf, sink,
+                    quantized: bool = False):
+    """Inverse of :func:`pack_segments`: scatter the wire buffer into the
+    receiver's new shards via ``sink(group_key, dst_offset, [rows, length]
+    array)``. Row counts must match what the sender packed — both sides
+    derive them from the same state template."""
+    import numpy as np
+    buf = np.asarray(buf, np.uint8)
+    off = 0
+    for seg in segs:
+        dtype = jnp.dtype(plan.group(seg.group).dtype)
+        rows = sink(seg.group, None, None)  # row-count query
+        if quantized and dtype.kind == "f":
+            n_blocks = -(-seg.length // plan.block_size)
+            out = np.empty((rows, seg.length), dtype)
+            for r in range(rows):
+                scales = np.frombuffer(
+                    buf[off:off + 4 * n_blocks].tobytes(), np.float32)
+                off += 4 * n_blocks
+                q = np.frombuffer(
+                    buf[off:off + seg.length].tobytes(), np.int8)
+                off += seg.length
+                out[r] = dequantize_blocks_np(q, scales, dtype,
+                                              plan.block_size)
+        else:
+            nbytes = rows * seg.length * dtype.itemsize
+            out = np.frombuffer(buf[off:off + nbytes].tobytes(),
+                                dtype).reshape(rows, seg.length)
+            off += nbytes
+        sink(seg.group, seg.dst_offset, out)
+    return off
+
+
+def reshard(plan: ReshardPlan, my_rank: int, sources, shards, rows_by_group,
+            exchange, quantized: bool = False):
+    """Execute ``plan`` for new rank ``my_rank``.
+
+    - ``sources``: old rank -> serving NEW rank. A survivor serves its own
+      old shard; a drained rank's handoff or a buddy replica is served by
+      whichever rank holds it; old ranks absent from the map are LOST — the
+      receiver zero-fills their ranges (fresh-moment resume for that slice).
+    - ``shards``: ``(group_key, old_rank) -> [rows, shard]`` lookup valid
+      for every old rank assigned to ``my_rank``.
+    - ``rows_by_group``: group_key -> state rows sharing the geometry.
+    - ``exchange(send_bufs) -> recv_bufs``: ragged uint8 alltoall, one
+      buffer per new rank (index = peer's new rank).
+
+    Returns ``(new_shards, stats)`` where ``new_shards[group] `` is a
+    zero-initialized ``[rows, new_shard]`` array with every served segment
+    scattered in, and ``stats`` accounts wire/local bytes and lost
+    elements."""
+    import numpy as np
+    send_bufs = []
+    for dst in range(plan.new_world):
+        segs = plan.segments_for_pair(my_rank, dst, sources)
+        send_bufs.append(pack_segments(plan, segs, shards, quantized)
+                         if segs else np.empty(0, np.uint8))
+    recv_bufs = exchange(send_bufs)
+    new_shards = {}
+    for g in plan.new_groups:
+        rows = int(rows_by_group.get(g.key, 1))
+        new_shards[g.key] = np.zeros((rows, g.shard),
+                                     jnp.dtype(g.dtype))
+    lost = 0
+    for seg in plan.segments:
+        if seg.dst == my_rank and seg.src not in sources:
+            lost += seg.length
+    for serving in range(plan.new_world):
+        segs = plan.segments_for_pair(serving, my_rank, sources)
+        if not segs:
+            continue
+
+        def sink(key, dst_offset, chunk,
+                 _rows=rows_by_group, _out=new_shards):
+            if dst_offset is None:
+                return int(_rows.get(key, 1))
+            _out[key][:, dst_offset:dst_offset + chunk.shape[1]] = chunk
+            return None
+
+        unpack_segments(plan, segs, recv_bufs[serving], sink, quantized)
+    wire = sum(int(b.size) for i, b in enumerate(send_bufs) if i != my_rank)
+    stats = {
+        "wire_bytes_sent": wire,
+        "local_bytes": int(send_bufs[my_rank].size)
+        if my_rank < len(send_bufs) else 0,
+        "lost_elements": lost,
+        "quantized": bool(quantized),
+    }
+    return new_shards, stats
+
+
+def reshard_wire_bytes(plan: ReshardPlan, sources, rows_by_group,
+                       quantized: bool = False) -> int:
+    """Total cross-rank wire bytes the plan moves under ``sources`` (the
+    sum every rank's ``stats['wire_bytes_sent']`` would report) — the
+    BENCH/metrics accounting shares this one formula with the executor."""
+    total = 0
+    for seg in plan.segments:
+        serving = sources.get(seg.src)
+        if serving is None or serving == seg.dst:
+            continue
+        rows = int(rows_by_group.get(seg.group, 1))
+        total += _seg_wire_nbytes(plan, seg, rows, quantized)
+    return total
+
+
 def optimizer_state_bytes(params, n_shards: int, state_factor: float = 2.0,
                           block_size: int = LANE) -> dict:
     """Memory math for the docs/bench: replicated vs sharded optimizer-state

@@ -70,20 +70,50 @@ class HostManager:
     and is re-probed at the next refresh)."""
 
     def __init__(self, discovery: HostDiscovery,
-                 cooldown: float = None):
+                 cooldown: float = None, drain_cooldown: float = None):
         self._discovery = discovery
         self._lock = threading.Lock()
         # hostname -> monotonic timestamp of the (latest) blacklisting
         self._blacklist: Dict[str, float] = {}
+        # hostname -> monotonic timestamp of the drain announcement.
+        # Distinct from the blacklist on purpose: a drained host did
+        # nothing wrong (no failure strikes, no post-mortem) — it is
+        # simply expected to die. Held out until discovery stops listing
+        # it or HOROVOD_PREEMPT_COOLDOWN_SECONDS passes (a replacement
+        # spot instance may reuse the name).
+        self._draining: Dict[str, float] = {}
         self.current: Dict[str, int] = {}
         if cooldown is None:
             cooldown = env_float("HOROVOD_BLACKLIST_COOLDOWN_SECONDS",
                                  DEFAULT_BLACKLIST_COOLDOWN_SECONDS)
         self._cooldown = cooldown
+        if drain_cooldown is None:
+            drain_cooldown = env_float("HOROVOD_PREEMPT_COOLDOWN_SECONDS")
+        self._drain_cooldown = drain_cooldown
 
     def blacklist(self, hostname: str):
         with self._lock:
             self._blacklist[hostname] = time.monotonic()
+
+    def drain(self, hostname: str):
+        """Hold a host out of future topologies after a preemption notice
+        (no blacklist strike; re-admitted after the drain cooldown)."""
+        with self._lock:
+            self._draining[hostname] = time.monotonic()
+
+    def is_draining(self, hostname: str) -> bool:
+        with self._lock:
+            ts = self._draining.get(hostname)
+            if ts is None:
+                return False
+            if self._drain_expired(ts):
+                del self._draining[hostname]
+                return False
+            return True
+
+    def _drain_expired(self, ts: float) -> bool:
+        return self._drain_cooldown > 0 and \
+            time.monotonic() - ts >= self._drain_cooldown
 
     def _expired(self, ts: float) -> bool:
         return self._cooldown > 0 and \
@@ -108,8 +138,18 @@ class HostManager:
             for h in [h for h, ts in self._blacklist.items()
                       if self._expired(ts)]:
                 del self._blacklist[h]
+            for h in [h for h, ts in self._draining.items()
+                      if self._drain_expired(ts) or
+                      (self._drain_cooldown <= 0 and h not in found)]:
+                # re-admit strictly by cooldown (a single transient
+                # discovery blip must not re-admit a machine that is
+                # about to die); with the cooldown disabled (<=0) the
+                # hold instead lifts when discovery stops listing the
+                # host (the preemption completed)
+                del self._draining[h]
             usable = {h: s for h, s in found.items()
-                      if h not in self._blacklist}
+                      if h not in self._blacklist
+                      and h not in self._draining}
         changed = usable != self.current
         self.current = usable
         return changed

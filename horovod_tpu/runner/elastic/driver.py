@@ -99,6 +99,10 @@ class ElasticDriver:
         self._failures_to_blacklist = env_int(
             "HOROVOD_FAILURES_TO_BLACKLIST", FAILURES_TO_BLACKLIST)
         self._removed_slots: set = set()
+        # slot -> generation its CURRENT process was spawned into; scopes
+        # the reap-time DRAINED-registry fallback so a predecessor's
+        # drain record can't be charged to a respawned worker
+        self._worker_spawn_gen: Dict[Tuple[str, int], int] = {}
         self._expected_slots: List[Tuple[str, int]] = []
         self._go_deadline: float = 0.0
         self._go_published: set = set()
@@ -138,6 +142,13 @@ class ElasticDriver:
         self.anomaly_events: List[dict] = []
         # analyzer verdicts collected after worker failures (flight dumps)
         self.flight_verdicts: List[dict] = []
+        # preemption-notice draining: slots that announced departure via
+        # the KV (runner/elastic/preempt.py). Their exits are clean —
+        # no failure strike, no blacklist, no flight-dump post-mortem —
+        # and the announcement itself schedules a proactive resize so the
+        # shard handoff lands before the host dies.
+        self._draining: set = set()
+        self.drain_events: List[dict] = []
         self._lock = threading.Lock()
         self._rebalance_needed = threading.Event()
         self._shutdown = threading.Event()
@@ -174,8 +185,7 @@ class ElasticDriver:
             if self._metrics_exporter is not None:
                 self._metrics_exporter.stop()
                 self._metrics_exporter = None
-            for w in self._workers.values():
-                w.terminate()
+            self._stop_workers()
             if on_complete is not None:
                 try:
                     on_complete(self._kv)
@@ -201,6 +211,14 @@ class ElasticDriver:
     def _discovery_loop(self):
         while not self._shutdown.is_set():
             time.sleep(self._interval)
+            # Drain scan FIRST: the refresh below must already see the
+            # announced host as draining, or the same heartbeat's
+            # rebalance would schedule onto a machine that is about to
+            # die (and the exit of its drained worker would be misread).
+            try:
+                self._check_drains()
+            except Exception as e:  # noqa: BLE001 — drain detection must
+                self._log(f"drain scan error: {e!r}")  # not kill the driver
             try:
                 changed = self._hosts.refresh()
             except RuntimeError as e:
@@ -350,6 +368,17 @@ class ElasticDriver:
                 # a slot in the new assignment is no longer "removed", even
                 # if its (re-included) process never observed the removal
                 self._removed_slots.discard(key)
+                if key in self._draining and \
+                        not self._hosts.is_draining(s.hostname):
+                    # the host survived its preemption window (or a
+                    # replacement reused the name) and was genuinely
+                    # re-admitted — the drain-hold expired, this is not
+                    # the same heartbeat's stale host view — so clear the
+                    # drain record and its KV key; the fresh worker's
+                    # exits are judged normally again
+                    self._draining.discard(key)
+                    from horovod_tpu.runner.elastic.preempt import drain_key
+                    self._kv.delete(drain_key(*key))
                 w = self._workers.get(key)
                 if w is not None and w.poll() is None:
                     continue
@@ -358,17 +387,74 @@ class ElasticDriver:
                                  elastic=True, generation=gen,
                                  rendezvous_addr=rdv_addr)
                 self._log(f"spawning worker {key} (generation {gen})")
+                self._worker_spawn_gen[key] = gen
                 self._workers[key] = self._spawn_worker(
                     s.hostname, s.rank, self._command, env)
 
+    def _check_drains(self):
+        """One heartbeat's drain scan: a worker that received a preemption
+        notice announces it under ``drain/<host>/<slot>`` (preempt.py).
+        First sighting holds the host out of future topologies and
+        schedules a proactive resize — the goal is to complete the shard
+        handoff + rebalance BEFORE the machine dies, not after."""
+        from horovod_tpu.runner.elastic.preempt import drain_key
+        with self._lock:
+            slots = list(self._expected_slots)
+        for host, local_rank in slots:
+            key = (host, local_rank)
+            if key in self._draining:
+                continue
+            info = self._kv.get_json(drain_key(host, local_rank))
+            if not isinstance(info, dict):
+                continue
+            self._register_drain(key, info.get("generation"))
+
+    def _register_drain(self, key, announced_generation):
+        """Shared drain bookkeeping for the heartbeat scan and the reap
+        path's late detection: hold the host out, emit the structured
+        event + counter, schedule the proactive resize."""
+        host, local_rank = key
+        with self._lock:
+            if key in self._draining:
+                return
+            self._draining.add(key)
+            gen = self._generation
+        self._hosts.drain(host)
+        event = {
+            "event": "preempt_drain",
+            "host": host,
+            "local_rank": local_rank,
+            "announced_generation": announced_generation,
+            "generation": gen,
+        }
+        self.drain_events.append(event)
+        get_registry().counter(
+            "hvd_elastic_drains_total",
+            "preemption-notice drains observed by the driver").inc()
+        self._logger.warning("preemption drain: %s", json.dumps(event))
+        self._log(f"drain announced by {host}/{local_rank}; "
+                  f"scheduling proactive resize")
+        self._rebalance_needed.set()
+
     def _reap_workers(self):
         failed = []
+        late_drains = []  # drains detected at reap time, registered below
         with self._lock:
             for key, w in list(self._workers.items()):
                 code = w.poll()
                 if code is None:
                     continue
                 host, local_rank = key
+                if key in self._draining:
+                    # exit-by-drain is a clean departure whatever the exit
+                    # code (SIGTERM'd processes often report 143): no
+                    # failure strike, no blacklist, no flight-dump
+                    # post-mortem — the drain announcement already
+                    # scheduled the resize
+                    self._log(f"drained worker {key} exited (code {code})")
+                    del self._workers[key]
+                    self._removed_slots.discard(key)
+                    continue
                 if code == 0:
                     if key in self._removed_slots:
                         # a slot dropped by a scale-down exits cleanly; it
@@ -376,6 +462,36 @@ class ElasticDriver:
                         self._log(f"removed worker {key} exited")
                         del self._workers[key]
                         self._removed_slots.discard(key)
+                        continue
+                    # last-chance drain check: a worker that announced and
+                    # exited within one heartbeat may beat the drain scan
+                    # to this reap — its clean exit must not read as job
+                    # completion. Two signals, either suffices: the KV
+                    # drain key (written async, may not have landed) and
+                    # the DRAINED registry record (written synchronously
+                    # right before the exit, at the worker's own
+                    # generation, which may trail the driver's by one).
+                    # The registry probe is scoped to generations at or
+                    # after THIS process's spawn — a respawned worker must
+                    # not inherit its drained predecessor's record, or a
+                    # successful completion reads as a drain.
+                    from horovod_tpu.runner.elastic.preempt import drain_key
+                    drained = bool(self._kv.get_json(
+                        drain_key(host, local_rank)))
+                    if not drained:
+                        from horovod_tpu.runner.elastic.registration \
+                            import DRAINED
+                        spawn_gen = self._worker_spawn_gen.get(key, 0)
+                        for g in (self._generation, self._generation - 1):
+                            if g >= spawn_gen and self._registry.get(
+                                    g, host, local_rank) == DRAINED:
+                                drained = True
+                                break
+                    if drained:
+                        self._log(f"worker {key} exited after drain "
+                                  f"announcement; treating as drain")
+                        del self._workers[key]
+                        late_drains.append(key)
                         continue
                     self._log(f"worker {key} finished successfully")
                     self._result = 0 if self._result is None else self._result
@@ -395,6 +511,11 @@ class ElasticDriver:
                 # fresh generation); replaces the prior hack of clearing the
                 # discovery view, which raced with the discovery thread
                 self._rebalance_needed.set()
+        # Late drains register outside the lock (_register_drain takes it)
+        # so the doomed host is held out and the proactive resize fires
+        # even when the exit beat the heartbeat's drain scan.
+        for key in late_drains:
+            self._register_drain(key, None)
         # Dump collection polls the filesystem for up to 1.5s — done once
         # for the whole reap pass (several workers dying together are one
         # incident) and outside the lock so the go-barrier, rebalance, and
@@ -606,6 +727,28 @@ class ElasticDriver:
             except Exception:  # noqa: BLE001
                 w.terminate()
         return self._result if self._result is not None else 1
+
+    def _stop_workers(self, grace: float = 5.0):
+        """Teardown kill with escalation. SIGTERM alone no longer
+        guarantees death: elastic workers install the preemption-notice
+        handler, which defers exit to the next commit boundary (a second
+        SIGTERM force-exits, but a worker wedged in a peerless collective
+        may never run Python again) — so any survivor of the grace window
+        is SIGKILLed rather than left orphaned on the host."""
+        for w in self._workers.values():
+            w.terminate()
+        deadline = time.monotonic() + grace
+        for w in self._workers.values():
+            if w.poll() is not None:
+                continue
+            try:
+                w.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:  # noqa: BLE001 — escalate below
+                pass
+        for w in self._workers.values():
+            if w.poll() is None:
+                self._log("worker survived SIGTERM grace; killing")
+                w.kill()
 
     def _log(self, msg: str):
         # route through the HOROVOD_LOG_LEVEL-configured logger; --verbose

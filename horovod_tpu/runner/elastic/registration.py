@@ -18,6 +18,10 @@ from typing import Dict, Tuple
 READY = "READY"
 SUCCESS = "SUCCESS"
 FAILURE = "FAILURE"
+# Preemption-notice departure: the slot left on purpose (drain protocol,
+# runner/elastic/preempt.py). Counts as neither SUCCESS (the job is not
+# done) nor FAILURE (the host is not at fault — no blacklist strike).
+DRAINED = "DRAINED"
 
 
 def state_key(generation: int, hostname, local_rank) -> str:
@@ -45,7 +49,7 @@ class WorkerStateRegistry:
 
     def count(self, generation: int,
               slots: Dict[Tuple[str, int], None]) -> Dict[str, int]:
-        counts = {READY: 0, SUCCESS: 0, FAILURE: 0, None: 0}
+        counts = {READY: 0, SUCCESS: 0, FAILURE: 0, DRAINED: 0, None: 0}
         for (host, local_rank) in slots:
             state = self.get(generation, host, local_rank)
             counts[state] = counts.get(state, 0) + 1

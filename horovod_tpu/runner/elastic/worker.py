@@ -30,6 +30,7 @@ from typing import Optional, Tuple
 from horovod_tpu.common.env_registry import (env_bool, env_int, env_is_set,
                                              env_str)
 from horovod_tpu.runner.elastic.registration import (  # noqa: F401
+    DRAINED,
     FAILURE,
     READY,
     SUCCESS,
