@@ -50,12 +50,14 @@ test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q
 
 # The slow-marked elastic chaos soak (64 simulated ranks: kills,
-# preemption drains, partitions, rejoins; plus the subprocess drain
-# acceptance) under a hard wall-clock budget. SOAK_BUDGET is seconds.
+# preemption drains, partitions, rejoins — now with driver kills mixed
+# into the event schedule; plus the subprocess drain and driver-recovery
+# acceptances) under a hard wall-clock budget. SOAK_BUDGET is seconds.
 SOAK_BUDGET ?= 900
 soak:
 	timeout -k 10 $(SOAK_BUDGET) env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	    tests/test_chaos_soak.py tests/test_elastic_recovery.py \
+	    tests/test_control_plane.py \
 	    -q -m slow
 
 clean:

@@ -703,6 +703,18 @@ def main():
             print(f"elastic bench failed: {e!r}", file=sys.stderr)
             elastic_block = {"error": repr(e)}
 
+    # Control-plane availability (ISSUE 10 acceptance: `control_plane`
+    # block — driver recovery time, KV replay seconds vs WAL size,
+    # headless-mode duration during the kill drill).
+    if "control_plane" in SKIP:
+        control_plane = {"skipped": True}
+    else:
+        try:
+            control_plane = _control_plane_bench()
+        except Exception as e:  # must not sink the training bench
+            print(f"control-plane bench failed: {e!r}", file=sys.stderr)
+            control_plane = {"error": repr(e)}
+
     print(json.dumps({
         "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -725,6 +737,7 @@ def main():
         "step_attribution": step_attribution,
         "serving": serving,
         "elastic": elastic_block,
+        "control_plane": control_plane,
         "device_kind": jax.devices()[0].device_kind,
     }))
 
@@ -852,6 +865,117 @@ def _elastic_bench():
         "host; wire bytes from zero.reshard_wire_bytes (the runtime "
         "hvd_resize_bytes formula); checkpoint comparison = full-state "
         "broadcast from rank 0 to N-1 ranks")
+    return out
+
+
+def _control_plane_bench():
+    """The BENCH ``control_plane`` block: the measured cost of losing and
+    recovering the control plane (ISSUE 10).
+
+    Method: a durable rendezvous KV is loaded with a realistic key count
+    (topology records + worker state + heartbeats for a 64-rank job,
+    cycled to grow the WAL), a worker-shaped heartbeat loop runs against
+    it, and the server is killed and respawned the way the supervisor
+    respawns a crashed driver (same port, WAL replay, epoch bump). The
+    reported recovery time is kill → first post-recovery heartbeat ack —
+    the same quantity ``hvd_driver_recovery_seconds`` tracks — and the
+    headless duration is the gap between the last pre-kill ack and that
+    first post-recovery ack, i.e. what ``hvd_driver_unreachable_seconds``
+    peaks at during the drill.
+    """
+    import tempfile
+    import threading
+    from horovod_tpu.runner.http_kv import KVClient, KVServer
+
+    out = {}
+    with tempfile.TemporaryDirectory() as d:
+        kv = KVServer(kv_dir=d).start()
+        epoch_before = kv.epoch
+        # 64-rank-shaped control state: topology + worker state +
+        # heartbeats, re-written over several generations so the WAL
+        # carries realistic churn (not just a minimal snapshot)
+        for gen in range(4):
+            for rank in range(64):
+                kv.put_json(
+                    f"rank_and_size/g{gen}/host{rank // 8}/{rank % 8}",
+                    {"rank": rank, "size": 64, "controller_addr": "h0",
+                     "controller_port": 4242,
+                     "controller_data_port": 4243, "epoch": 1})
+                kv.put_json(f"worker_state/g{gen}/host{rank // 8}/"
+                            f"{rank % 8}",
+                            {"state": "READY", "ts": time.time()})
+                kv.put_json(f"worker_heartbeat/host{rank // 8}/"
+                            f"{rank % 8}",
+                            {"pid": 1000 + rank, "rank": rank,
+                             "ts": time.time()})
+            kv.put_json("generation", {"generation": gen, "epoch": 1})
+        wal_bytes = kv.wal_bytes
+        n_keys = len(kv.keys())
+        port = kv.port
+
+        # worker-shaped heartbeat probe: short total deadline per beat
+        acks, stop = [], threading.Event()
+
+        def beat_loop():
+            client = KVClient("127.0.0.1", port)
+            while not stop.is_set():
+                try:
+                    client.put_json("worker_heartbeat/bench/0",
+                                    {"pid": 1, "ts": time.time()},
+                                    timeout=0.5, attempts=1, deadline=0.5)
+                    acks.append(time.monotonic())
+                except Exception:  # noqa: BLE001 — the outage under test
+                    pass
+                time.sleep(0.02)
+
+        t = threading.Thread(target=beat_loop, daemon=True)
+        t.start()
+        # wait for the probe's first landed ack (a fixed sleep flakes on
+        # a loaded machine and IndexErrors the whole block)
+        warm_deadline = time.monotonic() + 10.0
+        while not acks and time.monotonic() < warm_deadline:
+            time.sleep(0.01)
+        if not acks:
+            raise RuntimeError("heartbeat probe never reached the KV")
+        time.sleep(0.2)
+        last_ack_before = acks[-1]
+        t_kill = time.monotonic()
+        kv.stop()  # SIGKILL-equivalent: per-record WAL flush, no snapshot
+        time.sleep(0.2)  # supervisor restart backoff
+        kv2 = KVServer(port=port, kv_dir=d).start()
+        deadline = time.monotonic() + 10.0
+        while (not acks or acks[-1] <= t_kill) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        stop.set()
+        t.join(timeout=2)
+        first_ack_after = next((a for a in acks if a > t_kill), None)
+        out = {
+            "kv_keys": n_keys,
+            "kv_wal_bytes": int(wal_bytes),
+            "kv_replay_seconds": round(kv2.replay_seconds, 4),
+            "driver_recovery_seconds":
+                round(first_ack_after - t_kill, 4)
+                if first_ack_after else None,
+            "headless_seconds":
+                round(first_ack_after - last_ack_before, 4)
+                if first_ack_after else None,
+            "epoch_before": epoch_before,
+            "epoch_after": kv2.epoch,
+            "recovered_keys": len(kv2.keys()),
+        }
+        # >=: the probe's own heartbeat key lands after the count
+        assert out["recovered_keys"] >= n_keys, \
+            "KV replay lost keys during the bench drill"
+        kv2.stop()
+    out["method"] = (
+        "durable KV loaded with 64-rank topology/state/heartbeat keys "
+        "over 4 generations; server killed and respawned on the same "
+        "port (supervisor restart backoff 0.2s); recovery = kill -> "
+        "first post-recovery heartbeat ack from a worker-shaped probe "
+        "(20ms beat, 0.5s total-deadline PUTs); headless = last pre-kill "
+        "ack -> first post-recovery ack; replay seconds from the "
+        "hvd_kv_replay_seconds gauge's source")
     return out
 
 

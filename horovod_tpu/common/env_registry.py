@@ -80,6 +80,35 @@ _decl("HOROVOD_RENDEZVOUS_ADDR", "str", None,
 _decl("HOROVOD_RENDEZVOUS_PORT", "int", 0,
       "launcher's HTTP KV server port")
 
+# -- control-plane availability (durable KV, driver supervision, fencing) --
+_decl("HOROVOD_KV_DIR", "str", None,
+      "durable rendezvous KV: WAL + snapshot directory (unset = in-memory "
+      "only; set = crash-recoverable control plane + epoch fencing)")
+_decl("HOROVOD_KV_SNAPSHOT_BYTES", "int", 1 << 20,
+      "WAL size that triggers a compacted snapshot (write-then-rename)")
+_decl("HOROVOD_CONTROL_EPOCH", "int", 0,
+      "control epoch the driver spawned this worker into (fencing floor: "
+      "strictly-older driver commands are rejected)")
+_decl("HOROVOD_DRIVER_SUPERVISE", "bool", True,
+      "run the elastic driver under the launcher's supervisor (respawn on "
+      "crash); only engages when HOROVOD_KV_DIR is set")
+_decl("HOROVOD_DRIVER_RESTART_LIMIT", "int", 10,
+      "driver crash respawns before the supervisor gives up")
+_decl("HOROVOD_DRIVER_RESTART_BACKOFF_SECONDS", "float", 0.5,
+      "pause between a driver crash and its respawn")
+_decl("HOROVOD_DRIVER_RECOVERY_WAIT_SECONDS", "float", 5.0,
+      "how long a recovered driver waits for live-worker heartbeats "
+      "before treating missing slots as dead (interrupted-resize resume)")
+_decl("HOROVOD_WORKER_HEARTBEAT_SECONDS", "float", 1.0,
+      "elastic worker KV heartbeat interval (driver-recovery adoption + "
+      "headless-mode outage detection)")
+_decl("HOROVOD_WORKER_HEARTBEAT_TIMEOUT_SECONDS", "float", 10.0,
+      "heartbeat age past which an adopted (pid-unreachable) worker is "
+      "declared dead by the recovered driver")
+_decl("HOROVOD_HEADLESS_DEADLINE_SECONDS", "float", 1800.0,
+      "how long a worker keeps training through a driver/KV outage "
+      "(headless mode) before aborting (<=0 = never abort)")
+
 # -- engine tuning knobs (EngineOptions, common.h) --
 _decl("HOROVOD_CYCLE_TIME", "float", 1.0,
       "background-loop coordination cycle time in ms", "both")

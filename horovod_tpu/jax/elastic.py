@@ -696,6 +696,9 @@ def run(func: Callable) -> Callable:
             # spot/preemptible pools: an eviction warning drains instead
             # of crashing (runner/elastic/preempt.py)
             preempt.install_preempt_handler()
+            # KV liveness heartbeat: driver-recovery adoption + bounded
+            # headless mode during control-plane outages
+            elastic_worker.start_heartbeat()
         max_retries = env_int("HOROVOD_ELASTIC_MAX_RETRIES")
         backoff_base = env_float("HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS")
         failures = 0
@@ -803,9 +806,14 @@ def _record_final_state(success: bool):
     if not elastic_worker.is_elastic_worker():
         return
     try:
+        # Generous retry budget: an exit code satisfies the driver that
+        # spawned us, but a driver *recovered mid-outage* only has this
+        # record to tell a clean completion from a crash — wait out a
+        # driver-restart window before giving up.
         elastic_worker.record_state(
             elastic_worker.current_generation(),
-            elastic_worker.SUCCESS if success else elastic_worker.FAILURE)
+            elastic_worker.SUCCESS if success else elastic_worker.FAILURE,
+            attempts=10, deadline=12.0)
     except Exception:  # noqa: BLE001 — the driver also watches exit codes
         pass
 

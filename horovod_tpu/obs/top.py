@@ -251,12 +251,21 @@ def render(rows: List[dict], unreachable: int = 0,
 
 class TopState:
     """Scrape-window state for the live view (previous step-histogram
-    totals per target, so STEP ms is a window mean, not a lifetime one)."""
+    totals per target, so STEP ms is a window mean, not a lifetime one).
+
+    Control-plane outages must not take the view down with them: when no
+    target answers at all (driver/KV dead, workers mid-restart) the last
+    successful rows are re-shown with a STALE banner carrying the
+    last-scrape age, and the view recovers by itself once any scrape
+    succeeds again — ``stale_age_seconds`` is None while fresh."""
 
     def __init__(self, targets: List[dict], serving: bool = False):
         self.targets = targets
         self.serving = serving
         self._prev: Dict[int, Tuple] = {}
+        self._last_rows: List[dict] = []
+        self._last_scrape: Optional[float] = None  # monotonic
+        self.stale_age_seconds: Optional[float] = None
 
     def refresh(self, window: bool = True) -> Tuple[List[dict], int]:
         rows, unreachable = [], 0
@@ -275,13 +284,27 @@ class TopState:
                     self._prev[i] = row["steps_raw"]
             rows.append(row)
         rows.sort(key=lambda r: (len(r["rank"]), r["rank"]))
+        if rows:
+            self._last_rows = rows
+            self._last_scrape = time.monotonic()
+            self.stale_age_seconds = None
+        elif self._last_scrape is not None:
+            # total outage: show the last good table, age-stamped, instead
+            # of a blank screen or a crash — and keep polling
+            self.stale_age_seconds = time.monotonic() - self._last_scrape
+            return list(self._last_rows), unreachable
         return rows, unreachable
 
     def render(self, rows: List[dict], unreachable: int,
                title: str) -> str:
-        if self.serving:
-            return render_serving(rows, unreachable, title)
-        return render(rows, unreachable, title)
+        text = render_serving(rows, unreachable, title) if self.serving \
+            else render(rows, unreachable, title)
+        if self.stale_age_seconds is not None:
+            banner = (f"*** STALE DATA: no target reachable "
+                      f"(driver/KV down?) — showing last scrape from "
+                      f"{self.stale_age_seconds:.0f}s ago ***")
+            text = banner + "\n" + text
+        return text
 
 
 def _title(n_rows: int, n_targets: int) -> str:
@@ -355,7 +378,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.once:
         rows, unreachable = state.refresh(window=False)
         if not rows:
-            print(f"hvd-top: none of {len(targets)} target(s) answered",
+            print(f"hvd-top: none of {len(targets)} target(s) answered "
+                  f"(workers down, or the driver/KV publishing "
+                  f"metrics_targets is unreachable)",
                   file=sys.stderr)
             return 1
         print(state.render(rows, unreachable,

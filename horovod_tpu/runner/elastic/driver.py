@@ -49,7 +49,7 @@ from horovod_tpu.runner.elastic.registration import (
     SUCCESS,
     WorkerStateRegistry,
 )
-from horovod_tpu.runner.exec_utils import WorkerProcess
+from horovod_tpu.runner.exec_utils import AdoptedWorker, WorkerProcess
 from horovod_tpu.runner.http_kv import KVServer, http_get_with_retry
 from horovod_tpu.runner.launch import (
     free_ports,
@@ -75,7 +75,8 @@ class ElasticDriver:
                  command: List[str], extra_env: Optional[dict] = None,
                  reset_limit: Optional[int] = None, verbose: bool = False,
                  discover_interval: float = DISCOVER_INTERVAL_SECS,
-                 spawn_worker=None):
+                 spawn_worker=None, kv_dir: Optional[str] = None,
+                 kv_port: int = 0):
         self._hosts = HostManager(discovery)
         self._min_np = min_np
         self._max_np = max_np
@@ -90,7 +91,16 @@ class ElasticDriver:
         # subprocess/ssh exec.
         self._spawn_worker = spawn_worker or WorkerProcess
 
-        self._kv = KVServer().start()
+        # Durable control plane (ISSUE 10): with a kv_dir the rendezvous
+        # KV write-ahead-logs every mutation and each driver start is a
+        # new persistent control epoch — the fencing token on every
+        # driver-originated command (a respawned driver's epoch outranks
+        # its dead predecessor's).
+        if kv_dir is None:
+            kv_dir = env_str("HOROVOD_KV_DIR")
+        self._kv_dir = kv_dir
+        self._kv = KVServer(port=kv_port, kv_dir=kv_dir).start()
+        self._epoch = self._kv.epoch
         self._registry = WorkerStateRegistry(self._kv)
         self._generation = -1
         self._prev_host_order: List[str] = []
@@ -159,6 +169,20 @@ class ElasticDriver:
         task function for run_task workers on shared-nothing hosts)."""
         self._kv.put_json(key, value)
 
+    def _publish(self, key: str, value):
+        """A driver-originated command write: claims this driver's
+        control epoch (the KV fences strictly-older claimants) and embeds
+        it in dict payloads so workers can fence too."""
+        if isinstance(value, dict):
+            value = dict(value)
+            value.setdefault("epoch", self._epoch)
+        self._kv.put_json(key, value, epoch=self._epoch)
+
+    @property
+    def epoch(self) -> int:
+        """This driver's control epoch (bumped at every durable start)."""
+        return self._epoch
+
     @property
     def generation(self) -> int:
         """The current (on completion: final) topology generation."""
@@ -170,8 +194,15 @@ class ElasticDriver:
         """``on_complete(kv)`` runs after the job finishes, while the
         rendezvous KV is still alive — callers harvest worker-published
         keys (task results) there."""
-        self._wait_for_min_hosts(start_timeout)
-        self._rebalance(first=True)
+        recovered = False
+        if self._kv.recovered:
+            try:
+                recovered = self._recover()
+            except Exception as e:  # noqa: BLE001 — a broken recovery
+                self._log(f"driver recovery failed: {e!r}; cold-starting")
+        if not recovered:
+            self._wait_for_min_hosts(start_timeout)
+            self._rebalance(first=True)
         poller = threading.Thread(target=self._discovery_loop, daemon=True)
         poller.start()
         barrier = threading.Thread(target=self._go_barrier_loop, daemon=True)
@@ -206,6 +237,126 @@ class ElasticDriver:
                     f"{timeout}s (have {self._hosts.current})")
             time.sleep(self._interval)
 
+    # -- crash recovery (ISSUE 10) ------------------------------------------
+
+    def _worker_log_path(self, key) -> Optional[str]:
+        """Per-slot worker log file (durable mode only): survives the
+        driver, so worker output is never lost to a control-plane crash
+        and a respawned driver resumes tailing it."""
+        if not self._kv_dir:
+            return None
+        host, local_rank = key
+        return os.path.join(self._kv_dir, "logs",
+                            f"{host}_{local_rank}.log")
+
+    def _recover(self) -> bool:
+        """Resume a job from WAL-recovered KV state instead of cold-
+        starting generation 0: restore the current generation and its
+        expected slots from the persisted topology, **adopt** workers
+        that are still alive (their next heartbeats prove it — no
+        double-spawn), publish the bumped control epoch, and schedule a
+        rebalance only if the recovered state is incomplete (a resize or
+        drain was interrupted mid-flight). Returns False to fall back to
+        a cold start."""
+        t0 = time.monotonic()
+        gen_info = self._kv.get_json("generation")
+        if not isinstance(gen_info, dict):
+            return False
+        gen = int(gen_info["generation"])
+        # Even a failed adoption must keep the generation monotonic: a
+        # cold start reusing g0 would resurrect stale worker_state/go
+        # records as a fake READY barrier.
+        self._generation = gen
+        slots = []
+        prefix = f"rank_and_size/g{gen}/"
+        for key in self._kv.keys(prefix):
+            rec = self._kv.get_json(key)
+            if not isinstance(rec, dict) or rec.get("removed"):
+                continue
+            host, local_rank = key[len(prefix):].rsplit("/", 1)
+            slots.append((int(rec.get("rank", 0)), (host, int(local_rank))))
+        slots = [s for _, s in sorted(slots)]
+        if not slots:
+            return False
+        self._expected_slots = slots
+        ordered = []
+        for host, _ in slots:
+            if host not in ordered:
+                ordered.append(host)
+        self._prev_host_order = ordered
+        if self._kv.get_json(f"go/g{gen}") is not None:
+            self._go_published.add(gen)
+        self._go_deadline = time.monotonic() + GO_BARRIER_TIMEOUT_SECS
+        self._publish("control_epoch", {"epoch": self._epoch})
+        try:
+            self._hosts.refresh()
+        except RuntimeError as e:
+            self._log(f"discovery error during recovery: {e}")
+        # adopt live workers from their heartbeats (a worker that keeps
+        # beating was NOT killed with the old driver; respawning it would
+        # double-place the slot)
+        hb_timeout = env_float("HOROVOD_WORKER_HEARTBEAT_TIMEOUT_SECONDS")
+        wait_deadline = time.monotonic() + env_float(
+            "HOROVOD_DRIVER_RECOVERY_WAIT_SECONDS")
+        adopted: Dict[Tuple[str, int], dict] = {}
+        first_beat = None
+        while True:
+            for key in slots:
+                if key in adopted:
+                    continue
+                from horovod_tpu.runner.elastic.worker import heartbeat_key
+                hb = self._kv.get_json(heartbeat_key(*key))
+                if isinstance(hb, dict) and \
+                        time.time() - float(hb.get("ts", 0)) <= hb_timeout:
+                    adopted[key] = hb
+                    if first_beat is None:
+                        first_beat = time.monotonic()
+            if len(adopted) >= len(slots) or \
+                    time.monotonic() >= wait_deadline:
+                break
+            time.sleep(0.1)
+        with self._lock:
+            for key, hb in adopted.items():
+                w = AdoptedWorker(key[0], hb.get("rank"),
+                                  int(hb.get("pid") or 0),
+                                  heartbeat_timeout=hb_timeout,
+                                  log_path=self._worker_log_path(key))
+                self._workers[key] = w
+                self._worker_spawn_gen[key] = gen
+        recovery_s = (first_beat or time.monotonic()) - t0
+        reg = get_registry()
+        reg.counter("hvd_driver_recoveries_total",
+                    "driver crash recoveries completed").inc()
+        reg.gauge("hvd_driver_recovery_seconds",
+                  "driver start to first adopted worker heartbeat at the "
+                  "last recovery").set(recovery_s)
+        event = {"event": "driver_recovered", "epoch": self._epoch,
+                 "generation": gen, "adopted": len(adopted),
+                 "expected": len(slots),
+                 "recovery_seconds": round(recovery_s, 3)}
+        self._logger.warning("driver recovered: %s", json.dumps(event))
+        self._log(f"driver_recovered: {json.dumps(event)}")
+        if len(adopted) < len(slots):
+            # dead slots (or a resize/drain cut down mid-flight): the
+            # normal rebalance machinery finishes the interrupted round
+            self._log(f"recovery found {len(slots) - len(adopted)} dead "
+                      f"slot(s); scheduling rebalance")
+            self._rebalance_needed.set()
+        return bool(adopted)
+
+    def _scan_heartbeats(self):
+        """Refresh adopted workers' liveness from their KV heartbeats
+        (remote adoptees have no pollable pid — heartbeat age is their
+        only death signal)."""
+        from horovod_tpu.runner.elastic.worker import heartbeat_key
+        with self._lock:
+            targets = [(key, w) for key, w in self._workers.items()
+                       if getattr(w, "adopted", False)]
+        for key, w in targets:
+            hb = self._kv.get_json(heartbeat_key(*key))
+            if isinstance(hb, dict):
+                w.note_heartbeat(float(hb.get("ts", 0)))
+
     # -- discovery + rebalancing --------------------------------------------
 
     def _discovery_loop(self):
@@ -224,6 +375,7 @@ class ElasticDriver:
             except RuntimeError as e:
                 self._log(f"discovery error: {e}")
                 continue
+            self._scan_heartbeats()
             self._reap_workers()
             try:
                 self._scrape_worker_metrics()
@@ -294,7 +446,7 @@ class ElasticDriver:
                 continue
             with self._lock:
                 if self._generation == gen:
-                    self._kv.put_json(f"go/g{gen}", {"ts": time.time()})
+                    self._publish(f"go/g{gen}", {"ts": time.time()})
                     self._go_published.add(gen)
 
     def _rebalance(self, first: bool = False):
@@ -333,14 +485,15 @@ class ElasticDriver:
             controller_port, data_port = free_ports(2)
             rdv_addr = launcher_addr([s.hostname for s in slots])
             publish_assignments(self._kv, slots, controller_addr,
-                                controller_port, data_port, generation=gen)
+                                controller_port, data_port, generation=gen,
+                                epoch=self._epoch)
             # mark slots no longer present as removed so resetting workers
             # on removed hosts exit cleanly (reference: gloo_context.cc
             # throws when the host is gone)
             current = {(s.hostname, s.local_rank) for s in slots}
             for key in list(self._workers):
                 if key not in current:
-                    self._kv.put_json(
+                    self._publish(
                         f"rank_and_size/g{gen}/{key[0]}/{key[1]}",
                         {"removed": True})
                     self._removed_slots.add(key)
@@ -349,7 +502,8 @@ class ElasticDriver:
             self._expected_slots = [(s.hostname, s.local_rank)
                                     for s in slots]
             self._go_deadline = time.monotonic() + GO_BARRIER_TIMEOUT_SECS
-            self._kv.put_json("notify", {"generation": gen})
+            self._publish("notify", {"generation": gen})
+            self._publish("control_epoch", {"epoch": self._epoch})
             # GC stale generations (keep the previous one: stragglers may
             # still be reading it while re-rendezvousing into gen)
             old = gen - 2
@@ -385,11 +539,22 @@ class ElasticDriver:
                 env = worker_env(s, controller_addr, controller_port,
                                  data_port, self._kv.port, self._extra_env,
                                  elastic=True, generation=gen,
-                                 rendezvous_addr=rdv_addr)
+                                 rendezvous_addr=rdv_addr,
+                                 epoch=self._epoch)
                 self._log(f"spawning worker {key} (generation {gen})")
                 self._worker_spawn_gen[key] = gen
-                self._workers[key] = self._spawn_worker(
-                    s.hostname, s.rank, self._command, env)
+                log_path = self._worker_log_path(key)
+                if log_path is not None and \
+                        self._spawn_worker is WorkerProcess:
+                    # durable mode: worker output goes to a file (a pipe
+                    # dies with the driver — its reader — and would EPIPE
+                    # every surviving worker's next print during a crash)
+                    self._workers[key] = WorkerProcess(
+                        s.hostname, s.rank, self._command, env,
+                        log_path=log_path)
+                else:
+                    self._workers[key] = self._spawn_worker(
+                        s.hostname, s.rank, self._command, env)
 
     def _check_drains(self):
         """One heartbeat's drain scan: a worker that received a preemption
@@ -445,6 +610,18 @@ class ElasticDriver:
                 if code is None:
                     continue
                 host, local_rank = key
+                if code != 0 and getattr(w, "adopted", False):
+                    # an adopted process's exit code is unknowable (no
+                    # child handle) — the worker-state registry record is
+                    # the truth for clean departures
+                    from horovod_tpu.runner.elastic.registration import \
+                        DRAINED
+                    spawn_gen = self._worker_spawn_gen.get(key, 0)
+                    for g in (self._generation, self._generation - 1):
+                        if g >= spawn_gen and self._registry.get(
+                                g, host, local_rank) in (SUCCESS, DRAINED):
+                            code = 0
+                            break
                 if key in self._draining:
                     # exit-by-drain is a clean departure whatever the exit
                     # code (SIGTERM'd processes often report 143): no
@@ -652,7 +829,7 @@ class ElasticDriver:
                     (stats[1] - prev[1]) / (stats[0] - prev[0])
         if targets:
             try:
-                self._kv.put_json("metrics_targets", targets)
+                self._publish("metrics_targets", targets)
             except Exception:  # noqa: BLE001 — telemetry must not kill
                 pass  # the heartbeat
         if serve_targets or getattr(self, "_serve_published", False):
@@ -662,9 +839,11 @@ class ElasticDriver:
             # pure-training jobs never touch the key
             self._serve_published = True
             try:
-                self._kv.put_json("serve_targets",
-                                  {"generation": gen,
-                                   "workers": serve_targets})
+                # epoch-claimed: a fenced-out stale driver must not be
+                # able to publish a shrunken fleet and drain the routers
+                self._publish("serve_targets",
+                              {"generation": gen,
+                               "workers": serve_targets})
             except Exception:  # noqa: BLE001 — routing discovery must not
                 pass  # kill the heartbeat either
         for key, info, delta in anomalies:

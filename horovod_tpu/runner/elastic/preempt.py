@@ -94,15 +94,22 @@ def _announce():
     if not elastic_worker.is_elastic_worker():
         return
     host, slot = elastic_worker._slot()
+    payload = {
+        "generation": elastic_worker.current_generation(),
+        "ts": time.time(),
+    }
     try:
-        elastic_worker.kv_client().put_json(drain_key(host, slot), {
-            "generation": elastic_worker.current_generation(),
-            "ts": time.time(),
-        })
+        elastic_worker.kv_client().put_json(drain_key(host, slot), payload,
+                                            deadline=10.0)
         _logger.warning("preemption notice: announced drain for %s/%s",
                         host, slot)
     except Exception as e:  # noqa: BLE001 — the driver also sees the exit
-        _logger.warning("drain announcement failed: %r", e)
+        # headless mode (driver mid-restart): queue the announcement so
+        # the heartbeat thread replays it the moment the KV returns
+        from horovod_tpu.runner.elastic import headless
+        headless.queue_write(drain_key(host, slot), payload)
+        _logger.warning("drain announcement failed (%r); queued for "
+                        "replay on driver reconnect", e)
 
 
 def install_preempt_handler(sig: Optional[str] = None) -> bool:
@@ -197,18 +204,25 @@ def publish_handoff(world: int, old_rank: int, stacks: dict,
         return False
     from horovod_tpu.runner.elastic import worker as elastic_worker
     quantized = env_str("HOROVOD_RESHARD_COMPRESSION") == "int8"
+    payload = {
+        "world": int(world),
+        "old_rank": int(old_rank),
+        "quantized": quantized,
+        "ts": time.time(),
+        "stacks": encode_shard_stacks(stacks, quantized),
+    }
     try:
         (client or elastic_worker.kv_client()).put_json(
-            handoff_key(world, old_rank), {
-                "world": int(world),
-                "old_rank": int(old_rank),
-                "quantized": quantized,
-                "ts": time.time(),
-                "stacks": encode_shard_stacks(stacks, quantized),
-            })
+            handoff_key(world, old_rank), payload, deadline=20.0)
         return True
     except Exception as e:  # noqa: BLE001 — machine may die any moment
-        _logger.warning("shard handoff failed: %r", e)
+        # best-effort replay if the process survives until the KV is
+        # back; the caller still treats this handoff as not-landed (the
+        # resize falls back to the buddy replica, and fetch_handoff's
+        # TTL rejects a too-late replay)
+        from horovod_tpu.runner.elastic import headless
+        headless.queue_write(handoff_key(world, old_rank), payload)
+        _logger.warning("shard handoff failed (%r); queued for replay", e)
         return False
 
 

@@ -1,0 +1,190 @@
+"""Driver supervision: the crash-recoverable control plane's outer loop.
+
+The elastic driver hosts the rendezvous KV every protocol rides, so PR 9's
+"self-healing" job was still one SIGKILL away from headlessness. With
+``HOROVOD_KV_DIR`` set, the launcher no longer runs the driver in-process:
+
+1. the **supervisor** (this module, inside the launcher process) spawns
+   the driver as a subprocess (``python -m
+   horovod_tpu.runner.elastic.supervisor --driver <args.json>``) with a
+   **pre-allocated KV port** so every incarnation binds the same endpoint
+   workers already hold in ``HOROVOD_RENDEZVOUS_PORT``;
+2. a driver that exits *intentionally* (job finished, reset limit) writes
+   a done-marker into the KV dir first — the supervisor returns its
+   result;
+3. any other exit (SIGKILL, OOM, crash) is a **crash**: the supervisor
+   respawns after ``HOROVOD_DRIVER_RESTART_BACKOFF_SECONDS``, up to
+   ``HOROVOD_DRIVER_RESTART_LIMIT`` times. The respawned driver replays
+   the KV WAL, bumps the persistent control epoch, adopts still-running
+   workers from their heartbeats, and finishes whatever resize/drain the
+   crash interrupted (:meth:`ElasticDriver._recover`).
+
+Workers meanwhile keep training on the peer-to-peer data plane (headless
+mode, :mod:`~horovod_tpu.runner.elastic.headless`) — the control plane's
+death is an observability gap, not a training outage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from horovod_tpu.common.env_registry import env_float, env_int, env_str
+from horovod_tpu.common.hvd_logging import get_logger
+
+_ARGS_FILE = "driver_args.json"
+_DONE_FILE = "driver_done.json"
+
+_logger = get_logger("elastic.supervisor")
+
+
+def _done_path(kv_dir: str) -> str:
+    return os.path.join(kv_dir, _DONE_FILE)
+
+
+def _write_done(kv_dir: str, rc: int):
+    """Mark an intentional driver exit (atomic write-then-rename) so the
+    supervisor can tell 'job finished with rc' from 'driver crashed'."""
+    path = _done_path(kv_dir)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"rc": int(rc), "pid": os.getpid(),
+                       "ts": time.time()}, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        _logger.warning("could not write driver done marker: %r", e)
+
+
+def _read_done(kv_dir: str, pid: int) -> Optional[int]:
+    """The marker's rc if it was written by driver incarnation ``pid``."""
+    try:
+        with open(_done_path(kv_dir)) as f:
+            doc = json.load(f)
+        return int(doc["rc"]) if int(doc.get("pid", -1)) == pid else None
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def driver_main(args_path: str) -> int:
+    """One driver incarnation (the ``--driver`` subprocess entry): run
+    the ElasticDriver over the durable KV, then write the done marker so
+    the supervising launcher knows this exit was intentional."""
+    from horovod_tpu.common.hvd_logging import setup_python_logging
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    setup_python_logging()
+    with open(args_path) as f:
+        payload = json.load(f)
+    kv_dir = env_str("HOROVOD_KV_DIR")
+    driver = ElasticDriver(
+        discovery=HostDiscoveryScript(payload["host_discovery_script"]),
+        min_np=payload["min_np"], max_np=payload["max_np"],
+        command=payload["command"], extra_env=payload.get("extra_env"),
+        reset_limit=payload.get("reset_limit"),
+        verbose=payload.get("verbose", False),
+        kv_dir=kv_dir, kv_port=payload.get("kv_port", 0))
+    rc = driver.run(start_timeout=payload.get("start_timeout", 120.0))
+    if kv_dir:
+        _write_done(kv_dir, rc)
+    return rc
+
+
+def run_supervised(args) -> int:
+    """The launcher-side supervisor loop (``run_elastic`` dispatches here
+    when ``HOROVOD_KV_DIR`` + ``HOROVOD_DRIVER_SUPERVISE`` are set)."""
+    from horovod_tpu.runner.launch import _engine_env, free_port
+    kv_dir = env_str("HOROVOD_KV_DIR")
+    os.makedirs(kv_dir, exist_ok=True)
+    payload = {
+        "min_np": args.min_np or args.num_proc,
+        "max_np": args.max_np or args.num_proc or args.min_np,
+        "host_discovery_script": args.host_discovery_script,
+        "command": list(args.command),
+        "extra_env": _engine_env(args),
+        "reset_limit": args.reset_limit,
+        "verbose": args.verbose,
+        "start_timeout": args.start_timeout,
+        # every driver incarnation must rebind the SAME KV port — the
+        # workers' HOROVOD_RENDEZVOUS_PORT is fixed at spawn time
+        "kv_port": free_port(),
+    }
+    args_path = os.path.join(kv_dir, _ARGS_FILE)
+    with open(args_path, "w") as f:
+        json.dump(payload, f)
+    return _supervise([sys.executable, "-m",
+                       "horovod_tpu.runner.elastic.supervisor",
+                       "--driver", args_path], kv_dir)
+
+
+def _supervise(cmd: List[str], kv_dir: str) -> int:
+    limit = env_int("HOROVOD_DRIVER_RESTART_LIMIT")
+    backoff = env_float("HOROVOD_DRIVER_RESTART_BACKOFF_SECONDS")
+    restarts = 0
+    stopping = {"sig": None}
+    proc: Optional[subprocess.Popen] = None
+
+    def forward(sig, _frame):
+        stopping["sig"] = sig
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(sig)
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig, forward)
+        except ValueError:  # not the main thread (programmatic callers)
+            pass
+    try:
+        while True:
+            try:
+                os.remove(_done_path(kv_dir))
+            except OSError:
+                pass
+            proc = subprocess.Popen(cmd)  # stdout/stderr inherited
+            rc = proc.wait()
+            done_rc = _read_done(kv_dir, proc.pid)
+            if done_rc is not None:
+                return done_rc
+            if stopping["sig"] is not None:
+                _logger.info("supervisor stopping on signal %s",
+                             stopping["sig"])
+                return 128 + int(stopping["sig"])
+            restarts += 1
+            event = {"event": "driver_crash", "exit_code": rc,
+                     "restart": restarts, "limit": limit}
+            _logger.warning("driver crashed: %s", json.dumps(event))
+            sys.stderr.write(f"[supervisor] driver crashed (exit {rc}); "
+                             f"respawn {restarts}/{limit}\n")
+            sys.stderr.flush()
+            if limit and restarts > limit:
+                _logger.error("driver restart limit exhausted")
+                return rc if rc else 1
+            if backoff > 0:
+                time.sleep(backoff)
+    finally:
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except ValueError:
+                pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) == 2 and argv[0] == "--driver":
+        return driver_main(argv[1])
+    sys.stderr.write(
+        "usage: python -m horovod_tpu.runner.elastic.supervisor "
+        "--driver <driver_args.json>\n(the launcher invokes this; use "
+        "hvdrun-tpu with HOROVOD_KV_DIR set instead)\n")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,12 +23,15 @@ generation.
 
 from __future__ import annotations
 
+import json
 import os
+import threading
 import time
 from typing import Optional, Tuple
 
-from horovod_tpu.common.env_registry import (env_bool, env_int, env_is_set,
-                                             env_str)
+from horovod_tpu.common.env_registry import (env_bool, env_float, env_int,
+                                             env_is_set, env_str)
+from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.runner.elastic.registration import (  # noqa: F401
     DRAINED,
     FAILURE,
@@ -36,6 +39,8 @@ from horovod_tpu.runner.elastic.registration import (  # noqa: F401
     SUCCESS,
     state_key,
 )
+
+_logger = get_logger("elastic.worker")
 
 
 def kv_client():
@@ -60,12 +65,111 @@ def _slot() -> Tuple[str, str]:
             str(env_int("HOROVOD_LOCAL_RANK")))
 
 
-def record_state(generation: int, state: str, client=None):
-    """Record READY/SUCCESS/FAILURE for this slot (registry PUT side)."""
+def heartbeat_key(host: str, slot) -> str:
+    """KV key a worker's liveness heartbeat lands under — a recovered
+    driver adopts live workers from these instead of respawning them."""
+    return f"worker_heartbeat/{host}/{slot}"
+
+
+# -- control-epoch fencing (worker side) ------------------------------------
+# The highest control epoch this worker has observed. Spawn env seeds the
+# floor; any driver command (notify / go / topology) carrying a strictly
+# OLDER epoch is a lingering pre-crash driver and is rejected.
+
+_epoch_floor: Optional[int] = None
+_epoch_lock = threading.Lock()
+
+
+def observe_epoch(epoch) -> bool:
+    """True when ``epoch`` is current (None = unfenced legacy record, or
+    at/above the floor — which it then raises); False for a strictly
+    older claim, with a structured log naming both epochs."""
+    global _epoch_floor
+    if epoch is None:
+        return True
+    e = int(epoch)
+    with _epoch_lock:
+        if _epoch_floor is None:
+            _epoch_floor = env_int("HOROVOD_CONTROL_EPOCH")
+        if e < _epoch_floor:
+            current = _epoch_floor
+        else:
+            _epoch_floor = e
+            return True
+    _logger.warning(
+        "rejected stale driver command: %s",
+        json.dumps({"event": "stale_epoch_rejected",
+                    "offered": e, "current": current}))
+    return False
+
+
+def _reset_epoch_for_tests():
+    global _epoch_floor
+    with _epoch_lock:
+        _epoch_floor = None
+
+
+# -- KV liveness heartbeat + headless-mode probe ----------------------------
+
+_heartbeat_started = False
+
+
+def start_heartbeat(interval: Optional[float] = None):
+    """Start the worker's KV heartbeat thread (idempotent; elastic
+    workers only). Each beat PUTs ``worker_heartbeat/<host>/<slot>``
+    (pid, rank, generation, wall ts) with a hard total deadline, and
+    drives the headless-mode state machine: a failed beat starts/extends
+    the outage clock (see :mod:`~horovod_tpu.runner.elastic.headless`),
+    a successful one replays any deferred drain/handoff writes."""
+    global _heartbeat_started
+    if _heartbeat_started or not is_elastic_worker():
+        return
+    _heartbeat_started = True
+    period = interval if interval is not None \
+        else env_float("HOROVOD_WORKER_HEARTBEAT_SECONDS")
+    host, slot = _slot()
+
+    def loop():
+        from horovod_tpu.runner.elastic import headless
+        client = kv_client()
+        while True:
+            try:
+                client.put_json(
+                    heartbeat_key(host, slot),
+                    {"pid": os.getpid(),
+                     "rank": env_int("HOROVOD_RANK"),
+                     "generation": current_generation(),
+                     "ts": time.time()},
+                    timeout=2.0, attempts=1,
+                    deadline=max(0.5, period))
+                headless.note_success(client)
+            except Exception:  # noqa: BLE001 — outage, not a crash
+                headless.note_failure()
+            time.sleep(period)
+
+    threading.Thread(target=loop, daemon=True,
+                     name="hvd-kv-heartbeat").start()
+
+
+def _reset_heartbeat_for_tests():
+    global _heartbeat_started
+    _heartbeat_started = False
+
+
+def record_state(generation: int, state: str, client=None,
+                 attempts: int = 3, deadline: Optional[float] = None):
+    """Record READY/SUCCESS/FAILURE for this slot (registry PUT side).
+
+    ``attempts``/``deadline`` let the *final* record (SUCCESS/FAILURE at
+    exit) ride out a driver-restart window: an exit code is truth for a
+    driver that spawned the process, but a *recovered* driver only has
+    the registry — a success record lost to a mid-restart KV reads as a
+    worker failure and triggers a spurious resize."""
     host, local_rank = _slot()
     (client or kv_client()).put_json(
         state_key(generation, host, local_rank),
-        {"state": state, "ts": time.time()})
+        {"state": state, "ts": time.time()},
+        attempts=attempts, deadline=deadline)
 
 
 def request_new_generation():
@@ -115,6 +219,11 @@ def rendezvous(timeout: float = 300.0) -> int:
             continue
         info = client.get_json(f"rank_and_size/g{gen}/{host}/{local_rank}",
                                timeout=30.0)
+        if info is not None and not observe_epoch(info.get("epoch")):
+            # topology published by a fenced-out pre-crash driver: wait
+            # for the current driver's record instead of re-initializing
+            # into a stale resize
+            info = None
         if info is None:
             # Generation published without this slot: either we were dropped
             # (the driver marks removed slots explicitly) or the driver is
@@ -139,7 +248,9 @@ def rendezvous(timeout: float = 300.0) -> int:
 def _wait_go(client, gen: int, deadline: float) -> bool:
     """Wait for go/g<gen>; False if the generation advances first."""
     while True:
-        if client.get_json(f"go/g{gen}", timeout=1.0) is not None:
+        go = client.get_json(f"go/g{gen}", timeout=1.0)
+        if go is not None and observe_epoch(
+                go.get("epoch") if isinstance(go, dict) else None):
             return True
         cur = client.get_json("generation", timeout=1.0)
         if cur is not None and cur["generation"] > gen:
@@ -169,6 +280,8 @@ def poll_notification(client=None) -> Optional[int]:
         info = (client or kv_client()).get_json("notify", timeout=5.0)
     except Exception:  # noqa: BLE001 — rendezvous may be restarting
         return None
+    if info and not observe_epoch(info.get("epoch")):
+        return None  # a fenced-out stale driver cannot trigger resets
     if info and info["generation"] > current_generation():
         return info["generation"]
     return None

@@ -4,26 +4,77 @@ Reference analog: horovod/runner/http/http_server.py (scoped PUT/GET/DELETE
 KV store, :35-134) + http_client.py. The launcher runs the server; workers
 (and the elastic re-init path, reference gloo_context.cc:154-200) read keys
 like ``rank_and_size/<hostname>/<local_rank>``.
+
+Control-plane availability (ISSUE 10): the KV is the single point every
+elastic protocol rides (rendezvous, drain announcements, shard handoffs,
+``serve_targets``), so it can optionally be **durable** and **fenced**:
+
+- **Durability** — with a ``kv_dir`` (``HOROVOD_KV_DIR``) every mutation is
+  appended to a write-ahead log (``wal.log``: ``[u32 len][u32 crc32]
+  [payload]`` records) before it is visible, and the log is periodically
+  compacted into an atomically-renamed snapshot (``snapshot.json``). A
+  respawned server replays snapshot + WAL; replay is tolerant of a
+  truncated tail and stops at the first corrupt record (the last complete
+  record wins — a crash mid-append must not refuse startup). Replay time
+  and WAL size are exported as ``hvd_kv_replay_seconds`` /
+  ``hvd_kv_wal_bytes``.
+- **Epoch fencing** — each durable server start bumps a persistent
+  **control epoch**. Writers that claim an epoch (the elastic driver; the
+  ``X-Hvd-Epoch`` header on the HTTP path) are rejected with a structured
+  409 when their epoch is strictly older than the server's: a lingering
+  pre-crash driver cannot mutate the store a recovered driver now owns.
+  Epoch-less writes (worker READY records, heartbeats, drain announces)
+  are never fenced — workers do not claim driver authority.
 """
 
 from __future__ import annotations
 
+import base64
 import json
+import os
 import random
 import threading
 import time
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 from urllib import error as urlerror
 from urllib import request as urlrequest
 
+# HTTP header a writer uses to claim a control epoch; strictly-older
+# claims are fenced with 409 + a JSON body naming both epochs.
+EPOCH_HEADER = "X-Hvd-Epoch"
 
-def _retrying(attempt_fn, attempts: int, backoff: float):
+_WAL_FILE = "wal.log"
+_SNAPSHOT_FILE = "snapshot.json"
+_EPOCH_FILE = "epoch"
+# sanity ceiling on a single WAL record (a corrupt length header must not
+# make replay try to allocate gigabytes)
+_MAX_RECORD_BYTES = 64 << 20
+
+
+class StaleEpochError(RuntimeError):
+    """A KV mutation claimed a control epoch older than the server's —
+    the writer is a fenced-out stale driver and must stand down."""
+
+    def __init__(self, current: int, offered: int):
+        self.current = int(current)
+        self.offered = int(offered)
+        super().__init__(
+            f"stale control epoch: offered {self.offered} < "
+            f"current {self.current}")
+
+
+def _retrying(attempt_fn, attempts: int, backoff: float,
+              deadline: Optional[float] = None):
     """Run ``attempt_fn`` with bounded retries and jittered exponential
     backoff. Connection-level failures (URLError, reset, refused) are
     transient and retried; HTTP status errors (404 and friends) mean the
-    server answered and raise immediately. Raises the last connection
-    error once attempts are exhausted."""
+    server answered and raise immediately. ``deadline`` is a *monotonic*
+    instant bounding total wall clock on top of the attempt bound — a
+    hung (accept-but-never-respond) server otherwise costs
+    attempts x timeout. Raises the last connection error once attempts
+    or the deadline are exhausted."""
     last: Exception = RuntimeError("no attempts made")
     for i in range(max(1, attempts)):
         try:
@@ -32,8 +83,13 @@ def _retrying(attempt_fn, attempts: int, backoff: float):
             raise  # the server answered; retrying won't change its mind
         except (urlerror.URLError, ConnectionError, OSError) as e:
             last = e
+        if deadline is not None and time.monotonic() >= deadline:
+            break
         if i + 1 < attempts:
-            time.sleep(backoff * (2 ** i) * (0.5 + random.random() / 2))
+            delay = backoff * (2 ** i) * (0.5 + random.random() / 2)
+            if deadline is not None:
+                delay = min(delay, max(0.0, deadline - time.monotonic()))
+            time.sleep(delay)
     raise last
 
 
@@ -49,30 +105,218 @@ def http_get_with_retry(url: str, timeout: float = 2.0, attempts: int = 3,
     return _retrying(attempt, attempts, backoff)
 
 
-class KVServer:
-    """Threaded HTTP KV server (launcher side)."""
+class _Wal:
+    """Append-only mutation log + compacted snapshots for one KVServer.
 
-    def __init__(self, port: int = 0):
+    Record framing: ``[u32 len LE][u32 crc32 LE][payload]``; payload is a
+    JSON op (``put``/``del``/``delp``, values base64). Appends are flushed
+    per record so a SIGKILLed driver loses at most the record being
+    written; replay tolerates exactly that (truncated tail, bad CRC) by
+    stopping at the last complete record and truncating the garbage."""
+
+    def __init__(self, kv_dir: str, snapshot_bytes: int):
+        self.dir = kv_dir
+        self.snapshot_bytes = snapshot_bytes
+        os.makedirs(kv_dir, exist_ok=True)
+        self.wal_path = os.path.join(kv_dir, _WAL_FILE)
+        self.snap_path = os.path.join(kv_dir, _SNAPSHOT_FILE)
+        self._f = None
+        self.wal_bytes = 0
+        self.replay_seconds = 0.0
+
+    # -- replay ---------------------------------------------------------------
+
+    def replay(self) -> Dict[str, bytes]:
+        t0 = time.perf_counter()
+        store: Dict[str, bytes] = {}
+        snap = self._load_snapshot()
+        if snap:
+            store.update(snap)
+        good_end = 0
+        try:
+            with open(self.wal_path, "rb") as f:
+                data = f.read()
+        except OSError:
+            data = b""
+        off = 0
+        while off + 8 <= len(data):
+            length = int.from_bytes(data[off:off + 4], "little")
+            crc = int.from_bytes(data[off + 4:off + 8], "little")
+            if length <= 0 or length > _MAX_RECORD_BYTES or \
+                    off + 8 + length > len(data):
+                break  # truncated tail / corrupt length: last record wins
+            payload = data[off + 8:off + 8 + length]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                break  # bit flip: stop at the last intact record
+            try:
+                op = json.loads(payload)
+            except ValueError:
+                break
+            self._apply(store, op)
+            off += 8 + length
+            good_end = off
+        if good_end < len(data):
+            # drop the corrupt/truncated tail so fresh appends don't land
+            # after garbage a future replay would stop at
+            try:
+                with open(self.wal_path, "r+b") as f:
+                    f.truncate(good_end)
+            except OSError:
+                pass
+        self._f = open(self.wal_path, "ab")
+        self.wal_bytes = good_end
+        self.replay_seconds = time.perf_counter() - t0
+        return store
+
+    def _load_snapshot(self) -> Dict[str, bytes]:
+        """The compacted base state, or {} when absent/empty/corrupt — a
+        bad snapshot degrades to a full-WAL replay, never a refusal to
+        start."""
+        try:
+            with open(self.snap_path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return {}
+        if not raw:
+            return {}
+        try:
+            doc = json.loads(raw)
+            return {k: base64.b64decode(v)
+                    for k, v in doc.get("store", {}).items()}
+        except (ValueError, TypeError, KeyError):
+            return {}
+
+    @staticmethod
+    def _apply(store: Dict[str, bytes], op: dict):
+        kind = op.get("op")
+        if kind == "put":
+            store[op["k"]] = base64.b64decode(op["v"])
+        elif kind == "del":
+            store.pop(op["k"], None)
+        elif kind == "delp":
+            for k in [k for k in store if k.startswith(op["p"])]:
+                del store[k]
+
+    # -- append + compaction (caller holds the server lock) -------------------
+
+    def append(self, op: dict, store: Dict[str, bytes]):
+        payload = json.dumps(op).encode()
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._f.write(len(payload).to_bytes(4, "little") +
+                      crc.to_bytes(4, "little") + payload)
+        self._f.flush()
+        self.wal_bytes += 8 + len(payload)
+        if self.wal_bytes > self.snapshot_bytes:
+            self.compact(store)
+
+    def compact(self, store: Dict[str, bytes]):
+        """Write the full store as a snapshot (write-then-rename, so a
+        crash mid-compaction leaves the previous snapshot + full WAL —
+        replay of both is idempotent), then start a fresh WAL."""
+        tmp = self.snap_path + ".tmp"
+        doc = {"store": {k: base64.b64encode(v).decode()
+                         for k, v in store.items()},
+               "ts": time.time()}
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        self._f.close()
+        self._f = open(self.wal_path, "wb")
+        self.wal_bytes = 0
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    # -- persistent control epoch --------------------------------------------
+
+    def load_epoch(self) -> int:
+        try:
+            with open(os.path.join(self.dir, _EPOCH_FILE)) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def store_epoch(self, epoch: int):
+        path = os.path.join(self.dir, _EPOCH_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(str(int(epoch)))
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+class KVServer:
+    """Threaded HTTP KV server (launcher side), optionally durable.
+
+    ``kv_dir`` (unset = the historical in-memory store) enables the WAL +
+    snapshot persistence and the persistent control epoch: every server
+    start over the same directory is a **new epoch** (stored + 1), and
+    mutations claiming a strictly-older epoch are fenced (HTTP 409 /
+    :class:`StaleEpochError`). ``recovered`` is True when replay restored
+    at least one key — the signal the elastic driver uses to resume an
+    interrupted job instead of cold-starting generation 0."""
+
+    def __init__(self, port: int = 0, kv_dir: Optional[str] = None,
+                 snapshot_bytes: Optional[int] = None):
         self._store: Dict[str, bytes] = {}
         self._lock = threading.Lock()
-        store = self._store
-        lock = self._lock
+        self._wal: Optional[_Wal] = None
+        self.epoch = 0
+        self.recovered = False
+        if kv_dir:
+            if snapshot_bytes is None:
+                from horovod_tpu.common.env_registry import env_int
+                snapshot_bytes = env_int("HOROVOD_KV_SNAPSHOT_BYTES")
+            self._wal = _Wal(kv_dir, snapshot_bytes)
+            self._store = self._wal.replay()
+            self.recovered = bool(self._store)
+            self.epoch = self._wal.load_epoch() + 1
+            self._wal.store_epoch(self.epoch)
+            self._export_metrics()
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # silence
                 pass
 
+            def _claimed_epoch(self) -> Optional[int]:
+                raw = self.headers.get(EPOCH_HEADER)
+                try:
+                    return int(raw) if raw not in (None, "") else None
+                except ValueError:
+                    return None
+
+            def _send_fenced(self, e: StaleEpochError):
+                body = json.dumps({
+                    "error": "stale_epoch",
+                    "current": e.current,
+                    "offered": e.offered}).encode()
+                self.send_response(409)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_PUT(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
-                with lock:
-                    store[self.path.lstrip("/")] = body
+                try:
+                    server._put(self.path.lstrip("/"), body,
+                                epoch=self._claimed_epoch())
+                except StaleEpochError as e:
+                    self._send_fenced(e)
+                    return
                 self.send_response(200)
                 self.end_headers()
 
             def do_GET(self):
-                with lock:
-                    val = store.get(self.path.lstrip("/"))
+                with server._lock:
+                    val = server._store.get(self.path.lstrip("/"))
                 if val is None:
                     self.send_response(404)
                     self.end_headers()
@@ -83,14 +327,87 @@ class KVServer:
                 self.wfile.write(val)
 
             def do_DELETE(self):
-                with lock:
-                    existed = store.pop(self.path.lstrip("/"), None)
-                self.send_response(200 if existed is not None else 404)
+                try:
+                    existed = server.delete(self.path.lstrip("/"),
+                                            epoch=self._claimed_epoch())
+                except StaleEpochError as e:
+                    self._send_fenced(e)
+                    return
+                self.send_response(200 if existed else 404)
                 self.end_headers()
 
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    # -- durability internals -------------------------------------------------
+
+    def _log_op(self, op: dict):
+        """Caller holds self._lock."""
+        if self._wal is not None:
+            self._wal.append(op, self._store)
+            self._export_metrics()
+
+    def _export_metrics(self):
+        try:
+            from horovod_tpu.metrics.registry import get_registry
+            reg = get_registry()
+            reg.gauge("hvd_kv_wal_bytes",
+                      "current control-plane WAL size").set(
+                          self._wal.wal_bytes)
+            reg.gauge("hvd_kv_replay_seconds",
+                      "WAL+snapshot replay time at last KV start").set(
+                          self._wal.replay_seconds)
+        except Exception:  # noqa: BLE001 — metrics must not break the KV
+            pass
+
+    def _check_epoch_locked(self, claimed: Optional[int]):
+        """Fence a claimed control epoch — caller holds ``self._lock`` so
+        the check is atomic with the mutation it guards (a stale writer
+        passing a separate pre-check could otherwise land its mutation
+        AFTER a newer epoch advanced). Strictly-older raises
+        StaleEpochError; newer advances and persists the server's epoch;
+        epoch-less writes pass untouched."""
+        if claimed is None:
+            return
+        if claimed < self.epoch:
+            raise StaleEpochError(self.epoch, claimed)
+        if claimed > self.epoch:
+            self.epoch = claimed
+            if self._wal is not None:
+                self._wal.store_epoch(claimed)
+
+    @staticmethod
+    def _log_stale(e: StaleEpochError):
+        try:
+            from horovod_tpu.common.hvd_logging import get_logger
+            get_logger("runner.kv").warning(
+                "fenced stale control epoch: %s",
+                json.dumps({"event": "stale_epoch_rejected",
+                            "offered": e.offered, "current": e.current}))
+        except Exception:  # noqa: BLE001 — logging must not mask the 409
+            pass
+
+    def _put(self, key: str, body: bytes, epoch: Optional[int] = None):
+        try:
+            with self._lock:
+                self._check_epoch_locked(epoch)
+                self._store[key] = body
+                self._log_op({"op": "put", "k": key,
+                              "v": base64.b64encode(body).decode()})
+        except StaleEpochError as e:
+            self._log_stale(e)
+            raise
+
+    @property
+    def wal_bytes(self) -> int:
+        return self._wal.wal_bytes if self._wal is not None else 0
+
+    @property
+    def replay_seconds(self) -> float:
+        return self._wal.replay_seconds if self._wal is not None else 0.0
+
+    # -- lifecycle ------------------------------------------------------------
 
     def start(self):
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -103,72 +420,137 @@ class KVServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._wal is not None:
+            self._wal.close()
 
     # direct (in-process) access for the launcher
-    def put_json(self, key: str, value: Any):
-        with self._lock:
-            self._store[key] = json.dumps(value).encode()
+    def put_json(self, key: str, value: Any, epoch: Optional[int] = None):
+        self._put(key, json.dumps(value).encode(), epoch=epoch)
 
     def get_json(self, key: str) -> Optional[Any]:
         with self._lock:
             val = self._store.get(key)
         return json.loads(val) if val is not None else None
 
-    def delete(self, key: str):
-        with self._lock:
-            self._store.pop(key, None)
+    def delete(self, key: str, epoch: Optional[int] = None) -> bool:
+        try:
+            with self._lock:
+                self._check_epoch_locked(epoch)
+                existed = self._store.pop(key, None) is not None
+                if existed:
+                    self._log_op({"op": "del", "k": key})
+                return existed
+        except StaleEpochError as e:
+            self._log_stale(e)
+            raise
 
-    def delete_prefix(self, prefix: str):
+    def delete_prefix(self, prefix: str, epoch: Optional[int] = None):
         """Drop every key under a prefix (generation GC: old topologies,
         worker states, go/reset records would otherwise accumulate for the
         life of an elastic job)."""
+        try:
+            with self._lock:
+                self._check_epoch_locked(epoch)
+                doomed = [k for k in self._store if k.startswith(prefix)]
+                for k in doomed:
+                    del self._store[k]
+                if doomed:
+                    self._log_op({"op": "delp", "p": prefix})
+        except StaleEpochError as e:
+            self._log_stale(e)
+            raise
+
+    def keys(self, prefix: str = "") -> List[str]:
+        """Snapshot of keys under a prefix (driver recovery rebuilds the
+        expected-slot set from the persisted topology records)."""
         with self._lock:
-            for k in [k for k in self._store if k.startswith(prefix)]:
-                del self._store[k]
+            return [k for k in self._store if k.startswith(prefix)]
 
 
 class KVClient:
-    """Worker-side client (reference: runner/http/http_client.py)."""
+    """Worker-side client (reference: runner/http/http_client.py).
 
-    def __init__(self, addr: str, port: int):
+    ``epoch`` (optional) is attached to every mutation as the control-
+    epoch claim; a fenced 409 raises :class:`StaleEpochError` so a stale
+    driver fails loudly instead of silently mutating a store a recovered
+    driver owns."""
+
+    def __init__(self, addr: str, port: int, epoch: Optional[int] = None):
         self._base = f"http://{addr}:{port}/"
+        self.epoch = epoch
+
+    def _headers(self) -> dict:
+        return {EPOCH_HEADER: str(self.epoch)} \
+            if self.epoch is not None else {}
+
+    @staticmethod
+    def _raise_if_fenced(e: urlerror.HTTPError):
+        if e.code != 409:
+            raise e
+        try:
+            body = json.loads(e.read())
+            raise StaleEpochError(body["current"], body["offered"]) from e
+        except (ValueError, KeyError):
+            raise e from None
 
     def put_json(self, key: str, value: Any, timeout: float = 10.0,
-                 attempts: int = 3, backoff: float = 0.1):
-        # Bounded retry on connection-level failures: a worker PUTting its
-        # READY record while the KV restarts (or before its listener is up)
-        # must not fail the whole rendezvous on one ECONNREFUSED.
+                 attempts: int = 3, backoff: float = 0.1,
+                 deadline: Optional[float] = None):
+        """Bounded retry on connection-level failures: a worker PUTting
+        its READY record while the KV restarts (or before its listener is
+        up) must not fail the whole rendezvous on one ECONNREFUSED.
+        ``deadline`` (seconds of total wall clock) additionally bounds the
+        whole call — per-attempt retries alone let a hung
+        (accept-but-never-respond) driver wedge a heartbeat/handoff
+        thread for attempts x timeout."""
         body = json.dumps(value).encode()
+        abs_deadline = time.monotonic() + deadline \
+            if deadline is not None else None
 
         def attempt():
+            per = timeout
+            if abs_deadline is not None:
+                per = max(0.05, min(per, abs_deadline - time.monotonic()))
             req = urlrequest.Request(self._base + key, data=body,
-                                     method="PUT")
-            urlrequest.urlopen(req, timeout=timeout)
+                                     method="PUT", headers=self._headers())
+            try:
+                urlrequest.urlopen(req, timeout=per)
+            except urlerror.HTTPError as e:
+                self._raise_if_fenced(e)
 
-        _retrying(attempt, attempts, backoff)
+        _retrying(attempt, attempts, backoff, deadline=abs_deadline)
 
     def get_json(self, key: str, timeout: float = 10.0,
                  poll_interval: float = 0.2) -> Optional[Any]:
         """GET, polling until the key exists or timeout elapses (rendezvous
-        keys appear asynchronously)."""
+        keys appear asynchronously). ``timeout`` is the total budget: each
+        attempt's transport timeout is capped at what remains, so a hung
+        server cannot stretch one poll past the window."""
         deadline = time.monotonic() + timeout
         while True:
+            per = max(0.05, min(timeout, deadline - time.monotonic()))
             try:
                 with urlrequest.urlopen(self._base + key,
-                                        timeout=timeout) as resp:
+                                        timeout=per) as resp:
                     return json.loads(resp.read())
             except urlerror.HTTPError as e:
                 if e.code != 404:
                     raise
-            except urlerror.URLError:
+            except (urlerror.URLError, ConnectionError, OSError):
+                # unreachable, reset, or hung past the per-attempt
+                # timeout (a raw socket TimeoutError when the server
+                # accepts but never responds) — poll until the window
+                # closes
                 pass
             if time.monotonic() >= deadline:
                 return None
             time.sleep(poll_interval)
 
     def delete(self, key: str, timeout: float = 10.0):
-        req = urlrequest.Request(self._base + key, method="DELETE")
+        req = urlrequest.Request(self._base + key, method="DELETE",
+                                 headers=self._headers())
         try:
             urlrequest.urlopen(req, timeout=timeout)
-        except urlerror.HTTPError:
-            pass
+        except urlerror.HTTPError as e:
+            if e.code == 409:
+                self._raise_if_fenced(e)

@@ -344,9 +344,11 @@ def _engine_env(args) -> dict:
 
 def publish_assignments(kv: KVServer, slots, controller_addr: str,
                         controller_port: int, data_port: int,
-                        generation: int = 0):
+                        generation: int = 0, epoch: int = 0):
     """Publish per-slot topology under a generation scope (reference:
-    rendezvous GET_RANK_AND_SIZE scope, runner/elastic/rendezvous.py)."""
+    rendezvous GET_RANK_AND_SIZE scope, runner/elastic/rendezvous.py).
+    ``epoch`` is the publishing driver's control epoch — embedded so
+    workers can fence a lingering pre-crash driver's stale topology."""
     for s in slots:
         kv.put_json(
             f"rank_and_size/g{generation}/{s.hostname}/{s.local_rank}",
@@ -355,8 +357,10 @@ def publish_assignments(kv: KVServer, slots, controller_addr: str,
              "cross_rank": s.cross_rank, "cross_size": s.cross_size,
              "controller_addr": controller_addr,
              "controller_port": controller_port,
-             "controller_data_port": data_port})
-    kv.put_json("generation", {"generation": generation})
+             "controller_data_port": data_port,
+             "epoch": epoch}, epoch=epoch)
+    kv.put_json("generation", {"generation": generation, "epoch": epoch},
+                epoch=epoch)
 
 
 def launcher_addr(hostnames) -> str:
@@ -383,7 +387,7 @@ def launcher_addr(hostnames) -> str:
 
 def worker_env(slot, controller_addr, controller_port, data_port,
                kv_port, extra, elastic=False, generation=0,
-               rendezvous_addr=None) -> dict:
+               rendezvous_addr=None, epoch=0) -> dict:
     env = slot.to_env()
     env.update(extra)
     env.update({
@@ -396,6 +400,7 @@ def worker_env(slot, controller_addr, controller_port, data_port,
     if elastic:
         env["HOROVOD_ELASTIC"] = "1"
         env["HOROVOD_ELASTIC_GENERATION"] = str(generation)
+        env["HOROVOD_CONTROL_EPOCH"] = str(epoch)
     # Workers must not grab a single-tenant accelerator relay the launcher
     # process may own; training scripts opt in explicitly.
     env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -490,6 +495,13 @@ def _wait_all(workers: List[WorkerProcess], liveness_check=None) -> int:
 
 
 def run_elastic(args) -> int:
+    from horovod_tpu.common.env_registry import env_bool, env_str
+    # Durable control plane: with HOROVOD_KV_DIR set the driver runs as a
+    # supervised subprocess — a crashed/killed driver is respawned and
+    # rehydrates from the WAL while workers keep training headless.
+    if env_str("HOROVOD_KV_DIR") and env_bool("HOROVOD_DRIVER_SUPERVISE"):
+        from horovod_tpu.runner.elastic.supervisor import run_supervised
+        return run_supervised(args)
     from horovod_tpu.runner.elastic.driver import ElasticDriver
     from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
     min_np = args.min_np or args.num_proc

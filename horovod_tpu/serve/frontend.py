@@ -121,8 +121,13 @@ class ServeFrontend:
                     else:
                         self._reply(200, {"status": "ok"})
                 elif path == "/stats":
-                    self._reply(200, serving_stats(
-                        frontend.registry.snapshot()))
+                    stats = serving_stats(frontend.registry.snapshot())
+                    if frontend.router is not None:
+                        # ingress mode: surface discovery health so load
+                        # balancers/operators can see the router is
+                        # serving from a stale (driver-outage) table
+                        stats["router"] = frontend.router.stale_info()
+                    self._reply(200, stats)
                 else:
                     self._reply(404, {"error": "not found"})
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 from urllib import error as urlerror
 from urllib import request as urlrequest
@@ -103,6 +104,12 @@ class RequestRouter:
         self._lock = threading.Lock()
         self._workers: Dict[str, WorkerHandle] = {}
         self.generation = -1
+        # control-plane outage state: when discovery (the driver's
+        # serve_targets key) disappears, the router keeps serving from
+        # this last-known table, marked stale, instead of draining a
+        # fleet of healthy workers over a dead metadata service
+        self.discovery_stale = False
+        self._last_refresh: Optional[float] = None  # monotonic
         self._log = get_logger("serve.router")
         reg = registry if registry is not None else get_registry()
         self._routed = reg.counter("hvd_serve_routed_total")
@@ -159,16 +166,59 @@ class RequestRouter:
             self.generation = generation
             self._refresh_gauge_locked()
 
-    def refresh_from_kv(self, kv_get_json: Callable[[str], Optional[dict]]):
+    def refresh_from_kv(self, kv_get_json: Callable[[str], Optional[dict]]
+                        ) -> bool:
         """Pull the driver-published ``serve_targets`` key (same pattern as
         ``hvd-top``'s ``metrics_targets``) and install it. ``kv_get_json``
         is any ``key -> dict|None`` getter (KVServer.get_json,
-        KVClient.get_json)."""
-        info = kv_get_json("serve_targets")
+        KVClient.get_json).
+
+        Returns True on a successful refresh. A discovery outage (KV
+        unreachable, key gone) keeps the last-known table and flips
+        :attr:`discovery_stale` — surfaced in ``/stats`` — rather than
+        draining workers that are still answering requests."""
+        try:
+            info = kv_get_json("serve_targets")
+        except Exception:  # noqa: BLE001 — KV mid-restart is an outage,
+            info = None  # not a router crash
         if not isinstance(info, dict) or "workers" not in info:
-            return
+            # "stale" means a previously-working discovery went away; a
+            # router that has never refreshed (driver still publishing
+            # its first table) is merely warming up, not degraded
+            if self._last_refresh is not None:
+                if not self.discovery_stale:
+                    self._log.warning(
+                        "serve discovery unreachable: %s",
+                        json.dumps({"event": "discovery_stale",
+                                    "workers": len(self._workers),
+                                    "generation": self.generation}))
+                self.discovery_stale = True
+            return False
         self.update_workers(info["workers"],
                             int(info.get("generation", 0)))
+        if self.discovery_stale:
+            self._log.info("serve discovery recovered (generation %d)",
+                           self.generation)
+        self.discovery_stale = False
+        self._last_refresh = time.monotonic()
+        return True
+
+    @property
+    def discovery_age_seconds(self) -> Optional[float]:
+        """Seconds since the last successful discovery refresh (None
+        before the first one)."""
+        if self._last_refresh is None:
+            return None
+        return time.monotonic() - self._last_refresh
+
+    def stale_info(self) -> dict:
+        """Discovery-health summary for ``/stats`` consumers."""
+        age = self.discovery_age_seconds
+        return {"discovery_stale": self.discovery_stale,
+                "discovery_age_seconds":
+                    round(age, 3) if age is not None else None,
+                "generation": self.generation,
+                "workers": len(self._workers)}
 
     def fail_worker(self, worker_id: str) -> List[str]:
         """Mark a worker dead; returns the request ids that were in flight

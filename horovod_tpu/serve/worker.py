@@ -186,6 +186,8 @@ def main(argv=None) -> int:
     generation = 0
     if elastic:
         generation = elastic_worker.rendezvous()
+        # driver-recovery adoption + headless outage accounting
+        elastic_worker.start_heartbeat()
     kv = elastic_worker.kv_client() \
         if env_is_set("HOROVOD_RENDEZVOUS_ADDR") else None
 

@@ -141,6 +141,51 @@ class FlakyHTTPServer:
         return False
 
 
+class ControlPlane:
+    """A durable rendezvous KV with a kill/restart surface — the chaos
+    soak's control-plane sidecar (ISSUE 10).
+
+    ``kill()`` drops the server the way a SIGKILLed driver does (no
+    snapshot, no graceful anything beyond what the per-record WAL flush
+    already guaranteed); ``restart()`` brings a fresh incarnation up over
+    the same directory and the same port, replaying the WAL and bumping
+    the persistent control epoch — exactly what a supervisor-respawned
+    driver's KV does. ``store()`` snapshots the visible state so tests
+    can assert byte-identical recovery."""
+
+    def __init__(self, kv_dir: str, port: int = 0):
+        from horovod_tpu.runner.http_kv import KVServer
+        self.kv_dir = kv_dir
+        self.kv = KVServer(port=port, kv_dir=kv_dir).start()
+        self.port = self.kv.port
+        self.epochs = [self.kv.epoch]
+
+    def kill(self):
+        # KVServer's durability is synchronous (append+flush per
+        # mutation), so a hard driver kill and a socket close lose the
+        # same amount: nothing that was acknowledged.
+        self.kv.stop()
+
+    def restart(self):
+        from horovod_tpu.runner.http_kv import KVServer
+        self.kv = KVServer(port=self.port, kv_dir=self.kv_dir).start()
+        self.epochs.append(self.kv.epoch)
+        return self.kv
+
+    def kill_and_restart(self):
+        self.kill()
+        return self.restart()
+
+    def store(self) -> Dict[str, object]:
+        return {k: self.kv.get_json(k) for k in self.kv.keys()}
+
+    def close(self):
+        try:
+            self.kv.stop()
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
+
+
 # ===========================================================================
 # Simulated elastic cluster (ISSUE 9): real ShardedState protocol over an
 # in-memory collective bus, at world sizes subprocesses can't reach.

@@ -129,23 +129,47 @@ def test_int8_resize_wire_cut(monkeypatch):
 # beyond 8 ranks — the chaos harness parameterized by world size.
 
 
-def _interleave_soak(world: int, events: int, seed: int):
+def _interleave_soak(world: int, events: int, seed: int,
+                     control_plane=None):
+    """``control_plane``: an optional chaos.ControlPlane sidecar — ISSUE
+    10 mixes ``driver_kill`` events into the schedule: the durable KV is
+    killed mid-soak and restarted (WAL replay + epoch bump) while the
+    cluster keeps training through the outage, and the store must come
+    back byte-identical."""
     rng = np.random.RandomState(seed)
     bound = env_float("HOROVOD_ELASTIC_RECOVERY_BOUND_SECONDS")
     recoveries = []
+    kinds = ["kill", "drain", "partition", "rejoin"]
+    if control_plane is not None:
+        kinds.append("driver_kill")
     with chaos.SimCluster(world, n_params=world * 100,
                           block_size=64, seed=seed) as c:
         for ev in range(events):
             c.run_steps(int(rng.randint(1, 4)), commit_every=1)
             c.run_steps(int(rng.randint(0, 3)))  # live, uncommitted tail
             n = len(c.members)
-            kind = rng.choice(["kill", "drain", "partition", "rejoin"])
+            kind = rng.choice(kinds)
             if kind == "kill" and n > max(2, world // 2):
                 c.kill(int(rng.randint(n)))
             elif kind == "drain" and n > max(2, world // 2):
                 c.drain(int(rng.randint(n)))
             elif kind == "rejoin" and n < world:
                 c.rejoin(min(world - n, int(rng.randint(1, 3))))
+            elif kind == "driver_kill":
+                cp = control_plane
+                cp.kv.put_json(f"soak/ev{ev}", {"event": ev})
+                before = cp.store()
+                epoch_before = cp.kv.epoch
+                cp.kill()
+                # the control plane is DOWN: training continues —
+                # the data plane never needed the driver
+                c.run_steps(1, commit_every=1)
+                c.check_consistency()
+                cp.restart()
+                assert cp.kv.epoch > epoch_before
+                assert cp.kv.recovered
+                assert cp.store() == before, \
+                    "KV state changed across kill+replay"
             # partition: membership unchanged — the identity fast path
             recoveries.append(c.resize())
             c.check_consistency()
@@ -175,6 +199,38 @@ def test_chaos_soak_64_ranks():
     assert len(recoveries) == 10
     text = prom.render(get_registry().collect())
     assert RESIZE_BYTES in text and RESIZE_SECONDS in text
+
+
+@pytest.mark.slow
+def test_chaos_soak_64_ranks_with_driver_kills(tmp_path):
+    """ISSUE 10 soak variant (`make soak`): the PR 9 64-rank event
+    schedule with control-plane kills mixed in — the durable KV dies and
+    respawns mid-soak (WAL replay, epoch bump) while training and
+    resizes continue, with byte-identical KV recovery, no step loss, and
+    the deferred-write queue replayed on reconnect."""
+    from horovod_tpu.runner.elastic import headless
+    from horovod_tpu.runner.http_kv import KVClient
+    headless._reset_for_tests()
+    cp = chaos.ControlPlane(str(tmp_path / "kv"))
+    try:
+        # exercise the headless write queue across one of the kills:
+        # a drain announcement deferred during the outage must land
+        cp.kill()
+        headless.note_failure()
+        headless.queue_write("drain/soak-host/0", {"generation": 7})
+        cp.restart()
+        headless.note_success(KVClient("127.0.0.1", cp.port))
+        assert cp.kv.get_json("drain/soak-host/0") == {"generation": 7}
+        pre_soak_epochs = len(cp.epochs)
+        recoveries = _interleave_soak(world=64, events=10, seed=11,
+                                      control_plane=cp)
+        assert len(recoveries) == 10
+        assert len(cp.epochs) > pre_soak_epochs, \
+            "seeded schedule produced no driver_kill event"
+        assert cp.epochs == sorted(cp.epochs)  # epochs only move forward
+    finally:
+        cp.close()
+        headless._reset_for_tests()
 
 
 @pytest.mark.slow
