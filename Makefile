@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all lint lock-graph engine top tsan asan ubsan sanitizers test test-fast soak clean
+.PHONY: all lint lock-graph engine top tune-smoke tsan asan ubsan sanitizers test test-fast soak clean
 
 all: engine
 
@@ -27,6 +27,15 @@ engine:
 # e.g. `make top TOP_ARGS="--once --targets 127.0.0.1:9090"`.
 top:
 	$(PYTHON) -m horovod_tpu.obs.top $(TOP_ARGS)
+
+# Bounded CPU-backend autotuner session (horovod_tpu/tune/smoke.py): a
+# real closed loop on 2 loopback engine ranks — exposed-comm objective
+# from the flight-ring decomposition, converged config printed as JSON,
+# exit 1 if the tuner failed to cut exposed comm. ~20s, no TPU needed.
+TUNE_SMOKE_STEPS ?= 20
+tune-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.tune.smoke \
+	    --steps $(TUNE_SMOKE_STEPS)
 
 # Sanitizer matrix over the pure-C++ engine harness (tsan_harness.cc):
 # data races (tsan), heap errors + leaks (asan), undefined behavior

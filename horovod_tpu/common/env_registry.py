@@ -127,6 +127,10 @@ _decl("HOROVOD_ENGINE_LIB", "str", None,
 _decl("HOROVOD_HIERARCHICAL_ALLREDUCE", "bool", False,
       "two-level gradient reduction (reduce-scatter over fast axes, "
       "cross-slice allreduce, all-gather back)")
+_decl("HOROVOD_BUCKET_BYTES", "int", 0,
+      "gradient-exchange bucket bound in bytes: >0 issues the backward "
+      "collectives as size-bounded buckets overlapped with backward "
+      "compute (0 = one fused exchange per dtype)")
 
 # -- serving plane / low-latency collectives --
 _decl("HOROVOD_SERVING_MODE", "bool", False,
@@ -158,6 +162,26 @@ _decl("HOROVOD_SERVE_DRAIN_TIMEOUT_SECONDS", "float", 10.0,
       "requests before they are re-routed")
 _decl("HOROVOD_SERVE_RETRY_LIMIT", "int", 3,
       "re-route attempts per accepted request before it fails loudly")
+
+# -- frontend exposed-comm tuner (horovod_tpu/tune) --
+_decl("HOROVOD_TUNE", "bool", False,
+      "exposed-comm-driven frontend autotuner: searches bucket size / "
+      "fusion threshold / cycle time / compression / express lane, and "
+      "keeps the engine's per-cycle parameter broadcast alive for pushes",
+      "both")
+_decl("HOROVOD_TUNE_EPOCH_STEPS", "int", 16,
+      "train steps per tuning epoch (one configuration measured per epoch)")
+_decl("HOROVOD_TUNE_SAMPLES", "int", 24,
+      "tuning-epoch budget before the tuner fixes the best configuration")
+_decl("HOROVOD_TUNE_WARMUP_EPOCHS", "int", 1,
+      "measurement epochs discarded before the search starts (compile "
+      "and cache warmup)")
+_decl("HOROVOD_TUNE_ACCURACY_TOLERANCE", "float", 0.02,
+      "max relative probe-loss degradation an int8 compression choice may "
+      "cause before the tuner rolls it back and blacklists it")
+_decl("HOROVOD_TUNE_LOG", "str", None,
+      "CSV file recording frontend-tuner samples (objective + config per "
+      "row)")
 
 # -- autotuner --
 _decl("HOROVOD_AUTOTUNE", "bool", False,

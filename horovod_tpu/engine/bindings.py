@@ -58,7 +58,11 @@ _lib_lock = threading.Lock()
 # 8: hvdtpu_step_begin/hvdtpu_step_end — frontend step-boundary marks
 #    recorded into the flight ring (step-time attribution); DONE flight
 #    events carry the response's exec-callback span (us) in aux.
-ABI_VERSION = 8
+# 9: hvdtpu_set_tuned_params / hvdtpu_get_tuned_params — runtime push of
+#    cycle time / fusion threshold / cache / express-lane knobs through
+#    the parameter-sync broadcast (HOROVOD_TUNE); the TunedParams wire
+#    record gains low_latency_threshold_bytes + express_lane.
+ABI_VERSION = 9
 
 
 def _lib_path() -> Path:
@@ -199,6 +203,13 @@ def load_library():
         lib.hvdtpu_step_begin.argtypes = [ctypes.c_int64, ctypes.c_int64]
         lib.hvdtpu_step_end.restype = ctypes.c_int32
         lib.hvdtpu_step_end.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.hvdtpu_set_tuned_params.restype = ctypes.c_int32
+        lib.hvdtpu_set_tuned_params.argtypes = [
+            ctypes.c_int64, ctypes.c_double, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_int32]
+        lib.hvdtpu_get_tuned_params.restype = ctypes.c_int64
+        lib.hvdtpu_get_tuned_params.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
         lib.hvdtpu_abort.restype = ctypes.c_int32
         lib.hvdtpu_abort.argtypes = [ctypes.c_int64, ctypes.c_char_p]
         lib.hvdtpu_set_fault_spec.restype = ctypes.c_int32
@@ -402,6 +413,38 @@ class EngineSession:
         """Record the matching STEP_END mark (see :meth:`step_begin`)."""
         if not self._destroyed:
             self._lib.hvdtpu_step_end(self._session, step_id)
+
+    def set_tuned_params(self, cycle_time_ms: Optional[float] = None,
+                         fusion_threshold_bytes: Optional[int] = None,
+                         cache_enabled: Optional[bool] = None,
+                         low_latency_threshold_bytes: Optional[int] = None,
+                         express_lane: Optional[bool] = None):
+        """Push engine knobs at runtime (the frontend autotuner's engine
+        hook). The record is staged and adopted by every rank at the same
+        coordination-cycle boundary via the parameter-sync broadcast —
+        requires ``HOROVOD_TUNE=1`` on multi-rank sessions (single-rank
+        sessions apply on the next cycle unconditionally). ``None`` keeps
+        the current value. Raises on a session that cannot sync."""
+        rc = self._lib.hvdtpu_set_tuned_params(
+            self._session,
+            -1.0 if cycle_time_ms is None else float(cycle_time_ms),
+            -1 if fusion_threshold_bytes is None
+            else int(fusion_threshold_bytes),
+            -1 if cache_enabled is None else int(bool(cache_enabled)),
+            -1 if low_latency_threshold_bytes is None
+            else int(low_latency_threshold_bytes),
+            -1 if express_lane is None else int(bool(express_lane)))
+        if rc != 0:
+            raise HorovodInternalError(
+                self._lib.hvdtpu_last_error().decode())
+
+    def tuned_params(self) -> dict:
+        """The currently applied engine knobs: ``{"cycle_time_ms",
+        "fusion_threshold_bytes", "low_latency_threshold_bytes",
+        "cache_enabled", "tuning_active", "express_lane"}``. Reflects a
+        :meth:`set_tuned_params` push only after the next coordination
+        cycle applied/broadcast it."""
+        return self._json_call(self._lib.hvdtpu_get_tuned_params) or {}
 
     # -- data plane hookup --------------------------------------------------
 

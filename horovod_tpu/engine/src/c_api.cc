@@ -7,6 +7,7 @@
 // single-process N-rank testability win).
 
 #include <algorithm>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <map>
@@ -49,7 +50,11 @@ extern "C" {
 // 8: hvdtpu_step_begin/hvdtpu_step_end — frontend step-boundary marks
 //    recorded into the flight ring (step-time attribution); DONE flight
 //    events carry the response's exec-callback span (us) in aux.
-int32_t hvdtpu_abi_version() { return 8; }
+// 9: hvdtpu_set_tuned_params / hvdtpu_get_tuned_params — runtime push of
+//    cycle time / fusion threshold / cache / express-lane knobs through
+//    the parameter-sync broadcast (HOROVOD_TUNE); TunedParams wire record
+//    gains low_latency_threshold_bytes + express_lane.
+int32_t hvdtpu_abi_version() { return 9; }
 
 namespace {
 
@@ -138,6 +143,56 @@ int32_t hvdtpu_step_end(int64_t session, int64_t step_id) {
   return 0;
 }
 
+// Frontend-tuner knob push: stage a TunedParams record for the next
+// coordination cycle's parameter broadcast (every rank adopts at the
+// same cycle boundary — rank-divergent fusion/express partitions would
+// desync the exec order). Sentinels keep the current value: cycle_ms
+// <= 0, fusion_bytes <= 0, low_latency_bytes < 0, cache/express < 0.
+// Effective on the coordinator; other ranks' pushes are ignored (they
+// adopt via the broadcast). Returns 0, or nonzero with the reason via
+// hvdtpu_last_error (multi-rank session without HOROVOD_TUNE=1).
+int32_t hvdtpu_set_tuned_params(int64_t session, double cycle_ms,
+                                int64_t fusion_bytes, int32_t cache_enabled,
+                                int64_t low_latency_bytes,
+                                int32_t express_lane) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  TunedParams p = e->TunedSnapshot();
+  if (cycle_ms > 0) p.cycle_time_ms = cycle_ms;
+  if (fusion_bytes > 0) p.fusion_threshold_bytes = fusion_bytes;
+  if (cache_enabled >= 0) p.cache_enabled = cache_enabled != 0 ? 1 : 0;
+  if (low_latency_bytes >= 0) p.low_latency_threshold_bytes =
+      low_latency_bytes;
+  if (express_lane >= 0) p.express_lane = express_lane != 0 ? 1 : 0;
+  auto st = e->SetTunedParams(p);
+  if (!st.ok()) {
+    SetError(st.reason);
+    return 1;
+  }
+  return 0;
+}
+
+// Currently applied engine knobs as JSON (CopyJson buffer contract):
+// {"cycle_time_ms","fusion_threshold_bytes","low_latency_threshold_bytes",
+//  "cache_enabled","tuning_active","express_lane"}.
+int64_t hvdtpu_get_tuned_params(int64_t session, char* buf, int64_t len) {
+  Engine* e = GetSession(session);
+  if (!e) return -1;
+  TunedParams p = e->TunedSnapshot();
+  char json[256];
+  std::snprintf(json, sizeof(json),
+                "{\"cycle_time_ms\":%.6f,\"fusion_threshold_bytes\":%lld,"
+                "\"low_latency_threshold_bytes\":%lld,\"cache_enabled\":%d,"
+                "\"tuning_active\":%d,\"express_lane\":%d}",
+                p.cycle_time_ms,
+                static_cast<long long>(p.fusion_threshold_bytes),
+                static_cast<long long>(p.low_latency_threshold_bytes),
+                static_cast<int>(p.cache_enabled),
+                static_cast<int>(p.tuning_active),
+                static_cast<int>(p.express_lane));
+  return CopyJson(json, buf, len);
+}
+
 // Host data-plane microbenchmark: payload bytes/s of the SUM combine
 // kernel (bench.py --host-microbench). dtype per DataType ids;
 // scalar_baseline=1 times the pre-vectorization scalar kernel.
@@ -193,6 +248,13 @@ int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,
   if (const char* v = std::getenv("HOROVOD_SERVING_CYCLE_TIME")) {
     opts.serving_cycle_time_ms = std::atof(v);
   }
+
+  // Frontend-tuner parameter sync: HOROVOD_TUNE keeps the per-cycle
+  // TunedParams broadcast alive so hvdtpu_set_tuned_params pushes reach
+  // every rank at the same cycle boundary.
+  const char* tn = std::getenv("HOROVOD_TUNE");
+  opts.param_sync = tn != nullptr && std::strcmp(tn, "0") != 0 &&
+                    std::strcmp(tn, "") != 0;
 
   // Autotune knobs come straight from env (reference parses these in C++
   // too, operations.cc:521-530 + utils/env_parser).

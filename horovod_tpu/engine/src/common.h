@@ -202,6 +202,14 @@ struct EngineOptions {
   bool serving_mode = false;               // HOROVOD_SERVING_MODE
   int64_t low_latency_threshold_bytes = 4096;  // HOROVOD_LOW_LATENCY_THRESHOLD
   double serving_cycle_time_ms = 0.1;      // HOROVOD_SERVING_CYCLE_TIME
+  // Express lane outside serving mode: the frontend tuner may enable the
+  // small-tensor latency route for training jobs (runtime-tunable via the
+  // TunedParams broadcast; never read directly off env).
+  bool express_lane = false;
+  // Frontend-tuner parameter sync (HOROVOD_TUNE): broadcast the
+  // coordinator's TunedParams every cycle so hvdtpu_set_tuned_params
+  // pushes reach all ranks at the same cycle boundary.
+  bool param_sync = false;                 // HOROVOD_TUNE
   bool autotune = false;                   // HOROVOD_AUTOTUNE
   std::string autotune_log_path;           // HOROVOD_AUTOTUNE_LOG
   int autotune_warmup_samples = 3;         // HOROVOD_AUTOTUNE_WARMUP_SAMPLES

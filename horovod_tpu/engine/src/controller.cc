@@ -88,7 +88,22 @@ Controller::Controller(std::shared_ptr<ControllerTransport> transport,
   stall_.set_shutdown_time_sec(opts_.stall_shutdown_time_sec);
   stall_.set_disabled(opts_.stall_check_disable);
   pm_.Initialize(opts_, /*is_coordinator=*/transport_->rank() == 0);
-  autotune_sync_ = opts_.autotune;
+  // param_sync (HOROVOD_TUNE) keeps the per-cycle broadcast alive so the
+  // frontend tuner's pushes propagate; the engine's own Bayesian autotune
+  // uses the same channel and turns it off at convergence.
+  autotune_sync_ = opts_.autotune || opts_.param_sync;
+  last_applied_ = pm_.Current();
+}
+
+void Controller::PushTunedParams(const TunedParams& p) {
+  std::lock_guard<std::mutex> lock(tune_mu_);
+  pending_push_ = p;
+  push_pending_.store(true, std::memory_order_relaxed);
+}
+
+TunedParams Controller::CurrentParams() const {
+  std::lock_guard<std::mutex> lock(tune_mu_);
+  return last_applied_;
 }
 
 bool Controller::IncrementTensorCount(const Request& msg, int joined_count) {
@@ -250,7 +265,7 @@ bool Controller::LowLatencyEligible(const Response& r) const {
   // responses. Grouped tensors keep their fusion atomicity (a group member
   // peeled off alone would break the all-or-nothing contract), and ERROR/
   // JOIN/BARRIER responses carry no payload worth re-ordering.
-  if (!opts_.serving_mode) return false;
+  if (!opts_.serving_mode && !opts_.express_lane) return false;
   if (r.group_id >= 0) return false;
   if (!r.error_message.empty()) return false;
   switch (r.type) {
@@ -279,7 +294,7 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
   // partition from the same response list, so execution order stays
   // identical across ranks.
   std::vector<Response> express;
-  if (opts_.serving_mode) {
+  if (opts_.serving_mode || opts_.express_lane) {
     std::vector<Response> rest;
     rest.reserve(responses->size());
     for (auto& r : *responses) {
@@ -672,7 +687,10 @@ Status Controller::RunCycle(const CycleInput& in, CycleOutput* out) {
   out->join_completed = join_completed;
   out->should_shut_down = any_shutdown;
 
-  if (autotune_sync_) {
+  // Frontend pushes on a single-rank session need no standing sync: the
+  // broadcast is a local no-op, so servicing it on demand is safe.
+  if (autotune_sync_ ||
+      (size() == 1 && push_pending_.load(std::memory_order_relaxed))) {
     auto pst = SynchronizeParameters(out);
     if (!pst.ok()) return pst;
   }
@@ -700,14 +718,40 @@ Status Controller::SynchronizeParameters(CycleOutput* out) {
       }
     }
     pm_.RecordCycle(bytes);
+    // Consume a staged frontend push — but never while the engine's own
+    // Bayesian search is live (the push would stomp a sample mid-flight;
+    // HOROVOD_TUNE and HOROVOD_AUTOTUNE are documented as exclusive).
+    if (push_pending_.load(std::memory_order_relaxed) && !pm_.active()) {
+      TunedParams staged;
+      {
+        std::lock_guard<std::mutex> lock(tune_mu_);
+        staged = pending_push_;
+        push_pending_.store(false, std::memory_order_relaxed);
+      }
+      staged.tuning_active = pm_.Current().tuning_active;
+      pm_.SetCurrent(staged);
+    }
   }
   std::string payload;
   if (rank() == 0) pm_.Current().SerializeTo(&payload);
   auto st = transport_->Bcast(&payload);
   if (!st.ok()) return st;
   TunedParams p = TunedParams::Deserialize(payload);
-  if (rank() != 0) pm_.SetCurrent(p);
+  if (rank() != 0) {
+    pm_.SetCurrent(p);
+    // a worker's own staged push is superseded by whatever the
+    // coordinator broadcast — drop it so the flag can't stick
+    push_pending_.store(false, std::memory_order_relaxed);
+  }
   opts_.fusion_threshold_bytes = p.fusion_threshold_bytes;
+  if (p.low_latency_threshold_bytes > 0) {
+    opts_.low_latency_threshold_bytes = p.low_latency_threshold_bytes;
+  }
+  opts_.express_lane = p.express_lane != 0;
+  {
+    std::lock_guard<std::mutex> lock(tune_mu_);
+    last_applied_ = p;
+  }
   if ((p.cache_enabled != 0) != opts_.cache_enabled) {
     opts_.cache_enabled = p.cache_enabled != 0;
     cache_.set_capacity(opts_.cache_enabled ? opts_.cache_capacity : 0);
@@ -719,7 +763,9 @@ Status Controller::SynchronizeParameters(CycleOutput* out) {
     cached_pending_.clear();
   }
   out->tuned_cycle_time_ms = p.cycle_time_ms;
-  if (!p.tuning_active) autotune_sync_ = false;
+  // param_sync keeps the channel open for future frontend pushes even
+  // after the engine-side tuner (if any) fixed its configuration.
+  if (!p.tuning_active && !opts_.param_sync) autotune_sync_ = false;
   return Status::OK();
 }
 

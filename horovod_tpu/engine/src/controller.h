@@ -24,8 +24,10 @@
 #ifndef HVD_TPU_CONTROLLER_H
 #define HVD_TPU_CONTROLLER_H
 
+#include <atomic>
 #include <deque>
 #include <memory>
+#include <mutex>
 #include <set>
 #include <string>
 #include <unordered_map>
@@ -82,6 +84,16 @@ class Controller {
   ResponseCache& response_cache() { return cache_; }
   ParameterManager& parameter_manager() { return pm_; }
 
+  // Frontend-tuner push (hvdtpu_set_tuned_params): stage a parameter
+  // record for adoption by the NEXT SynchronizeParameters broadcast —
+  // never applied inline, so every rank flips at the same cycle boundary.
+  // Effective on the coordinator; other ranks' pushes are ignored (their
+  // engines adopt via the broadcast). Safe from any thread.
+  void PushTunedParams(const TunedParams& p);
+  // The last applied record (what the knobs currently are). Safe from any
+  // thread.
+  TunedParams CurrentParams() const;
+
  private:
   // Rank-0 bookkeeping of how many ranks announced each tensor.
   struct TensorCount {
@@ -117,6 +129,13 @@ class Controller {
   StallInspector stall_;
   ParameterManager pm_;
   bool autotune_sync_ = false;
+  // Frontend-tuner push staging (PushTunedParams → SynchronizeParameters).
+  // tune_mu_ guards pending_push_/last_applied_ only — never held across
+  // any other lock or transport call (HVL102 keeps the graph edge-free).
+  mutable std::mutex tune_mu_;
+  std::atomic<bool> push_pending_{false};
+  TunedParams pending_push_;
+  TunedParams last_applied_;
 
   // Tensors that hit cache and wait for the common bit (order-preserving).
   std::deque<Request> cached_pending_;

@@ -302,6 +302,32 @@ Status Engine::WaitHandle(int64_t handle, double timeout_sec) {
   return handles_.Wait(handle, timeout_sec);
 }
 
+Status Engine::SetTunedParams(const TunedParams& p) {
+  if (controller_ == nullptr) {
+    return Status::InvalidArgument("engine not initialized");
+  }
+  // Requires the STANDING sync channel (param_sync). HOROVOD_AUTOTUNE's
+  // channel does not qualify: while its search is live the controller
+  // skips external pushes, and at convergence the broadcast stops — a
+  // push accepted against it would return success and never apply.
+  if (size_ > 1 && !opts_.param_sync) {
+    return Status::InvalidArgument(
+        "tuned-params push needs the standing per-cycle parameter "
+        "broadcast — set HOROVOD_TUNE=1 (frontend tuner sync) on every "
+        "rank (HOROVOD_AUTOTUNE's channel closes at convergence and "
+        "cannot carry frontend pushes)");
+  }
+  controller_->PushTunedParams(p);
+  // Wake the cycle loop so a push on an idle session applies promptly
+  // instead of waiting out the current cycle time.
+  {
+    std::lock_guard<std::mutex> lock(cycle_mu_);
+    work_available_ = true;
+  }
+  cycle_cv_.notify_one();
+  return Status::OK();
+}
+
 void Engine::RequestShutdown() {
   shutdown_requested_.store(true);
   std::lock_guard<std::mutex> lock(cycle_mu_);

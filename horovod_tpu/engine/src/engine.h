@@ -148,6 +148,16 @@ class Engine {
   // response-ordered traffic.
   DataPlane* data_plane() { return data_plane_.get(); }
 
+  // Frontend-tuner knob push (hvdtpu_set_tuned_params): stage a
+  // TunedParams record for the next coordination cycle's parameter
+  // broadcast. Requires a sync channel: HOROVOD_TUNE / HOROVOD_AUTOTUNE,
+  // or a single-rank session (trivial broadcast). Safe from any thread.
+  Status SetTunedParams(const TunedParams& p);
+  // The currently applied record (JSON via hvdtpu_get_tuned_params).
+  TunedParams TunedSnapshot() const {
+    return controller_ ? controller_->CurrentParams() : TunedParams{};
+  }
+
  private:
   void BackgroundLoop();
   void BackgroundLoopImpl();

@@ -30,26 +30,32 @@ double FromUnit(double t, double lo, double hi) {
 }  // namespace
 
 void TunedParams::SerializeTo(std::string* out) const {
-  out->resize(sizeof(double) + sizeof(int64_t) + 2);
+  out->resize(sizeof(double) + 2 * sizeof(int64_t) + 3);
   char* p = &(*out)[0];
   std::memcpy(p, &cycle_time_ms, sizeof(double));
   p += sizeof(double);
   std::memcpy(p, &fusion_threshold_bytes, sizeof(int64_t));
   p += sizeof(int64_t);
+  std::memcpy(p, &low_latency_threshold_bytes, sizeof(int64_t));
+  p += sizeof(int64_t);
   p[0] = static_cast<char>(cache_enabled);
   p[1] = static_cast<char>(tuning_active);
+  p[2] = static_cast<char>(express_lane);
 }
 
 TunedParams TunedParams::Deserialize(const std::string& payload) {
   TunedParams p;
-  if (payload.size() < sizeof(double) + sizeof(int64_t) + 2) return p;
+  if (payload.size() < sizeof(double) + 2 * sizeof(int64_t) + 3) return p;
   const char* q = payload.data();
   std::memcpy(&p.cycle_time_ms, q, sizeof(double));
   q += sizeof(double);
   std::memcpy(&p.fusion_threshold_bytes, q, sizeof(int64_t));
   q += sizeof(int64_t);
+  std::memcpy(&p.low_latency_threshold_bytes, q, sizeof(int64_t));
+  q += sizeof(int64_t);
   p.cache_enabled = static_cast<uint8_t>(q[0]);
   p.tuning_active = static_cast<uint8_t>(q[1]);
+  p.express_lane = static_cast<uint8_t>(q[2]);
   return p;
 }
 
@@ -63,8 +69,10 @@ void ParameterManager::Initialize(const EngineOptions& opts,
   is_coordinator_ = is_coordinator;
   current_.cycle_time_ms = opts.cycle_time_ms;
   current_.fusion_threshold_bytes = opts.fusion_threshold_bytes;
+  current_.low_latency_threshold_bytes = opts.low_latency_threshold_bytes;
   current_.cache_enabled = opts.cache_enabled ? 1 : 0;
   current_.tuning_active = active_ ? 1 : 0;
+  current_.express_lane = opts.express_lane ? 1 : 0;
   warmup_remaining_ = opts.autotune_warmup_samples;
   steps_remaining_ = opts.autotune_steps;
   sample_cycles_ = opts.autotune_sample_cycles;

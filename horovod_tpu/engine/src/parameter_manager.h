@@ -26,11 +26,21 @@
 namespace hvdtpu {
 
 // The tunable set, broadcast as a fixed-size record each autotune cycle.
+// Also the record the frontend tuner (horovod_tpu/tune) pushes through
+// hvdtpu_set_tuned_params: the push lands on the coordinator, and the same
+// per-cycle broadcast that synchronizes the Bayesian autotuner fans it out,
+// so every rank flips fusion/cache/express knobs at the same cycle boundary
+// (rank-divergent fusion partitions would desync the exec order).
 struct TunedParams {
   double cycle_time_ms = 0;
   int64_t fusion_threshold_bytes = 0;
+  // Express-lane class boundary: responses at or under this many bytes skip
+  // the fusion buffer and run ahead of bulk traffic when the lane is on
+  // (serving mode, or express_lane enabled by the tuner for training).
+  int64_t low_latency_threshold_bytes = 4096;
   uint8_t cache_enabled = 1;
   uint8_t tuning_active = 1;
+  uint8_t express_lane = 0;
 
   void SerializeTo(std::string* out) const;
   static TunedParams Deserialize(const std::string& payload);

@@ -32,6 +32,11 @@ refresh window, queue depth, in-flight count, mean batch occupancy,
 p50/p99 request latency, ok/reject/expired totals — the ``hvd_serve_*``
 families the serving plane exports on the same endpoints).
 
+``--tune`` switches to the autotuner view (current bucket bytes / fusion
+threshold / cycle time / express-lane class / compression, search phase,
+last and best exposed-comm objective, samples spent — the ``hvd_tune_*``
+gauges the frontend tuner exports, :mod:`horovod_tpu.tune`).
+
 CLI::
 
     hvd-top --targets 127.0.0.1:9090,127.0.0.1:9091
@@ -64,6 +69,17 @@ _FMT = "{:>5} {:>9} {:>6} {:>7} {:>7} {:>6} {:>5} {:>7} {:>5}"
 SERVING_COLUMNS = ("RANK", "QPS", "QD", "INFL", "OCC", "p50ms", "p99ms",
                    "OK", "REJ", "EXP")
 _SERVING_FMT = "{:>5} {:>7} {:>4} {:>5} {:>5} {:>8} {:>8} {:>7} {:>6} {:>6}"
+
+# Tune view (--tune): the frontend autotuner's live state per rank, from
+# the hvd_tune_* gauges (horovod_tpu/tune). BUCKET/FUSE/CYC/LANE are the
+# currently applied knobs, PHASE the search stage, OBJ/BEST the last and
+# best measured exposed-comm objective, N the samples spent.
+TUNE_COLUMNS = ("RANK", "BUCKET", "FUSE MB", "CYC ms", "LANE", "COMP",
+                "PHASE", "OBJ ms", "BEST ms", "N")
+_TUNE_FMT = ("{:>5} {:>9} {:>8} {:>7} {:>6} {:>5} {:>9} {:>8} {:>8} "
+             "{:>4}")
+_TUNE_PHASES = {0: "warmup", 1: "sweep", 2: "refine", 3: "converged"}
+_TUNE_COMP = {0: "none", 1: "bf16", 2: "int8"}
 
 
 def _parse_hostports(arg: str) -> List[dict]:
@@ -200,6 +216,65 @@ def serving_row_from_snapshot(target: dict, snap: dict,
     }
 
 
+def tune_row_from_snapshot(target: dict, snap: dict) -> dict:
+    """One tune-view row from the hvd_tune_* gauge family."""
+    def v(name):
+        return snapshot_value(snap, name)
+
+    phase = v("hvd_tune_phase")
+    comp = v("hvd_tune_compression")
+    obj = v("hvd_tune_objective_seconds")
+    best = v("hvd_tune_best_objective_seconds")
+    return {
+        "rank": _rank_of(target, snap),
+        "bucket_bytes": v("hvd_tune_bucket_bytes"),
+        "fusion_mb": (v("hvd_tune_fusion_threshold_bytes") / (1 << 20)
+                      if v("hvd_tune_fusion_threshold_bytes") is not None
+                      else None),
+        "cycle_ms": v("hvd_tune_cycle_time_ms"),
+        "lane_bytes": v("hvd_tune_low_latency_threshold_bytes"),
+        "compression": (_TUNE_COMP.get(int(comp))
+                        if comp is not None else None),
+        "phase": (_TUNE_PHASES.get(int(phase))
+                  if phase is not None else None),
+        "objective_ms": obj * 1e3 if obj is not None else None,
+        "best_ms": best * 1e3 if best is not None else None,
+        "samples": v("hvd_tune_samples_total"),
+    }
+
+
+def _fmt_bucket(v) -> str:
+    if v is None:
+        return "-"
+    v = int(v)
+    if v <= 0:
+        return "off"
+    if v >= 1 << 20:
+        return f"{v / (1 << 20):.0f}M"
+    return f"{v / 1024:.0f}K"
+
+
+def render_tune(rows: List[dict], unreachable: int = 0,
+                title: str = "") -> str:
+    lines = []
+    if title:
+        lines.append(title)
+    lines.append(_TUNE_FMT.format(*TUNE_COLUMNS))
+    for r in rows:
+        lines.append(_TUNE_FMT.format(
+            r["rank"], _fmt_bucket(r["bucket_bytes"]),
+            _fmt(r["fusion_mb"], "{:.0f}"),
+            _fmt(r["cycle_ms"], "{:.2f}"),
+            _fmt_bucket(r["lane_bytes"]),
+            r["compression"] or "-", r["phase"] or "-",
+            _fmt(r["objective_ms"], "{:.2f}"),
+            _fmt(r["best_ms"], "{:.2f}"),
+            _fmt(r["samples"], "{:.0f}")))
+    if unreachable:
+        lines.append(f"({unreachable} target(s) unreachable)")
+    return "\n".join(lines)
+
+
 def render_serving(rows: List[dict], unreachable: int = 0,
                    title: str = "") -> str:
     lines = []
@@ -259,9 +334,11 @@ class TopState:
     last-scrape age, and the view recovers by itself once any scrape
     succeeds again — ``stale_age_seconds`` is None while fresh."""
 
-    def __init__(self, targets: List[dict], serving: bool = False):
+    def __init__(self, targets: List[dict], serving: bool = False,
+                 tune: bool = False):
         self.targets = targets
         self.serving = serving
+        self.tune = tune
         self._prev: Dict[int, Tuple] = {}
         self._last_rows: List[dict] = []
         self._last_scrape: Optional[float] = None  # monotonic
@@ -275,7 +352,9 @@ class TopState:
                 unreachable += 1
                 continue
             prev = self._prev.get(i) if window else None
-            if self.serving:
+            if self.tune:
+                row = tune_row_from_snapshot(t, snap)
+            elif self.serving:
                 row = serving_row_from_snapshot(t, snap, prev)
                 self._prev[i] = row["qps_raw"]
             else:
@@ -297,8 +376,12 @@ class TopState:
 
     def render(self, rows: List[dict], unreachable: int,
                title: str) -> str:
-        text = render_serving(rows, unreachable, title) if self.serving \
-            else render(rows, unreachable, title)
+        if self.tune:
+            text = render_tune(rows, unreachable, title)
+        elif self.serving:
+            text = render_serving(rows, unreachable, title)
+        else:
+            text = render(rows, unreachable, title)
         if self.stale_age_seconds is not None:
             banner = (f"*** STALE DATA: no target reachable "
                       f"(driver/KV down?) — showing last scrape from "
@@ -361,7 +444,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--serving", action="store_true",
                         help="serving view: per-rank QPS, queue depth, "
                              "batch occupancy, p50/p99 latency")
+    parser.add_argument("--tune", action="store_true",
+                        help="tuner view: current bucket/fusion/cycle/"
+                             "express-lane knobs, search phase, objective "
+                             "trend (hvd_tune_* gauges)")
     args = parser.parse_args(argv)
+    if args.serving and args.tune:
+        print("hvd-top: --serving and --tune are mutually exclusive",
+              file=sys.stderr)
+        return 2
 
     try:
         targets = discover_targets(args)
@@ -373,7 +464,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               "at the rendezvous KV, or set HOROVOD_METRICS_PORT)",
               file=sys.stderr)
         return 2
-    state = TopState(targets, serving=args.serving)
+    state = TopState(targets, serving=args.serving, tune=args.tune)
 
     if args.once:
         rows, unreachable = state.refresh(window=False)

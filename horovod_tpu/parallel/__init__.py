@@ -7,6 +7,7 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     data_parallel_mesh,
     replicated,
 )
+from horovod_tpu.parallel import bucketing  # noqa: F401
 from horovod_tpu.parallel import collectives  # noqa: F401
 from horovod_tpu.parallel import zero  # noqa: F401
 from horovod_tpu.parallel.zero import (  # noqa: F401

@@ -44,11 +44,15 @@ def _resolve_hierarchical(hierarchical: Optional[bool],
 
 
 def _make_param_update(optimizer, op, axes, compression, prescale_factor,
-                       postscale_factor, hierarchical, sharded_update):
+                       postscale_factor, hierarchical, sharded_update,
+                       bucket_bytes=0):
     """Build ``(grads, opt_state, params) -> (new_params, new_opt_state)``
     plus the opt-state PartitionSpec, switching between the replicated path
     (allreduce + full update on every replica) and the ZeRO-1 sharded path
-    (reduce-scatter → shard update → all-gather, parallel/zero.py)."""
+    (reduce-scatter → shard update → all-gather, parallel/zero.py).
+    ``bucket_bytes > 0`` splits either exchange into size-bounded buckets
+    in backward-ready order (parallel/bucketing.py) so XLA can overlap
+    wire time with the rest of backward."""
     if sharded_update:
         if op is collectives.Adasum:
             raise ValueError("sharded_update is incompatible with Adasum — "
@@ -62,12 +66,12 @@ def _make_param_update(optimizer, op, axes, compression, prescale_factor,
         update = functools.partial(
             zero.apply_sharded_update, optimizer, axes=axes, op=op,
             compression=compression, prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor)
+            postscale_factor=postscale_factor, bucket_bytes=bucket_bytes)
         return update, P(axes)
 
     allreduce_grads = _make_grad_allreduce(
         op, axes, compression, prescale_factor, postscale_factor,
-        hierarchical)
+        hierarchical, bucket_bytes)
 
     def apply(grads, opt_state, params):
         grads = allreduce_grads(grads)
@@ -78,8 +82,16 @@ def _make_param_update(optimizer, op, axes, compression, prescale_factor,
 
 
 def _make_grad_allreduce(op, axes, compression, prescale_factor,
-                         postscale_factor, hierarchical):
-    """The gradient-combining tree map shared by both step builders."""
+                         postscale_factor, hierarchical, bucket_bytes=0):
+    """The gradient-combining tree map shared by both step builders.
+
+    ``bucket_bytes > 0`` fuses per (bucket, dtype) instead of per dtype
+    over the whole tree: the collectives are elementwise, so the partition
+    cannot change values (bit-exact vs the unbucketed path for plain/cast
+    wire formats), and each bucket's collective depends only on its own
+    leaves — the overlap hook. Adasum is untouched: its exchange is
+    already per-tensor (maximally bucketed)."""
+    from horovod_tpu.parallel.bucketing import bucketed_apply_tree
     quantized = bool(getattr(compression, "quantized", False))
     if quantized:
         if hierarchical:
@@ -95,6 +107,12 @@ def _make_grad_allreduce(op, axes, compression, prescale_factor,
                 v, op=op, axis=axes, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
                 block_size=compression.block_size)
+        if bucket_bytes > 0:
+            # leaves align to the quantization block so block cohorts never
+            # span leaves — the quantized result is then invariant to the
+            # bucket partition (re-tuning never changes numerics)
+            return lambda tree: bucketed_apply_tree(
+                qred, tree, bucket_bytes, align=compression.block_size)
         return lambda tree: fused_apply_tree(qred, tree)
     if op is collectives.Adasum:
         def adasum_tree(tree):
@@ -121,7 +139,22 @@ def _make_grad_allreduce(op, axes, compression, prescale_factor,
             out = compression.decompress(out, ctx)
         return out
 
+    if bucket_bytes > 0:
+        return lambda tree: bucketed_apply_tree(red, tree, bucket_bytes)
     return lambda tree: fused_apply_tree(red, tree)
+
+
+def _vjp_grads(loss_fn, params, *args):
+    """Explicit-VJP gradient: forward once via ``jax.vjp``, then drive the
+    backward with a unit cotangent. Numerically identical to
+    ``jax.value_and_grad`` — the point is structural: the bucketed
+    exchange consumes the grads leaf-by-leaf, so each bucket's collective
+    depends only on its own leaves and XLA's latency-hiding scheduler may
+    issue it while the rest of the backward is still computing."""
+    loss, pullback, aux = jax.vjp(lambda p: loss_fn(p, *args), params,
+                                  has_aux=True)
+    grads, = pullback(jnp.ones((), loss.dtype))
+    return (loss, aux), grads
 
 
 class TrainStepOutput(NamedTuple):
@@ -151,7 +184,8 @@ def make_train_step(loss_fn: Callable,
                     hierarchical: Optional[bool] = None,
                     donate: bool = True,
                     remat: bool = False,
-                    sharded_update: bool = False) -> Callable:
+                    sharded_update: bool = False,
+                    bucket_bytes: Optional[int] = None) -> Callable:
     """Build a jitted data-parallel train step.
 
     ``loss_fn(params, batch, rng) -> (loss, aux)`` computes the local loss on
@@ -180,6 +214,17 @@ def make_train_step(loss_fn: Callable,
     recomputes activations instead of keeping them in HBM — the standard
     TPU trade of FLOPs for memory when a model's activations don't fit.
     Gradients are bit-identical; only peak memory and step time change.
+
+    ``bucket_bytes`` (env default ``HOROVOD_BUCKET_BYTES``; 0 = off) turns
+    on the bucketed backward-overlap exchange: the backward runs through an
+    explicit ``jax.vjp`` and the gradient collectives are issued as
+    size-bounded buckets in backward-ready order, each depending only on
+    its own leaves, so XLA overlaps the wire time with the remaining
+    backward FLOPs. Bit-exact vs the unbucketed path (plain/cast wire;
+    int8 results are invariant to the bucket partition — see
+    :mod:`horovod_tpu.parallel.bucketing`); composes with ``compression``
+    and ``sharded_update`` (opt state then needs
+    ``sharded_opt_init(..., bucket_bytes=...)`` with the same bound).
     """
     axes = tuple(a for a in axes if a in mesh.shape)
     if remat:
@@ -187,11 +232,14 @@ def make_train_step(loss_fn: Callable,
     # Accept both spellings of "no compression": None and the reference-style
     # Compression.none pass-through class.
     from horovod_tpu.jax.compression import Compression
+    from horovod_tpu.parallel.bucketing import resolve_bucket_bytes
     if compression is Compression.none:
         compression = None
+    bucket_bytes = resolve_bucket_bytes(bucket_bytes)
     _apply_update, opt_spec = _make_param_update(
         optimizer, op, axes, compression, prescale_factor, postscale_factor,
-        _resolve_hierarchical(hierarchical, axes), sharded_update)
+        _resolve_hierarchical(hierarchical, axes), sharded_update,
+        bucket_bytes)
 
     def _sync_aux(aux):
         def sync(v):
@@ -208,8 +256,11 @@ def make_train_step(loss_fn: Callable,
         # Decorrelate per-replica randomness (dropout etc.) while keeping
         # params identical: fold the replica id into the key.
         rng = jax.random.fold_in(rng, collectives.axis_rank(axes))
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch, rng)
+        if bucket_bytes > 0:
+            (loss, aux), grads = _vjp_grads(loss_fn, params, batch, rng)
+        else:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch, rng)
         new_params, new_opt_state = _apply_update(grads, opt_state, params)
         loss = collectives.allreduce(loss, op=Average, axis=axes)
         return TrainStepOutput(new_params, new_opt_state, loss, _sync_aux(aux))
@@ -246,7 +297,8 @@ def make_stateful_train_step(loss_fn: Callable,
                              hierarchical: Optional[bool] = None,
                              donate: bool = True,
                              remat: bool = False,
-                             sharded_update: bool = False) -> Callable:
+                             sharded_update: bool = False,
+                             bucket_bytes: Optional[int] = None) -> Callable:
     """Train step for models with non-gradient state (BatchNorm running
     statistics etc.).
 
@@ -260,16 +312,21 @@ def make_stateful_train_step(loss_fn: Callable,
     :func:`make_train_step`); ``sharded_update=True`` routes the update
     through the ZeRO-1 reduce-scatter pipeline (see :func:`make_train_step`
     — opt state must come from :func:`~horovod_tpu.parallel.zero.sharded_opt_init`).
+    ``bucket_bytes`` turns on the bucketed backward-overlap exchange (see
+    :func:`make_train_step`).
     """
     axes = tuple(a for a in axes if a in mesh.shape)
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
     from horovod_tpu.jax.compression import Compression
+    from horovod_tpu.parallel.bucketing import resolve_bucket_bytes
     if compression is Compression.none:
         compression = None
+    bucket_bytes = resolve_bucket_bytes(bucket_bytes)
     _apply_update, opt_spec = _make_param_update(
         optimizer, op, axes, compression, prescale_factor, postscale_factor,
-        _resolve_hierarchical(hierarchical, axes), sharded_update)
+        _resolve_hierarchical(hierarchical, axes), sharded_update,
+        bucket_bytes)
 
     def _sync_state(tree):
         def sync(v):
@@ -281,8 +338,12 @@ def make_stateful_train_step(loss_fn: Callable,
 
     def _local_step(params, opt_state, model_state, batch, rng):
         rng = jax.random.fold_in(rng, collectives.axis_rank(axes))
-        (loss, (new_model_state, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, model_state, batch, rng)
+        if bucket_bytes > 0:
+            (loss, (new_model_state, aux)), grads = _vjp_grads(
+                loss_fn, params, model_state, batch, rng)
+        else:
+            (loss, (new_model_state, aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, model_state, batch, rng)
         new_params, new_opt_state = _apply_update(grads, opt_state, params)
         loss = collectives.allreduce(loss, op=Average, axis=axes)
         return StatefulTrainStepOutput(new_params, new_opt_state,

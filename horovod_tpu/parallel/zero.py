@@ -58,37 +58,70 @@ class _DtypeGroup(NamedTuple):
     shard: int               # padded // n_shards
 
 
-def _group_leaves(leaves, n_shards: int,
-                  block_size: int = LANE) -> Tuple[_DtypeGroup, ...]:
+def _group_leaves(leaves, n_shards: int, block_size: int = LANE, *,
+                  indices: Optional[Sequence[int]] = None,
+                  leaf_align: int = 1,
+                  key_prefix: str = "") -> Tuple[_DtypeGroup, ...]:
     """Stable per-dtype grouping of a leaf list (first-appearance order,
-    mirroring ops/fusion.py), with the ZeRO partition geometry attached."""
+    mirroring ops/fusion.py), with the ZeRO partition geometry attached.
+
+    ``indices`` restricts the grouping to a leaf subset (the bucketed
+    pipeline groups per bucket); ``leaf_align`` pads every leaf to a
+    multiple of it inside the flat layout (the bucketed int8 path aligns
+    leaves to the quantization block so block cohorts never span leaves —
+    that is what makes the quantized result invariant to the bucket
+    partition)."""
     order: dict = {}
-    for i, leaf in enumerate(leaves):
-        order.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    for i in (range(len(leaves)) if indices is None else indices):
+        order.setdefault(jnp.dtype(leaves[i].dtype), []).append(i)
     groups = []
     lane = n_shards * block_size
     for dtype, idxs in order.items():
         sizes = tuple(int(leaves[i].size) for i in idxs)
-        total = sum(sizes)
+        total = sum(sz + (-sz) % leaf_align for sz in sizes)
         padded = total + (-total) % lane
         groups.append(_DtypeGroup(
-            key=str(dtype), dtype=dtype, indices=tuple(idxs), sizes=sizes,
+            key=key_prefix + str(dtype), dtype=dtype, indices=tuple(idxs),
+            sizes=sizes,
             shapes=tuple(tuple(leaves[i].shape) for i in idxs),
             padded=padded, shard=padded // n_shards))
     return tuple(groups)
 
 
-def _flatten_group(leaves, group: _DtypeGroup) -> jax.Array:
-    flat = jnp.concatenate([leaves[i].ravel() for i in group.indices])
+def bucket_groups(leaves, n_shards: int, bucket_bytes: int,
+                  block_size: int = LANE) -> Tuple[_DtypeGroup, ...]:
+    """Flat groups for the bucketed ZeRO-1 pipeline: one group per
+    (bucket, dtype) in bucket order (reverse flatten order — the order
+    backward produces the grads), every leaf block-aligned. Pure function
+    of (leaf shapes, bucket_bytes, n_shards) — the train step and
+    :func:`sharded_opt_init` derive the identical geometry from it."""
+    from horovod_tpu.parallel.bucketing import plan_buckets
+    groups = []
+    for b in plan_buckets(leaves, bucket_bytes):
+        groups.extend(_group_leaves(
+            leaves, n_shards, block_size, indices=b.indices,
+            leaf_align=block_size, key_prefix=f"b{b.index:04d}/"))
+    return tuple(groups)
+
+
+def _flatten_group(leaves, group: _DtypeGroup,
+                   leaf_align: int = 1) -> jax.Array:
+    parts = []
+    for i in group.indices:
+        v = leaves[i].ravel()
+        pad = (-v.size) % leaf_align
+        parts.append(jnp.pad(v, (0, pad)) if pad else v)
+    flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
     pad = group.padded - flat.size
     return jnp.pad(flat, (0, pad)) if pad else flat
 
 
-def _unflatten_group(flat: jax.Array, group: _DtypeGroup) -> list:
+def _unflatten_group(flat: jax.Array, group: _DtypeGroup,
+                     leaf_align: int = 1) -> list:
     out, offset = [], 0
     for sz, shape in zip(group.sizes, group.shapes):
         out.append(flat[offset:offset + sz].reshape(shape))
-        offset += sz
+        offset += sz + (-sz) % leaf_align
     return out
 
 
@@ -113,7 +146,8 @@ def apply_sharded_update(optimizer,
                          compression=None,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
-                         block_size: int = LANE):
+                         block_size: int = LANE,
+                         bucket_bytes: Optional[int] = None):
     """One ZeRO-1 step. Call INSIDE ``shard_map`` over ``axes``.
 
     ``params`` arrive replicated, ``opt_state`` leaves carry a leading
@@ -122,14 +156,25 @@ def apply_sharded_update(optimizer,
     conventions: None, a dtype-cast Compressor (fp16/bf16 wire), or a
     quantized Compressor (int8 blocks on both phases). Returns
     ``(new_params, new_opt_state)`` with the same layouts.
+
+    ``bucket_bytes`` (env default ``HOROVOD_BUCKET_BYTES``; 0 = off)
+    switches the exchange to size-bounded buckets in backward-ready order:
+    one reduce-scatter / all-gather pair per (bucket, dtype) group instead
+    of one per dtype, so each bucket's wire time only depends on its own
+    leaves and XLA can overlap it with the rest of backward
+    (:mod:`horovod_tpu.parallel.bucketing`). The optimizer state must then
+    come from ``sharded_opt_init(..., bucket_bytes=...)`` with the SAME
+    bound — the flat-shard geometry is a pure function of it.
     """
     _check_op(op)
     from horovod_tpu.jax.compression import Compression
+    from horovod_tpu.parallel.bucketing import resolve_bucket_bytes
     if compression is Compression.none:
         compression = None
     quantized = bool(getattr(compression, "quantized", False))
     if quantized:
         block_size = getattr(compression, "block_size", block_size)
+    bucket_bytes = resolve_bucket_bytes(bucket_bytes)
 
     n = collectives.axis_size(axes)
     rank = collectives.axis_rank(axes)
@@ -137,11 +182,16 @@ def apply_sharded_update(optimizer,
     p_leaves = jax.tree_util.tree_leaves(params)
     if len(p_leaves) != len(leaves):
         raise ValueError("params/grads trees differ in structure")
-    groups = _group_leaves(leaves, n, block_size)
+    if bucket_bytes > 0:
+        groups = bucket_groups(leaves, n, bucket_bytes, block_size)
+        leaf_align = block_size
+    else:
+        groups = _group_leaves(leaves, n, block_size)
+        leaf_align = 1
 
     g_shards, p_shards = {}, {}
     for group in groups:
-        gflat = _flatten_group(leaves, group)
+        gflat = _flatten_group(leaves, group, leaf_align)
         gflat = collectives._scale(gflat, prescale_factor)
         if quantized:
             shard = collectives.quantized_reducescatter(
@@ -154,7 +204,7 @@ def apply_sharded_update(optimizer,
         else:
             shard = collectives.reducescatter(gflat, op=op, axis=axes)
         g_shards[group.key] = collectives._scale(shard, postscale_factor)
-        pflat = _flatten_group(p_leaves, group)
+        pflat = _flatten_group(p_leaves, group, leaf_align)
         p_shards[group.key] = _local_shard(pflat, rank, group.shard)
 
     local_state = jax.tree_util.tree_map(lambda s: jnp.squeeze(s, 0),
@@ -175,7 +225,8 @@ def apply_sharded_update(optimizer,
             full = compression.decompress(full, ctx)
         else:
             full = lax.all_gather(u, axes, axis=0, tiled=True)
-        for i, leaf in zip(group.indices, _unflatten_group(full, group)):
+        for i, leaf in zip(group.indices,
+                           _unflatten_group(full, group, leaf_align)):
             update_leaves[i] = leaf
     updates_tree = jax.tree_util.tree_unflatten(treedef, update_leaves)
     new_params = optax.apply_updates(params, updates_tree)
@@ -183,13 +234,19 @@ def apply_sharded_update(optimizer,
     return new_params, new_state
 
 
-def _local_init(optimizer, params, axes, block_size):
+def _local_init(optimizer, params, axes, block_size, bucket_bytes=0):
     n = collectives.axis_size(axes)
     rank = collectives.axis_rank(axes)
     leaves = jax.tree_util.tree_leaves(params)
+    if bucket_bytes > 0:
+        groups = bucket_groups(leaves, n, bucket_bytes, block_size)
+        leaf_align = block_size
+    else:
+        groups = _group_leaves(leaves, n, block_size)
+        leaf_align = 1
     p_shards = {}
-    for group in _group_leaves(leaves, n, block_size):
-        pflat = _flatten_group(leaves, group)
+    for group in groups:
+        pflat = _flatten_group(leaves, group, leaf_align)
         p_shards[group.key] = _local_shard(pflat, rank, group.shard)
     state = optimizer.init(p_shards)
     return jax.tree_util.tree_map(lambda s: s[None], state)
@@ -199,17 +256,25 @@ def sharded_opt_init(optimizer,
                      params,
                      mesh: Mesh,
                      axes: Sequence[str] = ("data", "fsdp"),
-                     block_size: int = LANE):
+                     block_size: int = LANE,
+                     bucket_bytes: Optional[int] = None):
     """Initialize the sharded optimizer state on the mesh.
 
     The replicated-path idiom ``dp.replicate(opt.init(params), mesh)``
     materializes N full copies of the state; this builds the ZeRO layout
     instead — every state leaf becomes ``[N, shard]`` sharded over ``axes``
     on dim 0, so each device holds 1/N of the bytes. Feed the result to a
-    ``make_train_step(..., sharded_update=True)`` step."""
+    ``make_train_step(..., sharded_update=True)`` step.
+
+    ``bucket_bytes`` must match the step's bucket bound (both default to
+    ``HOROVOD_BUCKET_BYTES``): the bucketed pipeline lays the state out per
+    (bucket, dtype) group, and the two sides derive the geometry from the
+    same :func:`bucket_groups` plan."""
     axes = tuple(a for a in axes if a in mesh.shape)
+    from horovod_tpu.parallel.bucketing import resolve_bucket_bytes
     local = functools.partial(_local_init, optimizer, axes=axes,
-                              block_size=block_size)
+                              block_size=block_size,
+                              bucket_bytes=resolve_bucket_bytes(bucket_bytes))
     mapped = jax.shard_map(local, mesh=mesh, in_specs=(P(),),
                            out_specs=P(axes), check_vma=False)
     return jax.jit(mapped)(params)

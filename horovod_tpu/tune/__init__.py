@@ -1,0 +1,38 @@
+"""Exposed-comm-driven performance autotuner (the frontend counterpart of
+the engine's Bayesian parameter manager).
+
+PR-7's step attribution decomposes every train step into compute /
+exposed-comm / stall / host and names the gating tensor; this package is
+the layer that finally *acts* on those signals. A
+:class:`~horovod_tpu.tune.tuner.TuningSession` drives a deterministic
+search (:mod:`horovod_tpu.tune.search`) over the knobs that govern the
+gradient-exchange hot path (:mod:`horovod_tpu.tune.space`):
+
+- ``bucket_bytes`` — the backward-overlap bucket bound
+  (:mod:`horovod_tpu.parallel.bucketing`), an in-jit knob applied by
+  staged recompile at tuning-epoch boundaries;
+- ``fusion_threshold_bytes`` / ``cycle_time_ms`` — engine knobs pushed at
+  runtime through ``hvdtpu_set_tuned_params`` (every rank adopts at the
+  same coordination-cycle boundary via the HOROVOD_TUNE parameter-sync
+  broadcast);
+- ``compression`` — per-dtype-class wire format (fp32/bf16/int8), an
+  in-jit knob guarded by a probe-loss accuracy check with rollback;
+- ``low_latency_threshold_bytes`` — the express-lane class boundary for
+  sub-threshold collectives (the serving plane's latency-optimized route,
+  folded into the training search space).
+
+The objective is **exposed-comm seconds** (the critical-path quantity of
+arXiv:1810.11112), not raw step time, so compute noise doesn't pollute
+the search; wall-time mean is the fallback when no engine session exists
+(pure-jit steps hide their collectives from the engine). The converged
+configuration is published to the rendezvous KV, logged, and exported as
+``hvd_tune_*`` gauges that ``hvd-top --tune`` renders live.
+"""
+
+from horovod_tpu.tune.search import CoordinateSearch  # noqa: F401
+from horovod_tpu.tune.space import (  # noqa: F401
+    COMPRESSION_CHOICES,
+    Knob,
+    default_space,
+)
+from horovod_tpu.tune.tuner import TuningSession  # noqa: F401

@@ -1,0 +1,136 @@
+"""Deterministic staged search over a knob space.
+
+Two phases, both derived purely from (space, budget, observed objectives)
+so every rank running the same inputs proposes the same configurations:
+
+- **sweep** — coordinate descent over the knobs in space order: each
+  knob's candidate grid is measured with every other knob pinned at the
+  incumbent, then the knob is fixed at its argmin. One pass covers the
+  space with ``sum(len(grid))`` samples and recovers any single-knob
+  optimum that sits on the grid (the convergence guarantee
+  tests/test_tune.py pins against a synthetic cost model).
+- **refine** — hill climbing from the sweep's incumbent: half-step
+  neighbor moves per knob, round-robin, accepting improvements; stops
+  after a full improvement-free round or when the sample budget runs out.
+
+Bayesian optimization (the engine's bayes_opt.cc) would sample-efficiently
+model a smooth joint surface, but the frontend objective is an epoch
+aggregate with step-level noise and categorical knobs (compression,
+express lane) — a grid sweep with refinement is robust, explainable in a
+CSV trace, and convergence-testable. Lower objective is better (exposed
+-comm seconds).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from horovod_tpu.tune.space import Knob, config_key, default_config
+
+
+class CoordinateSearch:
+    """Propose/observe driver. ``propose()`` returns the next config to
+    measure (or None when converged/budget-exhausted); every proposal must
+    be answered by ``observe(config, objective)`` before the next one.
+    ``ban(name, value)`` removes a candidate (the accuracy guard's
+    rollback) — banned values are never proposed again and the incumbent
+    is evicted if it holds one."""
+
+    def __init__(self, space: Sequence[Knob], budget: int = 24,
+                 grid_points: int = 4):
+        self.space = tuple(space)
+        self.budget = int(budget)
+        self.grid_points = int(grid_points)
+        self.best: Dict[str, object] = default_config(self.space)
+        self.best_objective: Optional[float] = None
+        self.trace: List[dict] = []
+        self.phase = "sweep"
+        self._banned: Set[Tuple[str, object]] = set()
+        self._seen: Dict[Tuple, float] = {}
+        self._pending: Optional[Dict[str, object]] = None
+        self._gen = self._drive()
+
+    # -- public --------------------------------------------------------------
+
+    @property
+    def converged(self) -> bool:
+        return self.phase == "converged"
+
+    @property
+    def samples(self) -> int:
+        return len(self.trace)
+
+    def propose(self) -> Optional[Dict[str, object]]:
+        if self._pending is not None:
+            return dict(self._pending)
+        try:
+            while True:
+                cand = next(self._gen)
+                key = config_key(cand, self.space)
+                if any((k.name, cand[k.name]) in self._banned
+                       for k in self.space):
+                    continue
+                if key in self._seen:
+                    continue  # already measured — spend the budget elsewhere
+                if len(self.trace) >= self.budget:
+                    raise StopIteration
+                self._pending = dict(cand)
+                return dict(cand)
+        except StopIteration:
+            self.phase = "converged"
+            return None
+
+    def observe(self, config: Dict[str, object], objective: float):
+        if self._pending is None or \
+                config_key(config, self.space) != \
+                config_key(self._pending, self.space):
+            raise ValueError("observe() must answer the last propose()")
+        self._pending = None
+        self._seen[config_key(config, self.space)] = objective
+        self.trace.append({"config": dict(config),
+                           "objective": objective, "phase": self.phase})
+        if objective is not None and (
+                self.best_objective is None or
+                objective < self.best_objective):
+            self.best = dict(config)
+            self.best_objective = objective
+
+    def ban(self, name: str, value):
+        """Blacklist a knob value (accuracy-guard rollback). The incumbent
+        falls back to the knob's default if it held the banned value."""
+        self._banned.add((name, value))
+        if self.best.get(name) == value:
+            default = next(k.default for k in self.space if k.name == name)
+            self.best = dict(self.best, **{name: default})
+            # best_objective no longer describes `best`; keep the scores of
+            # configs that don't hold the banned value
+            clean = [t for t in self.trace
+                     if t["config"].get(name) != value and
+                     t["objective"] is not None]
+            self.best_objective = min(
+                (t["objective"] for t in clean), default=None)
+            for t in clean:
+                if t["objective"] == self.best_objective:
+                    self.best = dict(t["config"])
+                    break
+
+    # -- proposal stream -----------------------------------------------------
+
+    def _drive(self):
+        # Phase 1: measure the incumbent (the all-defaults baseline), then
+        # sweep each knob's grid with the others pinned at the incumbent.
+        yield dict(self.best)
+        for knob in self.space:
+            for cand in knob.grid(self.grid_points):
+                yield dict(self.best, **{knob.name: cand})
+        # Phase 2: neighbor refinement until a quiet round.
+        self.phase = "refine"
+        while True:
+            improved_at_entry = self.best_objective
+            for knob in self.space:
+                for cand in knob.neighbors(self.best[knob.name]):
+                    yield dict(self.best, **{knob.name: cand})
+            if self.best_objective is None or \
+                    improved_at_entry is None or \
+                    self.best_objective >= improved_at_entry:
+                return  # quiet round → converged

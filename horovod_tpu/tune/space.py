@@ -1,0 +1,117 @@
+"""The tuner's search space: one :class:`Knob` per hot-path parameter.
+
+Mirrors the engine parameter manager's ranges (parameter_manager.cc tunes
+cycle time and fusion threshold on the same log scales) and adds the
+frontend-owned knobs the engine cannot see: the backward-overlap bucket
+bound, the gradient wire format, and the express-lane class boundary.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+
+# Gradient wire formats the compression knob may select; "int8" is the
+# guarded choice (probe-loss rollback, tuner.py).
+COMPRESSION_CHOICES = ("none", "bf16", "int8")
+
+KIB = 1024
+MIB = 1024 * 1024
+
+
+class Knob(NamedTuple):
+    """One tunable parameter.
+
+    ``kind``: "log_int" / "log_float" span [lo, hi] on a log scale;
+    "choice" enumerates ``choices`` verbatim. ``extra`` prepends special
+    candidates outside the log span (e.g. 0 = feature off). ``guarded``
+    marks choices subject to the accuracy guard (compression)."""
+    name: str
+    kind: str
+    default: object
+    lo: float = 0.0
+    hi: float = 0.0
+    choices: Tuple = ()
+    extra: Tuple = ()
+    guarded: bool = False
+
+    def grid(self, points: int = 4) -> Tuple:
+        """Deterministic candidate list: ``extra`` + a log-spaced grid
+        (log_int snaps to powers of two) or the choices."""
+        if self.kind == "choice":
+            return tuple(self.choices)
+        vals = []
+        for i in range(points):
+            t = i / max(points - 1, 1)
+            v = math.exp(math.log(self.lo) +
+                         t * (math.log(self.hi) - math.log(self.lo)))
+            if self.kind == "log_int":
+                v = 1 << round(math.log2(max(v, 1)))
+                v = int(min(max(v, self.lo), self.hi))
+            vals.append(v)
+        out = list(self.extra)
+        for v in vals:
+            if v not in out:
+                out.append(v)
+        return tuple(out)
+
+    def neighbors(self, value) -> Tuple:
+        """Refinement moves around ``value``: half-step up/down on the log
+        scale (choice knobs refine by trying the other options)."""
+        if self.kind == "choice":
+            return tuple(c for c in self.choices if c != value)
+        if value in self.extra:  # "off" refines by trying the span edges
+            return (self.lo if self.kind == "log_float" else int(self.lo),
+                    self.hi if self.kind == "log_float" else int(self.hi))
+        out = []
+        for factor in (0.5, 2.0):
+            v = value * factor
+            if self.kind == "log_int":
+                v = int(min(max(1 << round(math.log2(max(v, 1))), self.lo),
+                            self.hi))
+            else:
+                v = min(max(v, self.lo), self.hi)
+            if v != value and v not in out:
+                out.append(v)
+        return tuple(out)
+
+
+def default_space(engine_knobs: bool = True,
+                  compression: bool = True) -> Tuple[Knob, ...]:
+    """The standard search space, ordered by expected leverage (the
+    coordinate sweep walks it in order).
+
+    ``engine_knobs=False`` drops the knobs that need a live engine push
+    (pure-jit single-process training tunes only the in-jit knobs);
+    ``compression=False`` drops the guarded wire-format knob (jobs that
+    must keep fp32-exact gradients)."""
+    knobs = [
+        Knob("bucket_bytes", "log_int", 0, lo=256 * KIB, hi=64 * MIB,
+             extra=(0,)),
+    ]
+    if engine_knobs:
+        knobs += [
+            Knob("fusion_threshold_bytes", "log_int", 64 * MIB,
+                 lo=1 * MIB, hi=256 * MIB),
+            Knob("cycle_time_ms", "log_float", 1.0, lo=0.5, hi=50.0),
+            # 0 = express lane off; the nonzero classes route sub-threshold
+            # collectives onto the latency-optimized lane ahead of bulk
+            # fusion (the serving express lane, opened to training).
+            Knob("low_latency_threshold_bytes", "choice", 0,
+                 choices=(0, 1 * KIB, 4 * KIB, 16 * KIB)),
+        ]
+    if compression:
+        knobs.append(Knob("compression", "choice", "none",
+                          choices=COMPRESSION_CHOICES, guarded=True))
+    return tuple(knobs)
+
+
+def default_config(space: Sequence[Knob]) -> Dict[str, object]:
+    return {k.name: k.default for k in space}
+
+
+def config_key(config: Dict[str, object],
+               space: Optional[Sequence[Knob]] = None) -> Tuple:
+    """Hashable identity of a configuration (dedup / blacklist)."""
+    names = [k.name for k in space] if space else sorted(config)
+    return tuple((n, config[n]) for n in names)

@@ -1,0 +1,414 @@
+"""The closed tuning loop: measure → search → apply.
+
+A :class:`TuningSession` is fed one ``on_step(loss)`` call per train step
+(the trainer owns the loop; nothing here blocks). Every
+``HOROVOD_TUNE_EPOCH_STEPS`` steps it closes a *tuning epoch*:
+
+1. **measure** — the epoch's objective is the mean exposed-comm seconds
+   of the step windows the engine's flight ring completed under the
+   epoch's configuration (obs/attribution decomposition — the critical
+   -path quantity, immune to compute noise); wall-time mean is the
+   fallback for engine-less pure-jit processes.
+2. **guard** — if the epoch ran a guarded knob value (int8 compression)
+   and the probe loss degraded more than
+   ``HOROVOD_TUNE_ACCURACY_TOLERANCE`` relative to the last unguarded
+   epoch, the value is banned, the sample is scored +inf, and the search
+   rolls back — accuracy is a constraint, not an objective term.
+3. **search** — the observation lands in the deterministic
+   :class:`~horovod_tpu.tune.search.CoordinateSearch`; the next proposal
+   becomes the new configuration.
+4. **apply** — engine knobs (fusion threshold, cycle time, express-lane
+   class) are pushed through ``hvdtpu_set_tuned_params`` and adopted by
+   every rank at one coordination-cycle boundary; in-jit knobs
+   (bucket_bytes, compression) are returned to the caller, whose job is
+   the *staged recompile*: rebuild the train step with
+   :meth:`TuningSession.step_kwargs` at this epoch boundary. Convergence
+   publishes the winning configuration to the rendezvous KV
+   (``tune_config/<job>``), the CSV log, and the ``hvd_tune_*`` gauges
+   ``hvd-top --tune`` renders.
+
+Multi-process jobs: the decision stream must be identical on every rank.
+The supported deployments are (a) single-controller jax (one process
+drives all devices — the common TPU shape), and (b) driver jobs with a
+rendezvous KV, where rank 0 leads and other ranks follow the epoch
+configs it publishes (``leader=False`` turns a session into a follower).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+from horovod_tpu.common.env_registry import (env_float, env_int, env_str)
+from horovod_tpu.common.hvd_logging import get_logger
+from horovod_tpu.tune.search import CoordinateSearch
+from horovod_tpu.tune.space import Knob, default_space
+
+# Knobs the engine adopts via the runtime push; everything else is in-jit
+# and needs the staged recompile.
+ENGINE_KNOBS = ("fusion_threshold_bytes", "cycle_time_ms",
+                "low_latency_threshold_bytes")
+IN_JIT_KNOBS = ("bucket_bytes", "compression")
+
+PHASES = {"warmup": 0, "sweep": 1, "refine": 2, "converged": 3}
+_COMPRESSION_CODE = {"none": 0, "bf16": 1, "int8": 2}
+
+
+def resolve_compression(name: str):
+    """Map a compression knob value to the dp/zero ``compression=``
+    argument."""
+    from horovod_tpu.jax.compression import Compression
+    return {"none": None, "bf16": Compression.bf16,
+            "int8": Compression.int8}[name]
+
+
+class TuningSession:
+    """See the module docstring. All decision logic is deterministic given
+    the observed objectives; everything runtime-flavored (engine, KV,
+    registry) is injectable for tests."""
+
+    def __init__(self,
+                 engine="auto",
+                 registry=None,
+                 kv=None,
+                 job: Optional[str] = None,
+                 space: Optional[Sequence[Knob]] = None,
+                 epoch_steps: Optional[int] = None,
+                 samples: Optional[int] = None,
+                 warmup_epochs: Optional[int] = None,
+                 accuracy_tolerance: Optional[float] = None,
+                 log_path: Optional[str] = None,
+                 grid_points: int = 4,
+                 leader: bool = True):
+        self._engine_arg = engine
+        if registry is None:
+            from horovod_tpu.metrics.registry import get_registry
+            registry = get_registry()
+        self._registry = registry
+        self._kv = kv
+        self._job = job or env_str("HOROVOD_JOB_NAME")
+        self._epoch_steps = max(2, epoch_steps if epoch_steps is not None
+                                else env_int("HOROVOD_TUNE_EPOCH_STEPS"))
+        self._warmup_left = warmup_epochs if warmup_epochs is not None \
+            else env_int("HOROVOD_TUNE_WARMUP_EPOCHS")
+        self._tol = accuracy_tolerance if accuracy_tolerance is not None \
+            else env_float("HOROVOD_TUNE_ACCURACY_TOLERANCE")
+        self._log_path = log_path if log_path is not None \
+            else (env_str("HOROVOD_TUNE_LOG") or "")
+        self._leader = leader
+        space = tuple(space) if space is not None else default_space()
+        self._space = space
+        self._search = CoordinateSearch(
+            space,
+            budget=samples if samples is not None
+            else env_int("HOROVOD_TUNE_SAMPLES"),
+            grid_points=grid_points)
+        self.config: Dict[str, object] = dict(self._search.best)
+        self.converged = False
+        self.epoch = 0
+        self._step_in_epoch = 0
+        self._step_times: List[float] = []
+        self._losses: List[float] = []
+        self._baseline_loss: Optional[float] = None
+        self._epoch_first_window_step: Optional[int] = None
+        self._log = get_logger("tune")
+        self._log_file = None
+        self._gauges = {}
+        self._c_samples = registry.counter(
+            "hvd_tune_samples_total",
+            help="tuning epochs measured by the frontend tuner")
+        self._export(None)
+
+    # -- wiring --------------------------------------------------------------
+
+    def _engine(self):
+        if self._engine_arg != "auto":
+            return self._engine_arg
+        try:
+            from horovod_tpu.common import basics
+            return basics._context().engine
+        except Exception:  # noqa: BLE001 — engine-less process
+            return None
+
+    def step_kwargs(self, config: Optional[Dict[str, object]] = None) -> dict:
+        """The ``make_train_step`` keyword subset for a configuration —
+        what the staged recompile passes through."""
+        cfg = config if config is not None else self.config
+        out = {}
+        if "bucket_bytes" in cfg:
+            out["bucket_bytes"] = int(cfg["bucket_bytes"])
+        if "compression" in cfg:
+            out["compression"] = resolve_compression(str(cfg["compression"]))
+        return out
+
+    # -- the per-step hook ---------------------------------------------------
+
+    def on_step(self, loss: Optional[float] = None) -> Optional[dict]:
+        """Feed one completed train step. Returns the NEW configuration
+        dict when the in-jit knobs changed (the caller must rebuild the
+        step via :meth:`step_kwargs` — the staged recompile), else None.
+        Engine knobs are pushed internally."""
+        if self.converged:
+            return None
+        self._step_times.append(time.perf_counter())
+        if loss is not None:
+            self._losses.append(float(loss))
+        self._step_in_epoch += 1
+        if self._step_in_epoch < self._epoch_steps:
+            return None
+        return self._end_epoch()
+
+    # -- epoch machinery -----------------------------------------------------
+
+    def _end_epoch(self) -> Optional[dict]:
+        objective, source = self._measure()
+        probe_loss = self._probe_loss()
+        self.epoch += 1
+        old = dict(self.config)
+        if self._warmup_left > 0:
+            # warmup epochs run the incumbent and discard the measurement
+            # (compile + cache effects); the search hasn't started yet
+            self._warmup_left -= 1
+            self._reset_epoch()
+            self._export(None, phase="warmup")
+            return None
+        if not self._leader:
+            return self._follow(old)
+        banned = self._guard(probe_loss)
+        if self._search._pending is None:
+            # epoch 0 after warmup: the search hasn't proposed yet — pull
+            # its first proposal (the incumbent) so observe() pairs up
+            first = self._search.propose()
+            if first is not None:
+                self.config = first
+        self._c_samples.inc()
+        self._search.observe(self.config,
+                             float("inf") if banned else objective)
+        self._log_sample(objective, source, banned)
+        nxt = self._search.propose()
+        if nxt is None:
+            return self._converge(old)
+        self.config = nxt
+        self._apply_engine_knobs()
+        self._publish_epoch()
+        self._reset_epoch()
+        self._export(objective)
+        return self.config if self._in_jit_changed(old) else None
+
+    def _measure(self):
+        """(objective_seconds, source): mean exposed-comm seconds of the
+        epoch's completed flight-ring step windows, falling back to the
+        epoch's wall-time step mean."""
+        wall = None
+        if len(self._step_times) >= 2:
+            diffs = [b - a for a, b in zip(self._step_times,
+                                           self._step_times[1:])]
+            if len(diffs) > 1:
+                # drop the first inter-step gap — it carries the recompile
+                diffs = diffs[1:]
+            # a 2-step epoch keeps its single (recompile-tainted) diff:
+            # a biased sample still beats scoring every epoch +inf
+            wall = sum(diffs) / len(diffs)
+        engine = self._engine()
+        if engine is not None:
+            try:
+                from horovod_tpu.obs import attribution
+                dump = engine.flight_dump()
+                if dump:
+                    windows = attribution.decompose_rank(dump)
+                    # the ring holds history: score only the most recent
+                    # windows, which ran under this epoch's configuration
+                    # (minus the first — the transition step)
+                    take = max(1, (self._epoch_steps - 1) // 2)
+                    tail = windows[-take:]
+                    if tail:
+                        exposed = sum(w["exposed_comm_s"] for w in tail) \
+                            / len(tail)
+                        return exposed, "exposed_comm"
+            except Exception as e:  # noqa: BLE001 — telemetry, not control
+                self._log.warning("tune measure fell back to wall time: %r",
+                                  e)
+        return (wall if wall is not None else float("inf")), "wall_time"
+
+    def _probe_loss(self) -> Optional[float]:
+        if not self._losses:
+            return None
+        tail = self._losses[len(self._losses) // 2:]
+        return sum(tail) / len(tail)
+
+    def _guard(self, probe_loss: Optional[float]) -> bool:
+        """Accuracy guard: a guarded knob value whose epoch degraded the
+        probe loss beyond tolerance is banned (rollback). Returns True
+        when the current sample must be scored +inf."""
+        guarded = [k for k in self._space if k.guarded]
+        if not guarded or probe_loss is None:
+            return False
+        knob = guarded[0]
+        value = self.config.get(knob.name, knob.default)
+        if value == knob.default:
+            self._baseline_loss = probe_loss
+            return False
+        if self._baseline_loss is None:
+            return False
+        if probe_loss > self._baseline_loss * (1.0 + self._tol):
+            self._search.ban(knob.name, value)
+            self._log.warning(
+                "tune accuracy guard: %s=%r degraded probe loss %.6f -> "
+                "%.6f (> %.1f%% tolerance) — rolled back and banned",
+                knob.name, value, self._baseline_loss, probe_loss,
+                100.0 * self._tol)
+            return True
+        return False
+
+    def _converge(self, old) -> Optional[dict]:
+        self.converged = True
+        self.config = dict(self._search.best)
+        self._apply_engine_knobs()
+        best = self._search.best_objective
+        record = {
+            "config": dict(self.config),
+            # json would render inf as the non-standard `Infinity`; a
+            # never-measured objective publishes as null instead
+            "objective_seconds": best if best is not None and
+            best != float("inf") else None,
+            "samples": self._search.samples,
+            "epochs": self.epoch,
+        }
+        self._log.info("tune converged: %s", json.dumps(record))
+        if self._kv is not None:
+            try:
+                self._kv.put_json(f"tune_config/{self._job}", record)
+                self._kv.put_json(
+                    f"tune_epoch/{self._job}/{self.epoch}",
+                    {"config": dict(self.config), "converged": True})
+            except Exception as e:  # noqa: BLE001 — KV outage ≠ job failure
+                self._log.warning("tune KV publish failed: %r", e)
+        if self._log_file is not None:
+            self._log_file.write("# converged\n")
+            self._log_file.flush()
+        self._reset_epoch()
+        self._export(self._search.best_objective, phase="converged")
+        return self.config if self._in_jit_changed(old) else None
+
+    def _follow(self, old) -> Optional[dict]:
+        """Follower rank: adopt the epoch config the leader published.
+        Engine knobs arrive via the engine broadcast on their own; only
+        the in-jit subset matters here."""
+        self._reset_epoch()
+        if self._kv is None:
+            return None
+        try:
+            rec = self._kv.get_json(
+                f"tune_epoch/{self._job}/{self.epoch}", timeout=5.0)
+        except Exception:  # noqa: BLE001 — keep training on KV outage
+            rec = None
+        if not rec:
+            return None
+        self.config = dict(rec.get("config", self.config))
+        self.converged = bool(rec.get("converged", False))
+        self._export(None)
+        return self.config if self._in_jit_changed(old) else None
+
+    def _publish_epoch(self):
+        if self._kv is None or not self._leader:
+            return
+        try:
+            self._kv.put_json(f"tune_epoch/{self._job}/{self.epoch}",
+                              {"config": dict(self.config),
+                               "converged": False})
+        except Exception as e:  # noqa: BLE001
+            self._log.warning("tune KV publish failed: %r", e)
+
+    def _reset_epoch(self):
+        self._step_in_epoch = 0
+        self._step_times = []
+        self._losses = []
+
+    def _in_jit_changed(self, old) -> bool:
+        return any(self.config.get(k) != old.get(k) for k in IN_JIT_KNOBS)
+
+    def _apply_engine_knobs(self):
+        engine = self._engine()
+        if engine is None:
+            return
+        kwargs = {}
+        if "cycle_time_ms" in self.config:
+            kwargs["cycle_time_ms"] = float(self.config["cycle_time_ms"])
+        if "fusion_threshold_bytes" in self.config:
+            kwargs["fusion_threshold_bytes"] = int(
+                self.config["fusion_threshold_bytes"])
+        if "low_latency_threshold_bytes" in self.config:
+            lane = int(self.config["low_latency_threshold_bytes"])
+            kwargs["low_latency_threshold_bytes"] = lane if lane > 0 else 0
+            kwargs["express_lane"] = lane > 0
+        if not kwargs:
+            return
+        try:
+            engine.set_tuned_params(**kwargs)
+        except Exception as e:  # noqa: BLE001 — a refused push must not
+            self._log.warning("tune engine push failed: %r", e)  # kill train
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _log_sample(self, objective, source, banned):
+        if not self._log_path:
+            return
+        if self._log_file is None:
+            self._log_file = open(self._log_path, "w")
+            self._log_file.write(
+                "objective_seconds,source,bucket_bytes,"
+                "fusion_threshold_bytes,cycle_time_ms,"
+                "low_latency_threshold_bytes,compression,phase,banned\n")
+        c = self.config
+        self._log_file.write(
+            f"{objective:.9f},{source},{c.get('bucket_bytes', '')},"
+            f"{c.get('fusion_threshold_bytes', '')},"
+            f"{c.get('cycle_time_ms', '')},"
+            f"{c.get('low_latency_threshold_bytes', '')},"
+            f"{c.get('compression', '')},{self._search.phase},"
+            f"{int(banned)}\n")
+        self._log_file.flush()
+
+    def _gauge(self, name, help_):
+        if name not in self._gauges:
+            self._gauges[name] = self._registry.gauge(name, help=help_)
+        return self._gauges[name]
+
+    def _export(self, objective, phase: Optional[str] = None):
+        c = self.config
+        phase = phase or ("converged" if self.converged
+                          else self._search.phase)
+        g = self._gauge
+        g("hvd_tune_phase",
+          "tuner phase (0 warmup / 1 sweep / 2 refine / 3 converged)"
+          ).set(PHASES.get(phase, 0))
+        if "bucket_bytes" in c:
+            g("hvd_tune_bucket_bytes",
+              "current gradient bucket bound (HOROVOD_BUCKET_BYTES knob)"
+              ).set(float(c["bucket_bytes"]))
+        if "fusion_threshold_bytes" in c:
+            g("hvd_tune_fusion_threshold_bytes",
+              "current engine fusion threshold pushed by the tuner").set(
+                  float(c["fusion_threshold_bytes"]))
+        if "cycle_time_ms" in c:
+            g("hvd_tune_cycle_time_ms",
+              "current engine cycle time pushed by the tuner").set(
+                  float(c["cycle_time_ms"]))
+        if "low_latency_threshold_bytes" in c:
+            g("hvd_tune_low_latency_threshold_bytes",
+              "express-lane class boundary (0 = lane off)").set(
+                  float(c["low_latency_threshold_bytes"]))
+        if "compression" in c:
+            g("hvd_tune_compression",
+              "gradient wire format (0 none / 1 bf16 / 2 int8)").set(
+                  float(_COMPRESSION_CODE.get(str(c["compression"]), 0)))
+        if objective is not None and objective != float("inf"):
+            g("hvd_tune_objective_seconds",
+              "last measured tuning objective (exposed-comm seconds)"
+              ).set(float(objective))
+        if self._search.best_objective is not None and \
+                self._search.best_objective != float("inf"):
+            g("hvd_tune_best_objective_seconds",
+              "best objective observed so far").set(
+                  float(self._search.best_objective))

@@ -7,6 +7,7 @@ optim/bayesian_optimization.cc, enabled via HOROVOD_AUTOTUNE
 """
 
 import threading
+import time
 import uuid
 
 import numpy as np
@@ -94,6 +95,79 @@ def test_autotune_converges_and_stays_correct(autotune_ring):
         assert 0.5 <= float(cycle_ms) <= 50.0
         assert (1 << 20) <= int(fusion) <= (256 << 20)
         assert cache in ("0", "1")
+
+
+def test_tuned_params_push_propagates(monkeypatch):
+    """Frontend-tuner engine hook (ABI 9): a rank-0 push rides the
+    parameter-sync broadcast (HOROVOD_TUNE=1) to every rank at a cycle
+    boundary, numerics stay exact, and the express lane + low-latency
+    threshold land alongside fusion/cycle knobs."""
+    monkeypatch.setenv("HOROVOD_TUNE", "1")
+    monkeypatch.delenv("HOROVOD_AUTOTUNE", raising=False)
+    group = f"tune-push-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=N, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(N)]
+    executors = [EagerExecutor(s) for s in sessions]
+    try:
+        before = sessions[0].tuned_params()
+        assert before["fusion_threshold_bytes"] == 64 << 20
+        assert before["express_lane"] == 0
+        sessions[0].set_tuned_params(cycle_time_ms=0.5,
+                                     fusion_threshold_bytes=2 << 20,
+                                     low_latency_threshold_bytes=2048,
+                                     express_lane=True)
+
+        def fn(r, ex):
+            for i in range(6):
+                x = np.full((256,), float(r + i), np.float32)
+                h = ex.submit(f"p{i}", _OP_ALLREDUCE, x, reduce_op=Sum)
+                ex.session.wait(h, timeout=15.0)
+                out = ex.take_result(f"p{i}")
+                np.testing.assert_allclose(
+                    out, np.full((256,), sum(rr + i for rr in range(N)),
+                                 np.float32))
+            return True
+
+        assert all(run_all(executors, fn))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            snaps = [s.tuned_params() for s in sessions]
+            if all(sn["fusion_threshold_bytes"] == 2 << 20 and
+                   sn["express_lane"] == 1 and
+                   sn["low_latency_threshold_bytes"] == 2048 and
+                   abs(sn["cycle_time_ms"] - 0.5) < 1e-9
+                   for sn in snaps):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(f"push never propagated: {snaps}")
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+
+
+def test_tuned_params_push_refused_without_sync(monkeypatch):
+    """A multi-rank session without HOROVOD_TUNE/HOROVOD_AUTOTUNE has no
+    broadcast channel — the push must refuse loudly, not silently diverge
+    one rank's fusion partition."""
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    monkeypatch.delenv("HOROVOD_TUNE", raising=False)
+    monkeypatch.delenv("HOROVOD_AUTOTUNE", raising=False)
+    group = f"tune-refuse-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=N, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(N)]
+    try:
+        with pytest.raises(HorovodInternalError, match="HOROVOD_TUNE"):
+            sessions[0].set_tuned_params(fusion_threshold_bytes=1 << 20)
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
 
 
 def test_autotune_off_no_log(tmp_path, monkeypatch):

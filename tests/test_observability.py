@@ -89,13 +89,13 @@ def test_stall_report_empty_before_any_warning():
 # ABI guard
 
 
-def test_abi_version_is_8():
-    # 7 → 8: hvdtpu_step_begin/hvdtpu_step_end (frontend step-boundary
-    # marks for step-time attribution); DONE flight events carry the
-    # response's exec-callback span in aux
+def test_abi_version_is_9():
+    # 8 → 9: hvdtpu_set_tuned_params / hvdtpu_get_tuned_params (runtime
+    # engine-knob push through the parameter-sync broadcast); TunedParams
+    # wire record gains low_latency_threshold_bytes + express_lane
     lib = bindings.load_library()
-    assert bindings.ABI_VERSION == 8
-    assert lib.hvdtpu_abi_version() == 8
+    assert bindings.ABI_VERSION == 9
+    assert lib.hvdtpu_abi_version() == 9
 
 
 def test_stale_library_refused(monkeypatch):

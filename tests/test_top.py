@@ -262,3 +262,87 @@ def test_cli_subprocess_once_smoke(cluster):
     assert "RANK" in proc.stdout
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert any(ln.split()[0] == "0" for ln in lines[2:])
+
+
+# ---------------------------------------------------------------------------
+# tune view (--tune): the frontend autotuner's hvd_tune_* gauges
+
+
+def _tune_registry(phase=2, bucket=2 << 20, best=0.0012):
+    reg = MetricsRegistry()
+    reg.gauge("hvd_tune_phase").set(phase)
+    reg.gauge("hvd_tune_bucket_bytes").set(bucket)
+    reg.gauge("hvd_tune_fusion_threshold_bytes").set(32 << 20)
+    reg.gauge("hvd_tune_cycle_time_ms").set(0.75)
+    reg.gauge("hvd_tune_low_latency_threshold_bytes").set(4096)
+    reg.gauge("hvd_tune_compression").set(1)  # bf16
+    reg.gauge("hvd_tune_objective_seconds").set(0.0021)
+    reg.gauge("hvd_tune_best_objective_seconds").set(best)
+    reg.counter("hvd_tune_samples_total").inc(9)
+    return reg
+
+
+@pytest.fixture
+def tune_cluster():
+    regs = [_tune_registry(phase=2), _tune_registry(phase=3, bucket=0)]
+    exporters = [MetricsExporter(regs[r], port=0,
+                                 labels={"rank": str(r)}).start()
+                 for r in range(2)]
+    yield regs, exporters
+    for e in exporters:
+        e.stop()
+
+
+def test_tune_row_extraction(tune_cluster):
+    regs, exporters = tune_cluster
+    target = {"addr": "127.0.0.1", "port": exporters[0].port}
+    snap = top.scrape_target(target)
+    assert snap is not None
+    row = top.tune_row_from_snapshot(target, snap)
+    assert row["rank"] == "0"
+    assert row["bucket_bytes"] == 2 << 20
+    assert row["fusion_mb"] == pytest.approx(32.0)
+    assert row["cycle_ms"] == pytest.approx(0.75)
+    assert row["lane_bytes"] == 4096
+    assert row["compression"] == "bf16"
+    assert row["phase"] == "refine"
+    assert row["objective_ms"] == pytest.approx(2.1)
+    assert row["best_ms"] == pytest.approx(1.2)
+    assert row["samples"] == 9
+
+
+def test_tune_render_columns(tune_cluster):
+    regs, exporters = tune_cluster
+    state = top.TopState([{"addr": "127.0.0.1", "port": e.port}
+                          for e in exporters], tune=True)
+    rows, unreachable = state.refresh(window=False)
+    assert unreachable == 0 and len(rows) == 2
+    text = state.render(rows, unreachable, "tune-title")
+    assert "tune-title" in text.splitlines()[0]
+    for col in top.TUNE_COLUMNS:
+        assert col in text.splitlines()[1]
+    body = text.splitlines()[2:]
+    # rank 0 mid-refine with a 2M bucket; rank 1 converged, bucket off
+    assert body[0].split()[0] == "0" and "2M" in body[0]
+    assert "refine" in body[0]
+    assert "converged" in body[1] and "off" in body[1]
+
+
+def test_cli_tune_once_smoke(tune_cluster):
+    """`hvd-top --tune --once` end to end in a clean interpreter — the
+    tune-view CI surface."""
+    regs, exporters = tune_cluster
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.obs.top", "--tune",
+         "--once", "--targets", _targets_arg(exporters)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "BUCKET" in proc.stdout and "PHASE" in proc.stdout
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert any(ln.split()[0] == "0" for ln in lines[2:])
+
+
+def test_cli_serving_and_tune_exclusive():
+    rc = top.main(["--serving", "--tune", "--once",
+                   "--targets", "127.0.0.1:1"])
+    assert rc == 2
