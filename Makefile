@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all lint lock-graph engine top tune-smoke tsan asan ubsan sanitizers test test-fast soak clean
+.PHONY: all lint lock-graph check-protocols conformance engine top tune-smoke tsan asan ubsan sanitizers test test-fast soak clean
 
 all: engine
 
@@ -18,6 +18,26 @@ lint:
 lock-graph:
 	$(PYTHON) -m horovod_tpu.lint --rules HVL102 \
 	    --lock-graph horovod_tpu/engine/build/lock_order.dot
+
+# Explicit-state model checking of the control-plane protocols
+# (hvd-check): exhaustive exploration of the coordination-cycle /
+# epoch-fencing / drain-handoff / TunedParams specs at the CI depth
+# bound, with crash/partition faults injected at every step. Zero
+# invariant violations is a tier-1 gate (tests/test_verify.py runs the
+# same exploration).
+check-protocols:
+	$(PYTHON) -m horovod_tpu.verify
+
+# Replay the latest chaos-soak artifacts (KV WAL + flight dumps) against
+# the protocol specs. `make soak` exports its artifacts to
+# SOAK_ARTIFACTS via HOROVOD_SOAK_ARTIFACT_DIR; any directory holding a
+# wal.log / flight_rank*.json works.
+SOAK_ARTIFACTS ?= /tmp/hvdtpu_soak_artifacts
+conformance:
+	@test -e $(SOAK_ARTIFACTS) || { \
+	    echo "no soak artifacts at $(SOAK_ARTIFACTS) — run 'make soak'" \
+	         "first or pass SOAK_ARTIFACTS=<dir>"; exit 2; }
+	$(PYTHON) -m horovod_tpu.verify --conformance $(SOAK_ARTIFACTS)
 
 engine:
 	$(MAKE) -C horovod_tpu/engine
@@ -64,7 +84,9 @@ test:
 # acceptances) under a hard wall-clock budget. SOAK_BUDGET is seconds.
 SOAK_BUDGET ?= 900
 soak:
-	timeout -k 10 $(SOAK_BUDGET) env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	timeout -k 10 $(SOAK_BUDGET) env JAX_PLATFORMS=cpu \
+	    HOROVOD_SOAK_ARTIFACT_DIR=$(SOAK_ARTIFACTS) \
+	    $(PYTHON) -m pytest \
 	    tests/test_chaos_soak.py tests/test_elastic_recovery.py \
 	    tests/test_control_plane.py \
 	    -q -m slow

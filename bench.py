@@ -922,6 +922,7 @@ def _control_plane_bench():
     """
     import tempfile
     import threading
+    from horovod_tpu.common import kv_keys
     from horovod_tpu.runner.http_kv import KVClient, KVServer
 
     out = {}
@@ -934,18 +935,26 @@ def _control_plane_bench():
         for gen in range(4):
             for rank in range(64):
                 kv.put_json(
-                    f"rank_and_size/g{gen}/host{rank // 8}/{rank % 8}",
+                    kv_keys.rank_and_size(gen, f"host{rank // 8}",
+                                          rank % 8),
                     {"rank": rank, "size": 64, "controller_addr": "h0",
                      "controller_port": 4242,
-                     "controller_data_port": 4243, "epoch": 1})
-                kv.put_json(f"worker_state/g{gen}/host{rank // 8}/"
-                            f"{rank % 8}",
+                     "controller_data_port": 4243, "epoch": 1},
+                    epoch=epoch_before)
+                # worker-shaped records: epoch-less by design (workers
+                # never claim driver authority)
+                # hvd-lint: disable=HVL008
+                kv.put_json(kv_keys.worker_state(gen, f"host{rank // 8}",
+                                                 rank % 8),
                             {"state": "READY", "ts": time.time()})
-                kv.put_json(f"worker_heartbeat/host{rank // 8}/"
-                            f"{rank % 8}",
+                # hvd-lint: disable=HVL008
+                kv.put_json(kv_keys.worker_heartbeat(f"host{rank // 8}",
+                                                     rank % 8),
                             {"pid": 1000 + rank, "rank": rank,
                              "ts": time.time()})
-            kv.put_json("generation", {"generation": gen, "epoch": 1})
+            kv.put_json(kv_keys.generation(),
+                        {"generation": gen, "epoch": 1},
+                        epoch=epoch_before)
         wal_bytes = kv.wal_bytes
         n_keys = len(kv.keys())
         port = kv.port
@@ -957,7 +966,8 @@ def _control_plane_bench():
             client = KVClient("127.0.0.1", port)
             while not stop.is_set():
                 try:
-                    client.put_json("worker_heartbeat/bench/0",
+                    # hvd-lint: disable=HVL008 — worker-shaped beat
+                    client.put_json(kv_keys.worker_heartbeat("bench", 0),
                                     {"pid": 1, "ts": time.time()},
                                     timeout=0.5, attempts=1, deadline=0.5)
                     acks.append(time.monotonic())

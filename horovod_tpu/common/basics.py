@@ -232,8 +232,8 @@ def _negotiate_subset_ports(members, is_leader: bool):
     # per-init round counter (incremented by the caller; all members call
     # init in lockstep), so a second init(comm=...) in the same processes
     # can't read the previous round's — now closed — ports
-    key = ("subset_ports/" + "-".join(str(m) for m in members) +
-           f"/r{_subset_round}")
+    from horovod_tpu.common import kv_keys
+    key = kv_keys.subset_ports(members, _subset_round)
     if is_leader:
         from horovod_tpu.runner.launch import free_ports
         ports = tuple(free_ports(2))

@@ -108,6 +108,9 @@ _decl("HOROVOD_WORKER_HEARTBEAT_TIMEOUT_SECONDS", "float", 10.0,
 _decl("HOROVOD_HEADLESS_DEADLINE_SECONDS", "float", 1800.0,
       "how long a worker keeps training through a driver/KV outage "
       "(headless mode) before aborting (<=0 = never abort)")
+_decl("HOROVOD_SOAK_ARTIFACT_DIR", "str", None,
+      "chaos-soak runs copy their KV WAL + flight artifacts here so "
+      "`make conformance` can replay the latest soak (hvd-check)")
 
 # -- engine tuning knobs (EngineOptions, common.h) --
 _decl("HOROVOD_CYCLE_TIME", "float", 1.0,

@@ -1,0 +1,299 @@
+"""Typed registry of every rendezvous-KV key family.
+
+The env-registry pattern (``common/env_registry.py``) applied to the KV
+namespace: each key family the control plane uses is declared once with
+its pattern, writer role, and whether driver-originated writes of it must
+claim the control epoch. Three consumers:
+
+- **typed builders** (``drain()``, ``rank_and_size()``, ``go()``, ...) —
+  the only sanctioned way Python code constructs a KV key. A typo'd
+  prefix cannot silently create an orphan namespace, and every protocol
+  spec in ``horovod_tpu/verify`` imports the same prefixes the runtime
+  uses.
+- **lint rule HVL007** — flags raw string construction of registered key
+  prefixes outside this module; HVL008 flags driver-originated KV writes
+  that skip the epoch claim.
+- **conformance checking** — ``horovod_tpu/verify/conformance.py``
+  replays KV write-ahead logs and uses :func:`match` to classify every
+  recorded mutation; a key no family matches is a divergence.
+
+Writer roles: ``driver`` writes claim the control epoch (the KV fences
+strictly-older claimants — see ``runner/http_kv.py``); ``worker`` /
+``serve-worker`` / ``tuner`` / ``task`` writes are deliberately
+epoch-less (workers never claim driver authority).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Dict, Optional, Tuple
+
+_VAR_RE = re.compile(r"<([a-z_]+)>")
+
+
+@lru_cache(maxsize=None)
+def _compiled(pattern: str) -> re.Pattern:
+    """One compiled matcher per family pattern — conformance replay
+    calls match() per WAL op, so the build must not repeat."""
+    parts = []
+    pos = 0
+    for m in _VAR_RE.finditer(pattern):
+        parts.append(re.escape(pattern[pos:m.start()]))
+        parts.append(f"(?P<{m.group(1)}>[^/]+)")
+        pos = m.end()
+    parts.append(re.escape(pattern[pos:]))
+    return re.compile("^" + "".join(parts) + "$")
+
+
+@dataclass(frozen=True)
+class KVKeyFamily:
+    name: str           # family id, e.g. "drain"
+    pattern: str        # doc pattern, e.g. "drain/<host>/<slot>"
+    writer: str         # "driver" | "worker" | "serve-worker" | "tuner" | "task"
+    epoch_claimed: bool  # driver-originated: writes must claim the epoch
+    doc: str
+
+    @property
+    def prefix(self) -> str:
+        """Literal text up to the first variable segment (what HVL007
+        scans for; '' only for exact singleton keys)."""
+        m = _VAR_RE.search(self.pattern)
+        return self.pattern if m is None else self.pattern[:m.start()]
+
+    @property
+    def exact(self) -> bool:
+        """True for singleton keys (the pattern has no variables)."""
+        return _VAR_RE.search(self.pattern) is None
+
+    @property
+    def regex(self) -> re.Pattern:
+        return _compiled(self.pattern)
+
+
+FAMILIES: Dict[str, KVKeyFamily] = {}
+
+
+def _decl(name: str, pattern: str, writer: str, epoch_claimed: bool,
+          doc: str):
+    assert name not in FAMILIES, name
+    FAMILIES[name] = KVKeyFamily(name, pattern, writer, epoch_claimed, doc)
+
+
+# -- elastic rendezvous (driver-published, epoch-claimed) -------------------
+_decl("generation", "generation", "driver", True,
+      "the driver's current topology generation")
+_decl("control_epoch", "control_epoch", "driver", True,
+      "the acting driver's control epoch (worker fencing floor)")
+_decl("notify", "notify", "driver", True,
+      "push notification that a newer generation exists")
+_decl("go", "go/g<gen>", "driver", True,
+      "go-barrier release for one generation (all slots READY)")
+_decl("rank_and_size", "rank_and_size/g<gen>/<host>/<local_rank>", "driver",
+      True, "per-slot topology record for one generation")
+_decl("metrics_targets", "metrics_targets", "driver", True,
+      "aggregated worker /metrics endpoints (hvd-top discovery)")
+_decl("serve_targets", "serve_targets", "driver", True,
+      "aggregated serving endpoints (router discovery)")
+_decl("straggler", "straggler/g<gen>/<rank>", "driver", True,
+      "driver-relayed straggler event for one rank")
+_decl("anomaly", "anomaly/g<gen>/<rank>", "driver", True,
+      "driver-relayed step-time anomaly event for one rank")
+
+# -- worker-originated records (deliberately epoch-less) --------------------
+_decl("worker_state", "worker_state/g<gen>/<host>/<local_rank>", "worker",
+      False, "READY/SUCCESS/FAILURE/DRAINED registry record")
+_decl("worker_heartbeat", "worker_heartbeat/<host>/<slot>", "worker", False,
+      "worker liveness heartbeat (driver-recovery adoption)")
+_decl("drain", "drain/<host>/<slot>", "worker", False,
+      "preemption-notice drain announcement")
+_decl("shard_handoff", "shard_handoff/w<world>/<old_rank>", "worker", False,
+      "departing rank's live ZeRO shard payload (world-scoped)")
+_decl("reset_request", "reset_request/g<gen>", "worker", False,
+      "worker request for a fresh rendezvous round past a dead generation")
+_decl("metrics_addr", "metrics_addr/<host>/<local_rank>", "worker", False,
+      "worker /metrics endpoint publication (driver aggregates)")
+
+# -- serving plane ----------------------------------------------------------
+_decl("serve_addr", "serve_addr/<host>/<local_rank>", "serve-worker", False,
+      "serving worker endpoint publication (driver aggregates)")
+_decl("serve_stop", "serve_stop", "serve-worker", False,
+      "cooperative stop signal polled by serving workers")
+
+# -- autotuner parameter sync ----------------------------------------------
+_decl("tune_config", "tune_config/<job>", "tuner", False,
+      "converged tuner config for a job (follower adoption)")
+_decl("tune_epoch", "tune_epoch/<job>/<epoch>", "tuner", False,
+      "per-epoch tuner config broadcast (cycle-fenced adoption)")
+
+# -- task execution (runner.run_task / cluster jobs) ------------------------
+_decl("task_fn", "task_fn", "task", False,
+      "pickled task function for shared-nothing run_task workers")
+_decl("task_started", "task_started/<rank>", "task", False,
+      "per-rank task-start acknowledgement")
+_decl("task_result", "task_result/g<gen>/<rank>", "task", False,
+      "per-rank pickled task result for one generation")
+_decl("cluster_controller", "cluster/<job>/r<round>/controller", "task",
+      False, "dynamically negotiated controller endpoint for a cluster job")
+_decl("subset_ports", "subset_ports/<members>/r<round>", "task", False,
+      "leader-allocated ports for a process-subset communicator")
+_decl("soak_event", "soak/ev<n>", "task", False,
+      "chaos-soak event marker (tests/chaos.py control-plane sidecar)")
+
+
+# -- typed builders ---------------------------------------------------------
+# One function per family; prefix helpers mirror the driver's GC scans.
+
+def generation() -> str:
+    return "generation"
+
+
+def control_epoch() -> str:
+    return "control_epoch"
+
+
+def notify() -> str:
+    return "notify"
+
+
+def go(gen: int) -> str:
+    return f"go/g{int(gen)}"
+
+
+def rank_and_size(gen: int, host, local_rank) -> str:
+    return f"rank_and_size/g{int(gen)}/{host}/{local_rank}"
+
+
+def rank_and_size_prefix(gen: int) -> str:
+    # trailing "/" so g1 can't swallow g10's keys
+    return f"rank_and_size/g{int(gen)}/"
+
+
+def worker_state(gen: int, host, local_rank) -> str:
+    return f"worker_state/g{int(gen)}/{host}/{local_rank}"
+
+
+def worker_state_prefix(gen: int) -> str:
+    return f"worker_state/g{int(gen)}/"
+
+
+def worker_heartbeat(host, slot) -> str:
+    return f"worker_heartbeat/{host}/{slot}"
+
+
+def drain(host, slot) -> str:
+    return f"drain/{host}/{slot}"
+
+
+def shard_handoff(world: int, old_rank: int) -> str:
+    return f"shard_handoff/w{int(world)}/{int(old_rank)}"
+
+
+def reset_request(gen: int) -> str:
+    return f"reset_request/g{int(gen)}"
+
+
+def straggler(gen: int, rank) -> str:
+    return f"straggler/g{int(gen)}/{rank}"
+
+
+def straggler_prefix(gen: int) -> str:
+    return f"straggler/g{int(gen)}/"
+
+
+def anomaly(gen: int, rank) -> str:
+    return f"anomaly/g{int(gen)}/{rank}"
+
+
+def anomaly_prefix(gen: int) -> str:
+    return f"anomaly/g{int(gen)}/"
+
+
+def metrics_targets() -> str:
+    return "metrics_targets"
+
+
+def serve_targets() -> str:
+    return "serve_targets"
+
+
+def serve_addr(host, local_rank) -> str:
+    return f"serve_addr/{host}/{local_rank}"
+
+
+def serve_stop() -> str:
+    return "serve_stop"
+
+
+def metrics_addr(host, local_rank) -> str:
+    return f"metrics_addr/{host}/{local_rank}"
+
+
+def tune_config(job: str) -> str:
+    return f"tune_config/{job}"
+
+
+def tune_epoch(job: str, epoch: int) -> str:
+    return f"tune_epoch/{job}/{int(epoch)}"
+
+
+def task_fn() -> str:
+    return "task_fn"
+
+
+def task_started(rank) -> str:
+    return f"task_started/{rank}"
+
+
+def task_result(gen: int, rank) -> str:
+    return f"task_result/g{int(gen)}/{rank}"
+
+
+def cluster_controller(job: str, round) -> str:
+    return f"cluster/{job}/r{round}/controller"
+
+
+def subset_ports(members, round) -> str:
+    return ("subset_ports/" + "-".join(str(m) for m in members) +
+            f"/r{round}")
+
+
+# -- classification ---------------------------------------------------------
+
+def match(key: str) -> Optional[Tuple[str, Dict[str, str]]]:
+    """Classify a concrete key: ``(family_name, captured_args)`` or None
+    when no registered family matches (a conformance divergence)."""
+    for fam in FAMILIES.values():
+        m = fam.regex.match(key)
+        if m is not None:
+            return fam.name, m.groupdict()
+    return None
+
+
+def match_prefix(prefix: str) -> Optional[str]:
+    """Classify a delete_prefix scan: the family whose keys live under
+    ``prefix``, or None. A GC prefix is valid when some family pattern
+    starts with it (e.g. ``rank_and_size/g3/``)."""
+    for fam in FAMILIES.values():
+        if fam.exact:
+            continue
+        # a concrete prefix like "worker_state/g3/" matches the family
+        # when the family regex accepts some extension of it
+        if prefix.startswith(fam.prefix):
+            return fam.name
+    return None
+
+
+def slash_prefixes() -> Dict[str, str]:
+    """{literal prefix -> family} for every non-singleton family — the
+    HVL007 scan list (singletons are matched at KV-accessor call sites
+    instead, since bare words like 'generation' appear in ordinary
+    strings)."""
+    return {fam.prefix: fam.name for fam in FAMILIES.values()
+            if not fam.exact}
+
+
+def singleton_names() -> Dict[str, str]:
+    """{exact key -> family} for singleton families."""
+    return {fam.pattern: fam.name for fam in FAMILIES.values() if fam.exact}

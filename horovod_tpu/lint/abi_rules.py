@@ -1,0 +1,109 @@
+"""ABI-drift rule (HVL104).
+
+``engine/src/c_api.cc`` and ``engine/bindings.py`` describe the same C
+ABI from two sides; the only runtime guard is the version handshake,
+which catches a *stale build* but not a *drifted source pair* (a new
+export nobody bound, a removed export still declared, an argtypes list
+whose arity no longer matches the C signature — the classic silent-
+corruption ctypes bug). HVL104 parses both sides statically and flags:
+
+- ABI version literal mismatch (``hvdtpu_abi_version`` vs ``ABI_VERSION``);
+- exported ``hvdtpu_*`` symbols never referenced in the bindings;
+- bindings references to symbols the C side does not export;
+- ``lib.hvdtpu_x.argtypes = [...]`` lists whose length differs from the
+  C parameter count.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Tuple
+
+from horovod_tpu.lint.base import Reporter
+# One parser for the C side of the ABI: the regexes/param counter live
+# in verify/engine_constants.py (the protocol specs parse the same
+# sources), so HVL104 and the specs can never disagree about what the
+# ABI *is*.
+from horovod_tpu.verify.engine_constants import (_ABI_RE, _EXPORT_RE,
+                                                 _param_count)
+
+
+def parse_c_side(text: str) -> Tuple[int, Dict[str, Tuple[int, int]]]:
+    """(abi_version or -1, {symbol: (param_count, line)})."""
+    m = _ABI_RE.search(text)
+    abi = int(m.group(1)) if m else -1
+    exports: Dict[str, Tuple[int, int]] = {}
+    for m in _EXPORT_RE.finditer(text):
+        line = text[:m.start()].count("\n") + 1
+        exports[m.group(1)] = (_param_count(text, m.end() - 1), line)
+    return abi, exports
+
+
+def parse_bindings(tree: ast.AST) \
+        -> Tuple[int, int, Dict[str, Tuple[int, int]], Dict[str, int]]:
+    """(ABI_VERSION or -1, its line, {symbol: (argtypes len, line)},
+    {referenced symbol: first line})."""
+    abi, abi_line = -1, 1
+    argtype_lens: Dict[str, Tuple[int, int]] = {}
+    referenced: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "ABI_VERSION" and \
+                    isinstance(node.value, ast.Constant):
+                abi, abi_line = int(node.value.value), node.lineno
+            if isinstance(t, ast.Attribute) and t.attr == "argtypes" and \
+                    isinstance(t.value, ast.Attribute) and \
+                    t.value.attr.startswith("hvdtpu_") and \
+                    isinstance(node.value, ast.List):
+                argtype_lens[t.value.attr] = (len(node.value.elts),
+                                              node.lineno)
+        if isinstance(node, ast.Attribute) and \
+                node.attr.startswith("hvdtpu_"):
+            referenced.setdefault(node.attr, node.lineno)
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith("hvdtpu_"):
+            referenced.setdefault(node.value, node.lineno)
+    return abi, abi_line, argtype_lens, referenced
+
+
+def check_abi_sync(rep: Reporter, c_api: Path, bindings: Path):
+    """HVL104 over one (c_api.cc, bindings.py) pair."""
+    if not c_api.exists() or not bindings.exists():
+        return
+    c_fr = rep.scan_file(c_api)
+    b_fr = rep.scan_file(bindings)
+    c_abi, exports = parse_c_side(c_fr.text)
+    try:
+        tree = ast.parse(b_fr.text, filename=str(bindings))
+    except SyntaxError:
+        return
+    b_abi, b_abi_line, argtype_lens, referenced = parse_bindings(tree)
+
+    if c_abi != b_abi:
+        b_fr.add("HVL104", b_abi_line,
+                 f"ABI version drift: bindings declare {b_abi} but "
+                 f"{c_api.name} returns {c_abi} — bump both together "
+                 "(the load-time handshake only catches stale builds, "
+                 "not drifted sources)")
+    for sym, (nargs, line) in sorted(exports.items()):
+        if sym == "hvdtpu_abi_version":
+            continue  # bound reflectively inside load_library itself
+        if sym not in referenced:
+            c_fr.add("HVL104", line,
+                     f"C export `{sym}` is never referenced in "
+                     f"{bindings.name} — an unbound ABI surface drifts "
+                     "silently")
+    for sym, line in sorted(referenced.items()):
+        if sym not in exports:
+            b_fr.add("HVL104", line,
+                     f"bindings reference `{sym}` but {c_api.name} does "
+                     "not export it")
+    for sym, (nargs, line) in sorted(argtype_lens.items()):
+        if sym in exports and exports[sym][0] != nargs:
+            b_fr.add("HVL104", line,
+                     f"`{sym}.argtypes` declares {nargs} parameter(s) "
+                     f"but the C signature takes {exports[sym][0]} — "
+                     "ctypes will silently corrupt the call frame")

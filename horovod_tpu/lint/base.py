@@ -37,6 +37,10 @@ RULES: Dict[str, str] = {
               "by edit distance)",
     "HVL006": "docs/DESIGN.md env table out of sync with the registry "
               "(regenerate with --write-env-table)",
+    "HVL007": "raw string KV-key construction outside the typed key "
+              "registry (common/kv_keys.py)",
+    "HVL008": "driver-originated KV write missing an epoch claim "
+              "(invisible to split-brain fencing and conformance replay)",
     "HVL101": "raw wait_for/wait_until/pthread_cond_clockwait outside "
               "CvWaitFor (gcc-10 libtsan cannot model clockwait)",
     "HVL102": "lock-order cycle in the static lock graph (deadlock "
@@ -44,6 +48,8 @@ RULES: Dict[str, str] = {
     "HVL103": "atomics discipline: hot-path counters must use "
               "memory_order_relaxed; cross-thread flags must be "
               "std::atomic",
+    "HVL104": "ABI drift between engine/src/c_api.cc exports / ABI "
+              "version and engine/bindings.py ctypes declarations",
 }
 
 _DISABLE_RE = re.compile(

@@ -22,12 +22,15 @@ import sys
 from pathlib import Path
 from typing import List, Optional
 
+from horovod_tpu.lint.abi_rules import check_abi_sync
 from horovod_tpu.lint.base import RULES, Finding, Reporter, iter_source_files
 from horovod_tpu.lint.cpp_rules import (check_atomics, check_lock_order,
                                         check_raw_cv_wait)
 from horovod_tpu.lint.py_collectives import check_python_collectives
 from horovod_tpu.lint.py_env import (check_cpp_env, check_doc_sync,
                                      check_python_env, write_env_table)
+from horovod_tpu.lint.py_kv import (check_python_kv_epochs,
+                                    check_python_kv_keys)
 
 # Repo layout contract: the scan roots relative to the repo root.
 PY_ROOTS = ("horovod_tpu", "examples", "bench.py")
@@ -73,6 +76,10 @@ def run_lint(repo_root: Optional[Path] = None,
             check_python_collectives(rep, f)
         if on("HVL004") or on("HVL005"):
             check_python_env(rep, f)
+        if on("HVL007"):
+            check_python_kv_keys(rep, f)
+        if on("HVL008"):
+            check_python_kv_epochs(rep, f)
     for f in cpp_files:
         if on("HVL101"):
             check_raw_cv_wait(rep, f)
@@ -82,6 +89,30 @@ def run_lint(repo_root: Optional[Path] = None,
             check_atomics(rep, f)
     if on("HVL102") and cpp_files:
         check_lock_order(rep, cpp_files, dot_out=lock_graph_out)
+    if on("HVL104"):
+        # the (c_api.cc, bindings.py) ABI pair: the real one on full-repo
+        # runs. For explicit paths, pair candidates by their directory
+        # (fixtures ship both halves side by side); a lone half — e.g.
+        # `hvd-lint engine/bindings.py` after a bindings edit — is
+        # checked against the real repo counterpart rather than silently
+        # skipping the rule.
+        real_c = root / "horovod_tpu/engine/src/c_api.cc"
+        real_b = root / "horovod_tpu/engine/bindings.py"
+        if paths:
+            pairs: dict = {}
+            for c in (f for f in cpp_files if "c_api" in f.name):
+                pairs.setdefault(c.parent, [None, None])[0] = c
+            for b in (f for f in py_files if "bindings" in f.name):
+                pairs.setdefault(b.parent, [None, None])[1] = b
+            # dedupe resolved pairs: passing both real halves explicitly
+            # puts them in different parent dirs, and each would fall
+            # back to the other — one check, not two
+            resolved = {(c or real_c, b or real_b)
+                        for c, b in pairs.values()}
+            for c, b in sorted(resolved):
+                check_abi_sync(rep, c, b)
+        else:
+            check_abi_sync(rep, real_c, real_b)
     if check_docs and on("HVL006"):
         check_doc_sync(rep, root / DESIGN_MD)
 

@@ -139,8 +139,9 @@ def _publish_endpoint(exporter: MetricsExporter, log):
         host = env_str("HOROVOD_HOSTNAME", socket.gethostname())
         local_rank = str(env_int("HOROVOD_LOCAL_RANK"))
         scrape_addr = "127.0.0.1" if host == "localhost" else host
+        from horovod_tpu.common import kv_keys
         KVClient(addr, kv_port).put_json(
-            f"metrics_addr/{host}/{local_rank}",
+            kv_keys.metrics_addr(host, local_rank),
             {"addr": scrape_addr, "port": exporter.port,
              "rank": env_int("HOROVOD_RANK")},
             timeout=5.0)

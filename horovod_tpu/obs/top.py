@@ -117,7 +117,9 @@ def discover_targets(args) -> List[dict]:
               env_int("HOROVOD_RENDEZVOUS_PORT"))
     if kv is not None:
         from horovod_tpu.runner.http_kv import KVClient
-        targets = KVClient(*kv).get_json("metrics_targets", timeout=3.0)
+        from horovod_tpu.common import kv_keys
+        targets = KVClient(*kv).get_json(kv_keys.metrics_targets(),
+                                         timeout=3.0)
         if targets:
             return list(targets)
     if env_is_set("HOROVOD_METRICS_PORT"):

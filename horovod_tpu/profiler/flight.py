@@ -331,6 +331,17 @@ def analyze(dumps: Dict[int, dict]) -> dict:
         lines.append(
             f"rank {verdict['lagging_rank']} lags the fleet by "
             f"{verdict['lag_behind_us'] / 1e6:.3f}s of collective activity")
+    # --- protocol conformance (hvd-check) -----------------------------------
+    # Replay the same dumps against the cycle spec's cross-rank rules
+    # (exec-order agreement incl. the express lane): every post-mortem
+    # doubles as a conformance oracle.
+    try:
+        from horovod_tpu.verify import conformance as _conf
+        verdict["conformance"] = _conf.check_flight_dumps(dumps)
+        for div in verdict["conformance"]:
+            lines.append(f"protocol conformance: {div}")
+    except Exception:  # noqa: BLE001 — conformance must not mask a verdict
+        verdict["conformance"] = []
     if not lines:
         lines.append("no anomaly: all recorded collectives completed on "
                      "all reporting ranks")

@@ -334,7 +334,8 @@ class ElasticRayExecutor:
             cap = max(self.settings.get("max_np") or 0,
                       self.settings["min_np"], 1)
             for rank in range(cap):
-                blob = kv.get_json(f"task_result/g{gen}/{rank}")
+                from horovod_tpu.common import kv_keys
+                blob = kv.get_json(kv_keys.task_result(gen, rank))
                 if blob is not None:
                     results[rank] = cloudpickle.loads(
                         base64.b64decode(blob["data"]))
@@ -355,8 +356,9 @@ class ElasticRayExecutor:
                 reset_limit=self.settings.get("reset_limit"),
                 verbose=self.settings.get("verbose", False),
                 spawn_worker=spawn)
+            from horovod_tpu.common import kv_keys
             self.driver.publish(
-                "task_fn",
+                kv_keys.task_fn(),
                 {"data": base64.b64encode(fn_blob).decode()})
             rc = self.driver.run(
                 start_timeout=self.settings.get("elastic_timeout", 600.0),

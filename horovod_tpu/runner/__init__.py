@@ -71,7 +71,10 @@ def run(func,
         deadline = time.monotonic() + start_timeout
         all_started = [False]
         kv = KVServer().start()
-        kv.put_json("task_fn", {"data": base64.b64encode(fn_blob).decode()})
+        from horovod_tpu.common import kv_keys
+        kv.put_json(kv_keys.task_fn(),
+                    {"data": base64.b64encode(fn_blob).decode()},
+                    epoch=kv.epoch)
 
         def not_started_by_deadline():
             if all_started[0] or time.monotonic() < deadline:
@@ -79,7 +82,7 @@ def run(func,
             missing = [r for r in range(np)
                        if not os.path.exists(
                            os.path.join(td, f"started.{r}"))
-                       and kv.get_json(f"task_started/{r}") is None]
+                       and kv.get_json(kv_keys.task_started(r)) is None]
             if missing:
                 return (f"ranks {missing} did not start within "
                         f"{start_timeout}s")
@@ -99,7 +102,7 @@ def run(func,
                     with open(path, "rb") as f:
                         results.append(cloudpickle.load(f))
                     continue
-                blob = kv.get_json(f"task_result/g0/{r}")
+                blob = kv.get_json(kv_keys.task_result(0, r))
                 if blob is None:
                     raise RuntimeError(f"no result from rank {r}")
                 results.append(cloudpickle.loads(

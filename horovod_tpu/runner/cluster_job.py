@@ -149,7 +149,8 @@ def _negotiate_controller(env: Dict[str, str]) -> Dict[str, str]:
     # (RayExecutor) negotiate afresh on every run(), and ranks >0 must not
     # read a previous run's — now closed — endpoint
     rnd = env.get("HOROVOD_CLUSTER_ROUND", "0")
-    key = f"cluster/{env['HOROVOD_CLUSTER_JOB']}/r{rnd}/controller"
+    from horovod_tpu.common import kv_keys
+    key = kv_keys.cluster_controller(env["HOROVOD_CLUSTER_JOB"], rnd)
     if int(env["HOROVOD_RANK"]) == 0:
         port, data_port = free_ports(2)
         info = {"addr": _self_addr_toward(kv_addr), "port": port,

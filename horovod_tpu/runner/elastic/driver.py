@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu.common import kv_keys
 from horovod_tpu.common.env_registry import (env_float, env_int, env_is_set,
                                              env_str)
 from horovod_tpu.common.hvd_logging import get_logger
@@ -166,8 +167,10 @@ class ElasticDriver:
 
     def publish(self, key: str, value):
         """Seed the rendezvous KV before workers spawn (e.g. the pickled
-        task function for run_task workers on shared-nothing hosts)."""
-        self._kv.put_json(key, value)
+        task function for run_task workers on shared-nothing hosts).
+        Claims the control epoch like every driver-originated write, but
+        leaves the payload untouched (callers own its schema)."""
+        self._kv.put_json(key, value, epoch=self._epoch)
 
     def _publish(self, key: str, value):
         """A driver-originated command write: claims this driver's
@@ -259,7 +262,7 @@ class ElasticDriver:
         drain was interrupted mid-flight). Returns False to fall back to
         a cold start."""
         t0 = time.monotonic()
-        gen_info = self._kv.get_json("generation")
+        gen_info = self._kv.get_json(kv_keys.generation())
         if not isinstance(gen_info, dict):
             return False
         gen = int(gen_info["generation"])
@@ -268,7 +271,7 @@ class ElasticDriver:
         # records as a fake READY barrier.
         self._generation = gen
         slots = []
-        prefix = f"rank_and_size/g{gen}/"
+        prefix = kv_keys.rank_and_size_prefix(gen)
         for key in self._kv.keys(prefix):
             rec = self._kv.get_json(key)
             if not isinstance(rec, dict) or rec.get("removed"):
@@ -284,10 +287,10 @@ class ElasticDriver:
             if host not in ordered:
                 ordered.append(host)
         self._prev_host_order = ordered
-        if self._kv.get_json(f"go/g{gen}") is not None:
+        if self._kv.get_json(kv_keys.go(gen)) is not None:
             self._go_published.add(gen)
         self._go_deadline = time.monotonic() + GO_BARRIER_TIMEOUT_SECS
-        self._publish("control_epoch", {"epoch": self._epoch})
+        self._publish(kv_keys.control_epoch(), {"epoch": self._epoch})
         try:
             self._hosts.refresh()
         except RuntimeError as e:
@@ -413,7 +416,7 @@ class ElasticDriver:
                 # it by rebalancing (reference: READY records re-triggering
                 # rendezvous, registration.py:66-135).
                 if gen not in reset_handled and \
-                        self._kv.get_json(f"reset_request/g{gen}"):
+                        self._kv.get_json(kv_keys.reset_request(gen)):
                     reset_handled.add(gen)
                     self._log(f"worker requested reset out of generation "
                               f"{gen}; scheduling rebalance")
@@ -446,7 +449,7 @@ class ElasticDriver:
                 continue
             with self._lock:
                 if self._generation == gen:
-                    self._publish(f"go/g{gen}", {"ts": time.time()})
+                    self._publish(kv_keys.go(gen), {"ts": time.time()})
                     self._go_published.add(gen)
 
     def _rebalance(self, first: bool = False):
@@ -494,7 +497,7 @@ class ElasticDriver:
             for key in list(self._workers):
                 if key not in current:
                     self._publish(
-                        f"rank_and_size/g{gen}/{key[0]}/{key[1]}",
+                        kv_keys.rank_and_size(gen, key[0], key[1]),
                         {"removed": True})
                     self._removed_slots.add(key)
             # arm the READY/go barrier for this generation, then notify
@@ -502,19 +505,26 @@ class ElasticDriver:
             self._expected_slots = [(s.hostname, s.local_rank)
                                     for s in slots]
             self._go_deadline = time.monotonic() + GO_BARRIER_TIMEOUT_SECS
-            self._publish("notify", {"generation": gen})
-            self._publish("control_epoch", {"epoch": self._epoch})
+            self._publish(kv_keys.notify(), {"generation": gen})
+            self._publish(kv_keys.control_epoch(), {"epoch": self._epoch})
             # GC stale generations (keep the previous one: stragglers may
             # still be reading it while re-rendezvousing into gen)
             old = gen - 2
             if old >= 0:
-                # trailing "/" so g1 can't swallow g10's keys
-                self._kv.delete_prefix(f"rank_and_size/g{old}/")
-                self._kv.delete_prefix(f"worker_state/g{old}/")
-                self._kv.delete_prefix(f"straggler/g{old}/")
-                self._kv.delete_prefix(f"anomaly/g{old}/")
-                self._kv.delete(f"go/g{old}")
-                self._kv.delete(f"reset_request/g{old}")
+                # prefix helpers keep the trailing "/" so g1 can't
+                # swallow g10's keys; GC claims the epoch like every
+                # other driver-originated mutation
+                self._kv.delete_prefix(kv_keys.rank_and_size_prefix(old),
+                                       epoch=self._epoch)
+                self._kv.delete_prefix(kv_keys.worker_state_prefix(old),
+                                       epoch=self._epoch)
+                self._kv.delete_prefix(kv_keys.straggler_prefix(old),
+                                       epoch=self._epoch)
+                self._kv.delete_prefix(kv_keys.anomaly_prefix(old),
+                                       epoch=self._epoch)
+                self._kv.delete(kv_keys.go(old), epoch=self._epoch)
+                self._kv.delete(kv_keys.reset_request(old),
+                                epoch=self._epoch)
                 self._go_published.discard(old)
             # spawn workers for slots that have no live process
             for s in slots:
@@ -532,7 +542,8 @@ class ElasticDriver:
                     # exits are judged normally again
                     self._draining.discard(key)
                     from horovod_tpu.runner.elastic.preempt import drain_key
-                    self._kv.delete(drain_key(*key))
+                    self._kv.delete(drain_key(*key),
+                                    epoch=self._epoch)
                 w = self._workers.get(key)
                 if w is not None and w.poll() is None:
                     continue
@@ -782,7 +793,7 @@ class ElasticDriver:
             # serving plane: aggregate worker-published serve endpoints
             # into one key (the ingress router's discovery input — the
             # serving analog of metrics_targets below)
-            sinfo = self._kv.get_json(f"serve_addr/{host}/{local_rank}")
+            sinfo = self._kv.get_json(kv_keys.serve_addr(host, local_rank))
             if isinstance(sinfo, dict) and sinfo.get("addr") \
                     and sinfo.get("port"):
                 serve_targets.append(
@@ -790,7 +801,7 @@ class ElasticDriver:
                      "addr": sinfo["addr"], "port": sinfo["port"],
                      "rank": sinfo.get("rank"),
                      "generation": sinfo.get("generation")})
-            info = self._kv.get_json(f"metrics_addr/{host}/{local_rank}")
+            info = self._kv.get_json(kv_keys.metrics_addr(host, local_rank))
             # a malformed/partial KV entry skips THIS worker only — it must
             # not abort the whole scrape pass for the healthy ones
             if not isinstance(info, dict) or not info.get("addr") \
@@ -829,7 +840,7 @@ class ElasticDriver:
                     (stats[1] - prev[1]) / (stats[0] - prev[0])
         if targets:
             try:
-                self._publish("metrics_targets", targets)
+                self._publish(kv_keys.metrics_targets(), targets)
             except Exception:  # noqa: BLE001 — telemetry must not kill
                 pass  # the heartbeat
         if serve_targets or getattr(self, "_serve_published", False):
@@ -841,7 +852,7 @@ class ElasticDriver:
             try:
                 # epoch-claimed: a fenced-out stale driver must not be
                 # able to publish a shrunken fleet and drain the routers
-                self._publish("serve_targets",
+                self._publish(kv_keys.serve_targets(),
                               {"generation": gen,
                                "workers": serve_targets})
             except Exception:  # noqa: BLE001 — routing discovery must not
@@ -872,7 +883,8 @@ class ElasticDriver:
         self._logger.warning("worker step anomaly: %s", json.dumps(event))
         self._log(f"anomaly event: {json.dumps(event)}")
         try:
-            self._kv.put_json(f"anomaly/g{gen}/{event['rank']}", event)
+            self._kv.put_json(kv_keys.anomaly(gen, event["rank"]), event,
+                              epoch=self._epoch)
         except Exception:  # noqa: BLE001
             pass
 
@@ -889,8 +901,8 @@ class ElasticDriver:
             self._log(f"straggler event: {json.dumps(event)}")
             try:
                 self._kv.put_json(
-                    f"straggler/g{event['generation']}/{event['rank']}",
-                    event)
+                    kv_keys.straggler(event["generation"], event["rank"]),
+                    event, epoch=self._epoch)
             except Exception:  # noqa: BLE001
                 pass
 

@@ -47,15 +47,18 @@ _drained = threading.Event()
 
 def drain_key(host: str, slot) -> str:
     """KV key a worker announces its departure under — shared single
-    definition with the driver's heartbeat scan."""
-    return f"drain/{host}/{slot}"
+    definition with the driver's heartbeat scan (typed registry:
+    common/kv_keys.py)."""
+    from horovod_tpu.common import kv_keys
+    return kv_keys.drain(host, slot)
 
 
 def handoff_key(world: int, old_rank: int) -> str:
     """KV key for a departing rank's live shard payload, scoped by the
     shard layout's world size (the consuming sync knows the old world from
     the survivor descriptors, not the drain generation)."""
-    return f"shard_handoff/w{world}/{old_rank}"
+    from horovod_tpu.common import kv_keys
+    return kv_keys.shard_handoff(world, old_rank)
 
 
 def preempt_requested() -> bool:

@@ -27,7 +27,8 @@ DRAINED = "DRAINED"
 def state_key(generation: int, hostname, local_rank) -> str:
     """KV key for a slot's state record — the single definition shared by
     the worker (PUT side) and the driver's registry (poll side)."""
-    return f"worker_state/g{generation}/{hostname}/{local_rank}"
+    from horovod_tpu.common import kv_keys
+    return kv_keys.worker_state(generation, hostname, local_rank)
 
 
 class WorkerStateRegistry:

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Optional, Tuple
 
+from horovod_tpu.common import kv_keys
 from horovod_tpu.common.env_registry import (env_bool, env_float, env_int,
                                              env_is_set, env_str)
 from horovod_tpu.common.hvd_logging import get_logger
@@ -68,7 +69,7 @@ def _slot() -> Tuple[str, str]:
 def heartbeat_key(host: str, slot) -> str:
     """KV key a worker's liveness heartbeat lands under — a recovered
     driver adopts live workers from these instead of respawning them."""
-    return f"worker_heartbeat/{host}/{slot}"
+    return kv_keys.worker_heartbeat(host, slot)
 
 
 # -- control-epoch fencing (worker side) ------------------------------------
@@ -200,7 +201,7 @@ def rendezvous(timeout: float = 300.0) -> int:
     min_gen = env_int("HOROVOD_ELASTIC_MIN_GENERATION")
     deadline = time.monotonic() + timeout
     while True:
-        gen_info = client.get_json("generation", timeout=60.0)
+        gen_info = client.get_json(kv_keys.generation(), timeout=60.0)
         if gen_info is None:
             raise RuntimeError(
                 "rendezvous server unreachable during elastic rendezvous")
@@ -212,12 +213,12 @@ def rendezvous(timeout: float = 300.0) -> int:
                 raise RuntimeError(
                     f"driver never advanced past generation {gen} "
                     f"(need >= {min_gen})")
-            client.put_json(f"reset_request/g{gen}",
+            client.put_json(kv_keys.reset_request(gen),
                             {"slot": f"{host}/{local_rank}",
                              "ts": time.time()})
             time.sleep(0.3)
             continue
-        info = client.get_json(f"rank_and_size/g{gen}/{host}/{local_rank}",
+        info = client.get_json(kv_keys.rank_and_size(gen, host, local_rank),
                                timeout=30.0)
         if info is not None and not observe_epoch(info.get("epoch")):
             # topology published by a fenced-out pre-crash driver: wait
@@ -248,11 +249,11 @@ def rendezvous(timeout: float = 300.0) -> int:
 def _wait_go(client, gen: int, deadline: float) -> bool:
     """Wait for go/g<gen>; False if the generation advances first."""
     while True:
-        go = client.get_json(f"go/g{gen}", timeout=1.0)
+        go = client.get_json(kv_keys.go(gen), timeout=1.0)
         if go is not None and observe_epoch(
                 go.get("epoch") if isinstance(go, dict) else None):
             return True
-        cur = client.get_json("generation", timeout=1.0)
+        cur = client.get_json(kv_keys.generation(), timeout=1.0)
         if cur is not None and cur["generation"] > gen:
             return False
         if time.monotonic() > deadline:
@@ -277,7 +278,8 @@ def poll_notification(client=None) -> Optional[int]:
     this worker rendezvoused into (reference: WorkerNotificationService push,
     here a poll of the ``notify`` key)."""
     try:
-        info = (client or kv_client()).get_json("notify", timeout=5.0)
+        info = (client or kv_client()).get_json(kv_keys.notify(),
+                                                 timeout=5.0)
     except Exception:  # noqa: BLE001 — rendezvous may be restarting
         return None
     if info and not observe_epoch(info.get("epoch")):

@@ -342,9 +342,16 @@ class KVServer:
 
     # -- durability internals -------------------------------------------------
 
-    def _log_op(self, op: dict):
-        """Caller holds self._lock."""
+    def _log_op(self, op: dict, epoch: Optional[int] = None):
+        """Caller holds self._lock. ``epoch`` (the writer's admitted
+        control-epoch claim, when one was made) is recorded on the WAL
+        op as ``"e"`` — replay ignores it, but the conformance checker
+        (``horovod_tpu/verify/conformance.py``) replays the log against
+        the epoch-monotonicity rule: a regression in the recorded claims
+        is split-brain evidence."""
         if self._wal is not None:
+            if epoch is not None:
+                op = dict(op, e=int(epoch))
             self._wal.append(op, self._store)
             self._export_metrics()
 
@@ -394,7 +401,8 @@ class KVServer:
                 self._check_epoch_locked(epoch)
                 self._store[key] = body
                 self._log_op({"op": "put", "k": key,
-                              "v": base64.b64encode(body).decode()})
+                              "v": base64.b64encode(body).decode()},
+                             epoch=epoch)
         except StaleEpochError as e:
             self._log_stale(e)
             raise
@@ -438,7 +446,7 @@ class KVServer:
                 self._check_epoch_locked(epoch)
                 existed = self._store.pop(key, None) is not None
                 if existed:
-                    self._log_op({"op": "del", "k": key})
+                    self._log_op({"op": "del", "k": key}, epoch=epoch)
                 return existed
         except StaleEpochError as e:
             self._log_stale(e)
@@ -455,7 +463,7 @@ class KVServer:
                 for k in doomed:
                     del self._store[k]
                 if doomed:
-                    self._log_op({"op": "delp", "p": prefix})
+                    self._log_op({"op": "delp", "p": prefix}, epoch=epoch)
         except StaleEpochError as e:
             self._log_stale(e)
             raise

@@ -349,9 +349,10 @@ def publish_assignments(kv: KVServer, slots, controller_addr: str,
     rendezvous GET_RANK_AND_SIZE scope, runner/elastic/rendezvous.py).
     ``epoch`` is the publishing driver's control epoch — embedded so
     workers can fence a lingering pre-crash driver's stale topology."""
+    from horovod_tpu.common import kv_keys
     for s in slots:
         kv.put_json(
-            f"rank_and_size/g{generation}/{s.hostname}/{s.local_rank}",
+            kv_keys.rank_and_size(generation, s.hostname, s.local_rank),
             {"rank": s.rank, "size": s.size,
              "local_rank": s.local_rank, "local_size": s.local_size,
              "cross_rank": s.cross_rank, "cross_size": s.cross_size,
@@ -359,7 +360,8 @@ def publish_assignments(kv: KVServer, slots, controller_addr: str,
              "controller_port": controller_port,
              "controller_data_port": data_port,
              "epoch": epoch}, epoch=epoch)
-    kv.put_json("generation", {"generation": generation, "epoch": epoch},
+    kv.put_json(kv_keys.generation(),
+                {"generation": generation, "epoch": epoch},
                 epoch=epoch)
 
 

@@ -21,6 +21,7 @@ import sys
 
 import cloudpickle
 
+from horovod_tpu.common import kv_keys
 from horovod_tpu.common.env_registry import env_int, env_str
 
 
@@ -43,14 +44,14 @@ def main():
     except OSError:
         pass  # results dir not mounted here; the KV marker covers us
     if kv is not None:
-        kv.put_json(f"task_started/{rank}", {"ok": True})
+        kv.put_json(kv_keys.task_started(rank), {"ok": True})
     if os.path.exists(fn_path):
         with open(fn_path, "rb") as f:
             fn = cloudpickle.load(f)
     elif kv is not None:
         # no shared filesystem: the launcher publishes the pickled
         # function under task_fn
-        blob = kv.get_json("task_fn", timeout=30.0)
+        blob = kv.get_json(kv_keys.task_fn(), timeout=30.0)
         if blob is None:
             raise RuntimeError(f"{fn_path} absent and no task_fn in the "
                                "rendezvous KV")
@@ -74,7 +75,7 @@ def main():
         # (elastic/worker.py rewrites it at each rendezvous); static jobs
         # stay at generation 0.
         gen = env_int("HOROVOD_ELASTIC_GENERATION")
-        kv.put_json(f"task_result/g{gen}/{rank}",
+        kv.put_json(kv_keys.task_result(gen, rank),
                     {"data": base64.b64encode(payload).decode()})
 
 

@@ -178,7 +178,8 @@ class RequestRouter:
         :attr:`discovery_stale` — surfaced in ``/stats`` — rather than
         draining workers that are still answering requests."""
         try:
-            info = kv_get_json("serve_targets")
+            from horovod_tpu.common import kv_keys
+            info = kv_get_json(kv_keys.serve_targets())
         except Exception:  # noqa: BLE001 — KV mid-restart is an outage,
             info = None  # not a router crash
         if not isinstance(info, dict) or "workers" not in info:

@@ -38,6 +38,7 @@ from typing import Optional
 
 import numpy as np
 
+from horovod_tpu.common import kv_keys
 from horovod_tpu.common.env_registry import (env_int, env_is_set, env_str)
 from horovod_tpu.common.exceptions import HorovodInternalError
 from horovod_tpu.common.hvd_logging import get_logger
@@ -144,7 +145,7 @@ class ServeWorker:
         host, local_rank = self._slot()
         addr = "127.0.0.1" if host == "localhost" else host
         kv_client.put_json(
-            f"serve_addr/{host}/{local_rank}",
+            kv_keys.serve_addr(host, local_rank),
             {"id": f"{host}/{local_rank}", "addr": addr,
              "port": self.frontend.port, "rank": env_int("HOROVOD_RANK"),
              "generation": generation}, timeout=5.0)
@@ -156,7 +157,7 @@ class ServeWorker:
             return
         host, local_rank = self._slot()
         try:
-            self._kv.delete(f"serve_addr/{host}/{local_rank}")
+            self._kv.delete(kv_keys.serve_addr(host, local_rank))
         except Exception:  # noqa: BLE001 — KV may already be gone at exit
             pass
 
@@ -213,7 +214,7 @@ def main(argv=None) -> int:
                 now - last_kv_poll >= KV_POLL_INTERVAL_SEC
             if kv_due:
                 last_kv_poll = now
-                if kv.get_json("serve_stop", timeout=1.0) is not None:
+                if kv.get_json(kv_keys.serve_stop(), timeout=1.0) is not None:
                     log.info("serve_stop published; draining and exiting")
                     worker.drain(timeout=30.0)
                     if elastic:

@@ -40,6 +40,7 @@ import json
 import time
 from typing import Dict, List, Optional, Sequence
 
+from horovod_tpu.common import kv_keys
 from horovod_tpu.common.env_registry import (env_float, env_int, env_str)
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.tune.search import CoordinateSearch
@@ -278,9 +279,9 @@ class TuningSession:
         self._log.info("tune converged: %s", json.dumps(record))
         if self._kv is not None:
             try:
-                self._kv.put_json(f"tune_config/{self._job}", record)
+                self._kv.put_json(kv_keys.tune_config(self._job), record)
                 self._kv.put_json(
-                    f"tune_epoch/{self._job}/{self.epoch}",
+                    kv_keys.tune_epoch(self._job, self.epoch),
                     {"config": dict(self.config), "converged": True})
             except Exception as e:  # noqa: BLE001 — KV outage ≠ job failure
                 self._log.warning("tune KV publish failed: %r", e)
@@ -300,7 +301,7 @@ class TuningSession:
             return None
         try:
             rec = self._kv.get_json(
-                f"tune_epoch/{self._job}/{self.epoch}", timeout=5.0)
+                kv_keys.tune_epoch(self._job, self.epoch), timeout=5.0)
         except Exception:  # noqa: BLE001 — keep training on KV outage
             rec = None
         if not rec:
@@ -314,7 +315,7 @@ class TuningSession:
         if self._kv is None or not self._leader:
             return
         try:
-            self._kv.put_json(f"tune_epoch/{self._job}/{self.epoch}",
+            self._kv.put_json(kv_keys.tune_epoch(self._job, self.epoch),
                               {"config": dict(self.config),
                                "converged": False})
         except Exception as e:  # noqa: BLE001

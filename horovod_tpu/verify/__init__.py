@@ -1,0 +1,26 @@
+"""Protocol verification: executable specs + bounded model checking.
+
+``hvd-check`` (this package's CLI) is the model-checking counterpart of
+``hvd-lint``: where the linter proves syntactic contracts, the checker
+exhaustively explores the *interleavings* of the control-plane protocols
+— coordination cycle + fast abort, control-epoch fencing, preemption
+drain → shard handoff → resize, and the cycle-boundary ``TunedParams``
+broadcast — with crash/partition/message-drop faults injectable at every
+step, and prints counterexample traces as readable event sequences.
+
+The specs are small pure-Python state machines whose constants (flag
+bits, KV key prefixes, the epoch comparison rule, the express-lane
+threshold) are parsed from or asserted against the real code, so a spec
+cannot silently drift from the implementation it models. A conformance
+mode replays real artifacts (flight-recorder dumps, KV write-ahead logs)
+against the same rules.
+"""
+
+from horovod_tpu.verify.checker import CheckResult, Violation, check
+from horovod_tpu.verify.spec import Invariant, Spec
+from horovod_tpu.verify.specs import MUTANTS, SPECS, make_spec
+
+__all__ = [
+    "CheckResult", "Violation", "check", "Invariant", "Spec",
+    "SPECS", "MUTANTS", "make_spec",
+]

@@ -1,0 +1,6 @@
+"""`python -m horovod_tpu.verify` — see horovod_tpu/verify/cli.py."""
+
+from horovod_tpu.verify.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

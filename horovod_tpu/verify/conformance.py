@@ -1,0 +1,303 @@
+"""Runtime trace conformance: replay real artifacts against the specs.
+
+Two artifact classes, both produced by ordinary operation of the system
+(no special tracing mode):
+
+- **KV write-ahead logs** (``HOROVOD_KV_DIR/wal.log`` + snapshot) — every
+  control-plane mutation in commit order. Replayed read-only (unlike
+  ``_Wal.replay`` this parser never truncates the artifact) and checked
+  against the typed key registry, the epoch-monotonicity rule, and the
+  go-barrier ordering (``go/gN`` only after generation N's topology).
+- **Flight-recorder dumps** (``flight_rank<R>.json``) — each rank's
+  collective lifecycle ring. Checked for the cycle spec's cross-rank
+  invariants: exec-order agreement (express lane included) and
+  signature agreement, plus any recorded DESYNC events.
+
+Every chaos-soak run doubles as a conformance oracle (the soak tests
+call :func:`check_kv_wal` on their control-plane sidecar's directory),
+and the PR-5 flight analyzer appends conformance lines to its verdict.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import zlib
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from horovod_tpu.common import kv_keys
+
+_MAX_RECORD_BYTES = 64 << 20  # mirrors runner/http_kv.py's replay ceiling
+
+
+# ===========================================================================
+# KV WAL replay (read-only)
+# ===========================================================================
+
+def iter_wal_ops(kv_dir) -> Iterator[dict]:
+    """Yield the decoded JSON ops of ``wal.log`` in commit order,
+    stopping (like the real replay) at the first truncated or corrupt
+    record — but never mutating the artifact."""
+    path = Path(kv_dir) / "wal.log"
+    try:
+        data = path.read_bytes()
+    except OSError:
+        return
+    off = 0
+    while off + 8 <= len(data):
+        length = int.from_bytes(data[off:off + 4], "little")
+        crc = int.from_bytes(data[off + 4:off + 8], "little")
+        if length <= 0 or length > _MAX_RECORD_BYTES or \
+                off + 8 + length > len(data):
+            return
+        payload = data[off + 8:off + 8 + length]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            return
+        try:
+            yield json.loads(payload)
+        except ValueError:
+            return
+        off += 8 + length
+
+
+def load_snapshot_keys(kv_dir) -> List[str]:
+    """Keys present in the compacted snapshot (compaction truncates the
+    WAL, so ordering checks must treat snapshot contents as 'already
+    seen')."""
+    path = Path(kv_dir) / "snapshot.json"
+    try:
+        doc = json.loads(path.read_bytes())
+        return list(doc.get("store", {}))
+    except (OSError, ValueError, AttributeError):
+        return []
+
+
+def _decoded_value(op: dict) -> Optional[dict]:
+    try:
+        val = json.loads(base64.b64decode(op.get("v", "")))
+    except (ValueError, TypeError):
+        return None
+    return val if isinstance(val, dict) else None
+
+
+def check_kv_wal(kv_dir) -> List[str]:
+    """Divergences between a KV write-ahead log and the protocol rules.
+    Empty list = conformant."""
+    out: List[str] = []
+    seen_keys = set(load_snapshot_keys(kv_dir))
+    max_claimed_epoch: Optional[int] = None
+    max_generation: Optional[int] = None
+    n = 0
+    for i, op in enumerate(iter_wal_ops(kv_dir)):
+        n += 1
+        kind = op.get("op")
+        # op-level epoch claim (recorded by KVServer._log_op): the
+        # strongest split-brain oracle — EVERY admitted claim must be
+        # monotone, whatever key it touched
+        claimed = op.get("e")
+        if claimed is not None:
+            e = int(claimed)
+            if max_claimed_epoch is not None and e < max_claimed_epoch:
+                out.append(
+                    f"wal[{i}]: op claimed control epoch {e} after "
+                    f"{max_claimed_epoch} was admitted — a fenced-out "
+                    "stale driver's mutation landed (split-brain)")
+            max_claimed_epoch = max(max_claimed_epoch or e, e)
+        if kind == "delp":
+            prefix = op.get("p", "")
+            if kv_keys.match_prefix(prefix) is None:
+                out.append(f"wal[{i}]: delete_prefix of unregistered key "
+                           f"namespace {prefix!r}")
+            seen_keys = {k for k in seen_keys
+                         if not k.startswith(prefix)}
+            continue
+        key = op.get("k", "")
+        m = kv_keys.match(key)
+        if m is None:
+            out.append(f"wal[{i}]: key {key!r} matches no registered "
+                       "family (common/kv_keys.py)")
+            continue
+        family, _args = m
+        fam = kv_keys.FAMILIES[family]
+        if kind == "del":
+            seen_keys.discard(key)
+            continue
+        seen_keys.add(key)
+        val = _decoded_value(op)
+        if fam.epoch_claimed and isinstance(val, dict) and \
+                "epoch" in val:
+            try:
+                e = int(val["epoch"])
+            except (TypeError, ValueError):
+                out.append(f"wal[{i}]: {key}: non-integer epoch "
+                           f"{val['epoch']!r}")
+                continue
+            if max_claimed_epoch is not None and e < max_claimed_epoch:
+                out.append(
+                    f"wal[{i}]: {key}: control epoch regressed "
+                    f"({e} after {max_claimed_epoch}) — a fenced-out "
+                    "stale driver's write landed (split-brain)")
+            max_claimed_epoch = max(max_claimed_epoch or e, e)
+        if family in ("generation", "notify") and isinstance(val, dict) \
+                and "generation" in val:
+            try:
+                g = int(val["generation"])
+            except (TypeError, ValueError):
+                g = None
+            if g is not None:
+                if max_generation is not None and g < max_generation:
+                    out.append(
+                        f"wal[{i}]: {key}: generation regressed "
+                        f"({g} after {max_generation})")
+                max_generation = max(max_generation or g, g)
+        if family == "go":
+            gen = kv_keys.FAMILIES["go"].regex.match(key).group("gen")
+            prefix = kv_keys.rank_and_size_prefix(int(gen))
+            if not any(k.startswith(prefix) for k in seen_keys):
+                out.append(
+                    f"wal[{i}]: {key}: go barrier released before any "
+                    f"{prefix}* topology record existed")
+    if n == 0 and not (Path(kv_dir) / "wal.log").exists() and \
+            not (Path(kv_dir) / "snapshot.json").exists():
+        out.append(f"{kv_dir}: no wal.log or snapshot.json — not a "
+                   "durable KV directory")
+    return out
+
+
+# ===========================================================================
+# Flight-dump replay
+# ===========================================================================
+
+def _exec_sequence(dump: dict) -> List[Tuple[str, int]]:
+    """One rank's executed collectives, in execution order: the order of
+    their EXEC timestamps (the express lane reorders execution relative
+    to enqueue, identically on every rank)."""
+    from horovod_tpu.profiler.flight import reconstruct
+    execd = [c for c in reconstruct(dump) if "EXEC" in c.phases
+             or "DONE" in c.phases]
+    execd.sort(key=lambda c: c.phases.get("EXEC",
+                                          c.phases.get("DONE", 0.0)))
+    return [(c.name, c.occurrence) for c in execd]
+
+
+def check_flight_dumps(dumps: Dict[int, dict]) -> List[str]:
+    """Cross-rank divergences in a set of per-rank flight dumps (the
+    output of ``profiler.flight.load_dumps``). Empty list = the recorded
+    run conforms to the cycle spec's invariants."""
+    from horovod_tpu.profiler.flight import reconstruct
+    out: List[str] = []
+    seqs = {r: _exec_sequence(d) for r, d in dumps.items()}
+    ranks = sorted(seqs)
+    for i in range(len(ranks)):
+        for j in range(i + 1, len(ranks)):
+            a, b = seqs[ranks[i]], seqs[ranks[j]]
+            common = set(a) & set(b)
+            fa = [x for x in a if x in common]
+            fb = [x for x in b if x in common]
+            if fa != fb:
+                # name the first divergence point, not the whole logs
+                k = next((n for n, (x, y) in enumerate(zip(fa, fb))
+                          if x != y), min(len(fa), len(fb)))
+                out.append(
+                    f"exec-order divergence between rank {ranks[i]} and "
+                    f"rank {ranks[j]} at common position {k}: "
+                    f"{fa[k][0] if k < len(fa) else '<end>'} vs "
+                    f"{fb[k][0] if k < len(fb) else '<end>'} — the "
+                    "cross-rank exec-order invariant (cycle spec) is "
+                    "violated")
+    # signature agreement + recorded desyncs
+    sigs: Dict[Tuple[str, int], Dict[int, int]] = {}
+    for r, d in dumps.items():
+        for c in reconstruct(d):
+            if c.signature:
+                sigs.setdefault((c.name, c.occurrence), {})[r] = \
+                    c.signature
+        for e in d.get("events", []):
+            if e.get("phase") == "DESYNC":
+                out.append(
+                    f"rank {r} recorded DESYNC for "
+                    f"{e.get('name', '?')!r} — submit-signature mismatch "
+                    "caught at runtime")
+    for (name, occ), by_rank in sigs.items():
+        if len(set(by_rank.values())) > 1:
+            out.append(
+                f"signature mismatch for {name!r} (occurrence {occ}) "
+                f"across ranks {sorted(by_rank)} — ranks submitted "
+                "different collectives under one name")
+    return out
+
+
+# ===========================================================================
+# Artifact-directory front door
+# ===========================================================================
+
+def check_artifacts(path, kv_dir=None, flight_dir=None) -> dict:
+    """Replay every artifact found under ``path`` (or the explicit
+    ``kv_dir``/``flight_dir`` overrides): ``{"checked": [...],
+    "divergences": [...]}``. A soak artifact directory usually holds the
+    control-plane KV dir (wal.log) and a set of flight_rank*.json."""
+    path = Path(path)
+    checked: List[str] = []
+    divergences: List[str] = []
+
+    kv_candidates = [Path(kv_dir)] if kv_dir else [
+        d for d in [path, path / "kv", *sorted(path.glob("**/"))]
+        if (d / "wal.log").exists() or (d / "snapshot.json").exists()]
+    seen = set()
+    for d in kv_candidates:
+        d = d.resolve()
+        if d in seen:
+            continue
+        seen.add(d)
+        checked.append(f"kv-wal: {d}")
+        divergences += [f"{d}: {line}" for line in check_kv_wal(d)]
+
+    fdir = Path(flight_dir) if flight_dir else path
+    dump_files = sorted(fdir.glob("**/flight_rank*.json"))
+    by_dir: Dict[Path, Dict[int, dict]] = {}
+    for f in dump_files:
+        try:
+            dump = json.loads(f.read_text())
+        except (OSError, ValueError):
+            divergences.append(f"{f}: unreadable flight dump")
+            continue
+        by_dir.setdefault(f.parent, {})[int(dump.get("rank", -1))] = dump
+    for d, dumps in sorted(by_dir.items()):
+        checked.append(f"flight: {d} (ranks {sorted(dumps)})")
+        divergences += [f"{d}: {line}"
+                        for line in check_flight_dumps(dumps)]
+
+    if not checked:
+        divergences.append(
+            f"{path}: no wal.log/snapshot.json or flight_rank*.json "
+            "artifacts found")
+    return {"checked": checked, "divergences": divergences}
+
+
+def copy_soak_artifacts(kv_dir: Optional[str] = None,
+                        flight_dir: Optional[str] = None):
+    """Copy a soak run's artifacts to ``HOROVOD_SOAK_ARTIFACT_DIR`` (if
+    set) so ``make conformance`` can replay the latest soak after the
+    fact. Best-effort by design — artifact export must never fail a
+    soak."""
+    import shutil
+    from horovod_tpu.common.env_registry import env_str
+    dest = env_str("HOROVOD_SOAK_ARTIFACT_DIR")
+    if not dest:
+        return None
+    try:
+        os.makedirs(dest, exist_ok=True)
+        if kv_dir and Path(kv_dir).exists():
+            target = Path(dest) / "kv"
+            shutil.rmtree(target, ignore_errors=True)
+            shutil.copytree(kv_dir, target)
+        if flight_dir and Path(flight_dir).exists():
+            target = Path(dest) / "flight"
+            target.mkdir(exist_ok=True)
+            for f in Path(flight_dir).glob("flight_rank*.json"):
+                shutil.copy(f, target / f.name)
+        return dest
+    except OSError:
+        return None

@@ -1,0 +1,123 @@
+"""Spec constants parsed from the real engine sources.
+
+The protocol specs must not hard-code a private copy of the engine's
+contract — a renumbered flag bit or a bumped ABI would leave the checker
+verifying a protocol nobody runs. Everything a spec needs from C++ land
+is parsed here, at import time of the spec, straight out of the checked-
+in sources (``engine/src/controller.cc`` flag bits, ``engine/src/
+c_api.cc`` ABI version + export list, ``engine/src/common.h`` defaults);
+``tests/test_verify.py`` additionally asserts agreement with
+``engine/bindings.py``. Lint rule HVL104 enforces the same agreement on
+every lint run.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+ENGINE_SRC = Path(__file__).resolve().parents[1] / "engine" / "src"
+
+_FLAG_RE = re.compile(
+    r"constexpr\s+uint64_t\s+(kFlag\w+)\s*=\s*1ull\s*<<\s*(\d+)\s*;")
+_ABI_RE = re.compile(r"hvdtpu_abi_version\(\)\s*\{\s*return\s+(\d+)\s*;")
+_LOW_LAT_RE = re.compile(
+    r"low_latency_threshold_bytes\s*=\s*(\d+)\s*;")
+# a C export definition: return type then hvdtpu_xxx( — the argument list
+# may span lines, captured up to the matching close paren by _c_exports.
+_EXPORT_RE = re.compile(
+    r"^\s*(?:int32_t|int64_t|uint64_t|double|void|const\s+char\s*\*)\s+"
+    r"(hvdtpu_\w+)\s*\(", re.MULTILINE)
+
+
+def _read(name: str) -> str:
+    path = ENGINE_SRC / name
+    try:
+        return path.read_text()
+    except OSError as e:
+        raise RuntimeError(
+            f"engine source {path} unavailable — the protocol specs parse "
+            "their constants from the checked-in C++ sources and cannot "
+            "run without them") from e
+
+
+@lru_cache(maxsize=None)
+def flag_bits() -> Dict[str, int]:
+    """{kFlagName: bit index} from controller.cc — the coordination-cycle
+    OR-flag word the cycle spec models."""
+    flags = {name: int(bit)
+             for name, bit in _FLAG_RE.findall(_read("controller.cc"))}
+    if not flags:
+        raise RuntimeError("no kFlag constants parsed from controller.cc")
+    return flags
+
+
+@lru_cache(maxsize=None)
+def abi_version() -> int:
+    """The engine's C ABI version literal (c_api.cc)."""
+    m = _ABI_RE.search(_read("c_api.cc"))
+    if m is None:
+        raise RuntimeError("hvdtpu_abi_version literal not found in c_api.cc")
+    return int(m.group(1))
+
+
+@lru_cache(maxsize=None)
+def low_latency_threshold_default() -> int:
+    """Default express-lane eligibility threshold in bytes (common.h) —
+    the partition boundary the cycle spec's express lane uses."""
+    m = _LOW_LAT_RE.search(_read("common.h"))
+    if m is None:
+        raise RuntimeError(
+            "low_latency_threshold_bytes default not found in common.h")
+    return int(m.group(1))
+
+
+def _param_count(text: str, open_paren: int) -> int:
+    """Parameters of the C declaration whose '(' is at ``open_paren``."""
+    depth = 0
+    args: List[str] = []
+    start = open_paren + 1
+    for i in range(open_paren, len(text)):
+        ch = text[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                args.append(text[start:i])
+                break
+        elif ch == "," and depth == 1:
+            args.append(text[start:i])
+            start = i + 1
+    args = [a.strip() for a in args]
+    if len(args) == 1 and args[0] in ("", "void"):
+        return 0
+    return len(args)
+
+
+@lru_cache(maxsize=None)
+def c_exports() -> Dict[str, int]:
+    """{exported hvdtpu_* symbol: parameter count} from c_api.cc."""
+    text = _read("c_api.cc")
+    out: Dict[str, int] = {}
+    for m in _EXPORT_RE.finditer(text):
+        out[m.group(1)] = _param_count(text, m.end() - 1)
+    if "hvdtpu_abi_version" not in out:
+        raise RuntimeError("export scan of c_api.cc found no functions")
+    return out
+
+
+def bindings_view() -> Tuple[int, Dict[str, int], set]:
+    """(ABI_VERSION, {symbol: declared argtypes length}, referenced
+    symbols) statically read out of engine/bindings.py — used by the
+    conformance tests to detect ABI drift without loading the library.
+    The AST walk itself is lint rule HVL104's (one parser, shared)."""
+    import ast
+    from horovod_tpu.lint.abi_rules import parse_bindings
+    path = ENGINE_SRC.parent / "bindings.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    abi, _line, argtype_lens, referenced = parse_bindings(tree)
+    return (abi, {sym: n for sym, (n, _l) in argtype_lens.items()},
+            set(referenced))

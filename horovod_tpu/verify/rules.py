@@ -1,0 +1,57 @@
+"""Shared protocol rules the specs and the conformance checker both use.
+
+Each rule here is a pure function mirroring one decision point in the
+real code, with a test asserting agreement against the real
+implementation (``tests/test_verify.py``) — the spec-vs-code contract
+the ISSUE calls "imported from or asserted against the real code".
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# outcomes of admit_epoch
+FENCED = "fenced"   # strictly-older claim: 409 / StaleEpochError
+ADOPT = "adopt"     # newer claim: server adopts + persists it
+OK = "ok"           # equal claim or epoch-less write
+
+
+def admit_epoch(current: int, claimed: Optional[int]) \
+        -> Tuple[str, int]:
+    """The KV server's epoch-fencing rule (``KVServer._check_epoch_locked``
+    in ``runner/http_kv.py``): ``(outcome, new_server_epoch)``.
+
+    - epoch-less writes (claimed is None) pass untouched;
+    - strictly-older claims are fenced;
+    - newer claims advance (and persist) the server epoch."""
+    if claimed is None:
+        return OK, current
+    if claimed < current:
+        return FENCED, current
+    if claimed > current:
+        return ADOPT, claimed
+    return OK, current
+
+
+def worker_accepts(floor: int, offered: Optional[int]) \
+        -> Tuple[bool, int]:
+    """The worker-side fencing floor (``runner/elastic/worker.py
+    observe_epoch``): ``(accepted, new_floor)``. ``None`` = unfenced
+    legacy record, accepted; at/above the floor accepted and raises it;
+    strictly below rejected."""
+    if offered is None:
+        return True, floor
+    if offered < floor:
+        return False, floor
+    return True, offered
+
+
+def express_eligible(size_bytes: int, threshold: int,
+                     grouped: bool = False,
+                     data_bearing: bool = True) -> bool:
+    """The express-lane partition rule (``Controller::LowLatencyEligible``
+    in ``engine/src/controller.cc``): small, ungrouped, data-bearing
+    responses peel onto the low-latency lane. Every rank must compute
+    this identically or cross-rank exec order desyncs — the invariant
+    the cycle spec checks."""
+    return data_bearing and not grouped and size_bytes <= threshold

@@ -1,0 +1,704 @@
+"""Executable protocol specs for the control plane.
+
+Four explicit state machines covering the five interlocking protocols:
+
+- :class:`CycleSpec` — the coordination cycle + fast abort + the
+  express-lane response partition (cross-rank exec-order agreement);
+- :class:`EpochSpec` — control-epoch fencing: KV 409/adopt rules, the
+  worker floor, driver recovery with heartbeat adoption;
+- :class:`DrainSpec` — preemption drain → shard handoff → resize, with
+  the driver's scan-before-refresh heartbeat ordering and the reap-time
+  last-chance drain check;
+- :class:`TuneSpec` — the cycle-boundary ``TunedParams`` broadcast.
+
+Spec constants come from the real code: the express threshold and flag
+bits are parsed out of ``engine/src`` (``engine_constants``), KV keys in
+trace labels come from ``common/kv_keys.py``, and the epoch rules are
+the shared functions in ``verify/rules.py`` that tests assert against
+the real ``KVServer``/``observe_epoch``.
+
+Seeded historical bugs are re-introducible as **mutations** (the
+``MUTANTS`` registry): ``hvd-check --mutant <name>`` must produce a
+counterexample for each, which is what proves the invariants have teeth.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from horovod_tpu.common import kv_keys
+from horovod_tpu.verify import engine_constants, rules
+from horovod_tpu.verify.spec import Invariant, Spec
+
+
+def _rep(t: tuple, i: int, v) -> tuple:
+    return t[:i] + (v,) + t[i + 1:]
+
+
+# ===========================================================================
+# Coordination cycle + fast abort + express-lane partition
+# ===========================================================================
+
+class CycleState(NamedTuple):
+    pending: tuple        # per rank: frozenset of negotiating tensor names
+    next_idx: tuple       # per rank: position in the submit script
+    exec_log: tuple       # per rank: tuple of executed tensor names
+    abort_req: tuple      # per rank: requested a fast abort
+    aborted: tuple        # per rank: session dead
+    crashed: tuple        # per rank: process dead (fault)
+    missed_abort: bool    # a cycle completed without honoring a signal
+    crashes_left: int
+    aborts_left: int
+
+
+class CycleSpec(Spec):
+    """Two engine ranks submit the same tensor program (a sub-threshold
+    gradient and a bulk gradient) at independent speeds; the cycle
+    negotiates the common set, peels the express lane, and executes.
+    Faults: one rank crash, one explicit abort request."""
+
+    # (name, size_bytes): one tensor under the express threshold, one
+    # over. Named so the express-first order differs from plain sorted
+    # order — a divergent partition must actually reorder execution.
+    SUBMITS = (("tiny_update", 1024), ("dense_grad", 1 << 20))
+
+    def __init__(self, ranks: int = 2, rank_divergent_express: bool = False,
+                 ignore_abort: bool = False, crashes: int = 1,
+                 aborts: int = 1):
+        super().__init__(name="cycle", mutations=tuple(
+            m for m, on in [("rank_divergent_express",
+                             rank_divergent_express),
+                            ("ignore_abort", ignore_abort)] if on))
+        self.ranks = ranks
+        self.rank_divergent_express = rank_divergent_express
+        self.ignore_abort = ignore_abort
+        self.crashes = crashes
+        self.aborts = aborts
+        self.threshold = engine_constants.low_latency_threshold_default()
+        # the abort flag bit must exist in the real coordination word —
+        # the fast-abort protocol this spec models rides it
+        self.abort_bit = engine_constants.flag_bits()["kFlagAbort"]
+
+    def initial(self) -> CycleState:
+        n = self.ranks
+        return CycleState(
+            pending=(frozenset(),) * n, next_idx=(0,) * n,
+            exec_log=((),) * n, abort_req=(False,) * n,
+            aborted=(False,) * n, crashed=(False,) * n,
+            missed_abort=False, crashes_left=self.crashes,
+            aborts_left=self.aborts)
+
+    def _alive(self, s: CycleState) -> List[int]:
+        return [r for r in range(self.ranks)
+                if not s.crashed[r] and not s.aborted[r]]
+
+    def _partition(self, rank: int, common: frozenset) \
+            -> Tuple[tuple, tuple]:
+        """(express, bulk) exec order for one rank — identical on every
+        rank in the real controller; the mutation gives rank >= 1 a
+        divergent threshold (the historical hazard class: rank-dependent
+        fusion/express eligibility)."""
+        threshold = self.threshold
+        if self.rank_divergent_express and rank >= 1:
+            threshold = 0
+        sizes = dict(self.SUBMITS)
+        express = tuple(sorted(
+            t for t in common
+            if rules.express_eligible(sizes[t], threshold)))
+        bulk = tuple(sorted(t for t in common if t not in express))
+        return express, bulk
+
+    def actions(self, s: CycleState):
+        out = []
+        alive = self._alive(s)
+        for r in alive:
+            if s.next_idx[r] < len(self.SUBMITS):
+                name = self.SUBMITS[s.next_idx[r]][0]
+                out.append((
+                    f"rank{r}.enqueue({name})",
+                    s._replace(
+                        pending=_rep(s.pending, r,
+                                     s.pending[r] | {name}),
+                        next_idx=_rep(s.next_idx, r, s.next_idx[r] + 1))))
+        for r in alive:
+            if s.crashes_left > 0:
+                out.append((f"fault: rank{r} crashes",
+                            s._replace(crashed=_rep(s.crashed, r, True),
+                                       crashes_left=s.crashes_left - 1)))
+            if s.aborts_left > 0 and not s.abort_req[r]:
+                out.append((
+                    f"rank{r}.hvdtpu_abort()",
+                    s._replace(abort_req=_rep(s.abort_req, r, True),
+                               aborts_left=s.aborts_left - 1)))
+        if alive:
+            out.append(self._cycle(s, alive))
+        return out
+
+    def _cycle(self, s: CycleState, alive: List[int]):
+        abort_signal = any(s.crashed) or any(s.abort_req[r] for r in alive)
+        if abort_signal and not self.ignore_abort:
+            # fast abort: the kFlagAbort bit rides the OR'd coordination
+            # word, so EVERY surviving rank fails this same cycle
+            aborted = s.aborted
+            for r in alive:
+                aborted = _rep(aborted, r, True)
+            return (f"cycle: flags|=kFlagAbort(bit {self.abort_bit}) -> "
+                    f"all alive ranks abort",
+                    s._replace(aborted=aborted))
+        if abort_signal:
+            # MUTATION ignore_abort: the cycle proceeds as if the flag
+            # word carried nothing — the missed signal is the violation
+            s = s._replace(missed_abort=True)
+        common = frozenset.intersection(
+            *[s.pending[r] for r in range(self.ranks)]) \
+            if self.ranks else frozenset()
+        pending = s.pending
+        exec_log = s.exec_log
+        for r in range(self.ranks):
+            pending = _rep(pending, r, s.pending[r] - common)
+            express, bulk = self._partition(r, common)
+            exec_log = _rep(exec_log, r, s.exec_log[r] + express + bulk)
+        label = "cycle: negotiate " + (
+            f"{sorted(common)}" if common else "(nothing common)")
+        return label, s._replace(pending=pending, exec_log=exec_log)
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        def exec_agreement(s: CycleState) -> bool:
+            logs = list(s.exec_log)
+            for i in range(len(logs)):
+                for j in range(i + 1, len(logs)):
+                    a, b = logs[i], logs[j]
+                    n = min(len(a), len(b))
+                    if a[:n] != b[:n]:
+                        return False
+            return True
+
+        def abort_honored(s: CycleState) -> bool:
+            return not s.missed_abort
+
+        return [
+            Invariant(
+                "exec_order_agreement",
+                "every pair of ranks executes negotiated collectives in "
+                "the same order (express-lane partition included) — "
+                "divergence deadlocks the data plane", exec_agreement),
+            Invariant(
+                "abort_within_one_cycle",
+                "a pending crash/abort signal is honored by the very "
+                "next coordination cycle on every surviving rank",
+                abort_honored),
+        ]
+
+
+# ===========================================================================
+# Control-epoch fencing (split-brain protection, adoption)
+# ===========================================================================
+
+class DriverS(NamedTuple):
+    alive: bool
+    fenced: bool        # observed a 409; stood down
+    epoch: int
+    gen: int            # last generation this driver published
+    last_notify: Optional[tuple]  # (gen, epoch) at this driver's replica
+    recovered: bool     # ran its adoption pass (recovered drivers only)
+    writes_left: int
+
+
+class EpochState(NamedTuple):
+    kv_epoch: int         # authoritative (durable) server epoch
+    persist_epoch: int    # what the epoch file holds
+    last_write_epoch: int  # epoch of the last ACCEPTED mutation
+    write_regressed: bool  # an older-epoch write landed after a newer one
+    kv_notify: Optional[tuple]  # (gen, epoch) in the durable store
+    drivers: tuple        # DriverS per driver slot (0 = original, 1 = respawn)
+    worker_alive: bool
+    worker_procs: int     # live processes for the one modeled slot
+    worker_floor: int
+    worker_gen: int
+    worker_max_gen: int   # highest generation ever accepted
+    respawns_left: int
+    partitions_left: int
+    kills_left: int
+    partitioned: tuple    # per driver: supervisor presumes it dead
+
+
+class EpochSpec(Spec):
+    """One durable KV, one worker slot, an original driver and one
+    supervisor respawn. Faults: a driver partition (presumed dead but
+    still writing), a worker kill. The lingering driver's own KV replica
+    is modeled per-driver (``last_notify``) — the window the worker-side
+    epoch floor exists for."""
+
+    def __init__(self, accept_stale_notify: bool = False,
+                 no_fence: bool = False, no_adoption_check: bool = False):
+        super().__init__(name="epoch", mutations=tuple(
+            m for m, on in [("accept_stale_notify", accept_stale_notify),
+                            ("no_fence", no_fence),
+                            ("no_adoption_check", no_adoption_check)]
+            if on))
+        self.accept_stale_notify = accept_stale_notify
+        self.no_fence = no_fence
+        self.no_adoption_check = no_adoption_check
+
+    def initial(self) -> EpochState:
+        d0 = DriverS(alive=True, fenced=False, epoch=1, gen=0,
+                     last_notify=None, recovered=False, writes_left=2)
+        d1 = DriverS(alive=False, fenced=False, epoch=0, gen=0,
+                     last_notify=None, recovered=False, writes_left=2)
+        return EpochState(
+            kv_epoch=1, persist_epoch=1, last_write_epoch=1,
+            write_regressed=False, kv_notify=None, drivers=(d0, d1),
+            worker_alive=True, worker_procs=1, worker_floor=1,
+            worker_gen=0, worker_max_gen=0,
+            respawns_left=1, partitions_left=1, kills_left=1,
+            partitioned=(False, False))
+
+    def actions(self, s: EpochState):
+        out = []
+        # fault: partition the original driver (supervisor thinks it
+        # crashed; the process lingers and keeps trying to act)
+        for i, d in enumerate(s.drivers):
+            if d.alive and not s.partitioned[i] and s.partitions_left > 0:
+                out.append((
+                    f"fault: driver{i} partitioned (supervisor presumes "
+                    f"it dead; process lingers)",
+                    s._replace(partitioned=_rep(s.partitioned, i, True),
+                               partitions_left=s.partitions_left - 1)))
+        # supervisor respawn: a fresh driver over the same KV dir; the
+        # durable replay bumps the persistent epoch (KVServer contract)
+        if s.respawns_left > 0 and not s.drivers[1].alive and \
+                any(s.partitioned[i] for i in range(2)):
+            new_epoch = s.persist_epoch + 1
+            rec_gen = s.kv_notify[0] if s.kv_notify else 0
+            d1 = DriverS(alive=True, fenced=False, epoch=new_epoch,
+                         gen=rec_gen, last_notify=None, recovered=False,
+                         writes_left=2)
+            out.append((
+                f"supervisor respawns driver1 (control epoch "
+                f"{s.persist_epoch} -> {new_epoch})",
+                s._replace(drivers=_rep(s.drivers, 1, d1),
+                           kv_epoch=new_epoch, persist_epoch=new_epoch,
+                           respawns_left=s.respawns_left - 1)))
+        # recovered driver's adoption pass: adopt a live (heartbeating)
+        # worker, spawn only for a dead slot
+        d1 = s.drivers[1]
+        if d1.alive and not d1.recovered:
+            if s.worker_alive and not self.no_adoption_check:
+                out.append((
+                    f"driver1 adopts live worker from "
+                    f"{kv_keys.worker_heartbeat('host', 0)}",
+                    s._replace(drivers=_rep(
+                        s.drivers, 1, d1._replace(recovered=True)))))
+            else:
+                label = ("driver1 respawns the slot (MUTATION: skipped "
+                         "the heartbeat adoption check)"
+                         if s.worker_alive else
+                         "driver1 spawns the dead slot")
+                out.append((
+                    label,
+                    s._replace(
+                        drivers=_rep(s.drivers, 1,
+                                     d1._replace(recovered=True)),
+                        worker_alive=True,
+                        worker_procs=s.worker_procs + 1,
+                        worker_floor=max(s.worker_floor, d1.epoch)
+                        if not s.worker_alive else s.worker_floor)))
+        # driver writes notify (the resize push) claiming its epoch
+        for i, d in enumerate(s.drivers):
+            if d.alive and not d.fenced and d.writes_left > 0:
+                out.append(self._write_notify(s, i))
+        # worker observes a notify — from the durable KV or from a
+        # lingering driver's replica
+        if s.worker_alive:
+            if s.kv_notify is not None:
+                act = self._observe(s, s.kv_notify, "durable KV")
+                if act is not None:
+                    out.append(act)
+            for i, d in enumerate(s.drivers):
+                if d.last_notify is not None:
+                    act = self._observe(
+                        s, d.last_notify, f"driver{i}'s lingering replica")
+                    if act is not None:
+                        out.append(act)
+        # fault: kill the worker (heartbeats stop)
+        if s.worker_alive and s.kills_left > 0:
+            out.append((
+                "fault: worker killed (heartbeats stop)",
+                s._replace(worker_alive=False,
+                           worker_procs=max(0, s.worker_procs - 1),
+                           kills_left=s.kills_left - 1)))
+        return out
+
+    def _write_notify(self, s: EpochState, i: int):
+        d = s.drivers[i]
+        gen = d.gen + 1
+        rec = (gen, d.epoch)
+        outcome, new_epoch = rules.admit_epoch(s.kv_epoch, d.epoch)
+        if outcome == rules.FENCED and not self.no_fence:
+            # the 409: the stale driver stands down; its replica still
+            # holds whatever it last served
+            return (
+                f"kv 409s driver{i}'s `{kv_keys.notify()}` write "
+                f"(offered {d.epoch} < current {s.kv_epoch}); "
+                f"driver{i} stands down",
+                s._replace(drivers=_rep(
+                    s.drivers, i,
+                    d._replace(fenced=True,
+                               writes_left=d.writes_left - 1))))
+        regressed = s.write_regressed or d.epoch < s.last_write_epoch
+        return (
+            f"driver{i} writes `{kv_keys.notify()}` = (gen {gen}, "
+            f"epoch {d.epoch})",
+            s._replace(
+                kv_epoch=new_epoch,
+                persist_epoch=max(s.persist_epoch, new_epoch),
+                last_write_epoch=max(s.last_write_epoch, d.epoch),
+                write_regressed=regressed,
+                kv_notify=rec,
+                drivers=_rep(s.drivers, i, d._replace(
+                    gen=gen, last_notify=rec,
+                    writes_left=d.writes_left - 1))))
+
+    def _observe(self, s: EpochState, rec: tuple, source: str):
+        gen, epoch = rec
+        accepted, new_floor = rules.worker_accepts(s.worker_floor, epoch)
+        if not accepted and not self.accept_stale_notify:
+            return None  # rejection is a no-op, not a transition
+        if not accepted:
+            label = (f"worker accepts STALE notify gen {gen} epoch "
+                     f"{epoch} from {source} (MUTATION: floor check "
+                     f"skipped)")
+            new_floor = s.worker_floor
+        else:
+            if gen == s.worker_gen:
+                return None
+            label = (f"worker observes notify gen {gen} (epoch {epoch}, "
+                     f"{source}); resets into it")
+        return (label, s._replace(
+            worker_floor=new_floor, worker_gen=gen,
+            worker_max_gen=max(s.worker_max_gen, gen)))
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        return [
+            Invariant(
+                "no_split_brain",
+                "once a newer-epoch driver has mutated the store, a "
+                "strictly-older epoch's mutation can never land (two "
+                "live drivers acting at the same time)",
+                lambda s: not s.write_regressed),
+            Invariant(
+                "epoch_monotone_persisted",
+                "the server epoch equals the persisted epoch file — an "
+                "adopted newer claim is durable before it fences anyone",
+                lambda s: s.kv_epoch == s.persist_epoch),
+            Invariant(
+                "worker_generation_monotonic",
+                "a worker never resets backward into an older generation "
+                "(a fenced-out driver's stale notify must not roll a "
+                "worker back)",
+                lambda s: s.worker_gen == s.worker_max_gen),
+            Invariant(
+                "no_double_spawn",
+                "the one modeled slot never has two live processes "
+                "(recovery must adopt heartbeating workers, not respawn "
+                "them)",
+                lambda s: s.worker_procs <= 1),
+        ]
+
+
+# ===========================================================================
+# Preemption drain -> shard handoff -> resize
+# ===========================================================================
+
+class DrainState(NamedTuple):
+    wphase: str            # running|announced|handed_off|exited|killed|reaped
+    committed: bool        # a commit boundary passed (shard acknowledged)
+    buddy: bool            # ring-buddy replica of the committed shard
+    kv_drain: bool         # drain/<host>/<slot> landed
+    kv_handoff: bool       # shard_handoff/w<N>/<r> landed
+    kv_drained_record: bool  # DRAINED registry record written at exit
+    drv_knows: bool        # driver registered the drain (host held out)
+    pc: int                # heartbeat program counter (index into steps)
+    drain_visible_at_hb: bool  # kv_drain at the current heartbeat's start
+    placed_on_doomed: bool
+    false_completion: bool
+    was_killed: bool
+    kills_left: int
+
+
+class DrainSpec(Spec):
+    """One draining worker, one driver heartbeat loop. The heartbeat is
+    three atomic steps whose order IS the protocol: drain scan, then
+    discovery refresh + rebalance, then reap. The PR-9 historical race
+    is re-introduced by swapping the first two (``scan_after_refresh``);
+    the reap-time last-chance drain check is removable with
+    ``no_last_chance``; ``no_buddy`` drops commit-time replication."""
+
+    def __init__(self, scan_after_refresh: bool = False,
+                 no_last_chance: bool = False, no_buddy: bool = False):
+        super().__init__(name="drain", mutations=tuple(
+            m for m, on in [("scan_after_refresh", scan_after_refresh),
+                            ("no_last_chance", no_last_chance),
+                            ("no_buddy", no_buddy)] if on))
+        self.no_last_chance = no_last_chance
+        self.no_buddy = no_buddy
+        self.steps = ["refresh", "scan"] if scan_after_refresh \
+            else ["scan", "refresh"]
+        self.steps.append("reap")
+
+    def initial(self) -> DrainState:
+        return DrainState(
+            wphase="running", committed=False, buddy=False,
+            kv_drain=False, kv_handoff=False, kv_drained_record=False,
+            drv_knows=False, pc=0, drain_visible_at_hb=False,
+            placed_on_doomed=False, false_completion=False,
+            was_killed=False, kills_left=1)
+
+    def actions(self, s: DrainState):
+        out = []
+        # -- worker side ----------------------------------------------------
+        if s.wphase in ("running", "announced") and not s.committed:
+            out.append((
+                "worker commits a step (shard acknowledged; ring-buddy "
+                "replica lands)" if not self.no_buddy else
+                "worker commits a step (MUTATION: buddy replication "
+                "skipped)",
+                s._replace(committed=True, buddy=not self.no_buddy)))
+        if s.wphase == "running":
+            out.append((
+                "SIGTERM: preemption notice (drain requested; KV "
+                "announce goes async)",
+                s._replace(wphase="announced")))
+        if s.wphase in ("announced", "handed_off") and not s.kv_drain:
+            # the announcement is asynchronous (a thread leaves the
+            # signal context) — interleavings where it lands late, or
+            # never lands before the exit, are explored for free because
+            # landing is just another action the scheduler may not pick
+            out.append((
+                f"async `{kv_keys.drain('host', 0)}` announcement lands",
+                s._replace(kv_drain=True)))
+        if s.wphase == "announced" and s.committed:
+            out.append((
+                f"worker publishes `{kv_keys.shard_handoff(2, 1)}` at "
+                "the commit boundary",
+                s._replace(wphase="handed_off", kv_handoff=True)))
+        if s.wphase == "handed_off":
+            out.append((
+                "worker records DRAINED and exits 0",
+                s._replace(wphase="exited", kv_drained_record=True)))
+        if s.wphase in ("running", "announced", "handed_off") and \
+                s.kills_left > 0:
+            out.append((
+                "fault: host dies (worker killed mid-drain)",
+                s._replace(wphase="killed", was_killed=True,
+                           kills_left=s.kills_left - 1)))
+        # -- driver heartbeat -----------------------------------------------
+        step = self.steps[s.pc]
+        out.append(self._hb_step(s, step))
+        return out
+
+    def _hb_step(self, s: DrainState, step: str):
+        nxt = (s.pc + 1) % len(self.steps)
+        ns = s._replace(pc=nxt)
+        if s.pc == 0:
+            # heartbeat begins: record what was already visible
+            ns = ns._replace(drain_visible_at_hb=s.kv_drain)
+        if step == "scan":
+            if s.kv_drain:
+                return ("driver heartbeat: drain scan sees "
+                        f"`{kv_keys.drain('host', 0)}`; host held out",
+                        ns._replace(drv_knows=True))
+            return "driver heartbeat: drain scan (nothing announced)", ns
+        if step == "refresh":
+            includes = not s.drv_knows
+            doomed = s.placed_on_doomed or (
+                includes and ns.drain_visible_at_hb)
+            label = ("driver heartbeat: refresh + rebalance "
+                     + ("EXCLUDES the draining host"
+                        if not includes else "places onto the host"))
+            return label, ns._replace(placed_on_doomed=doomed)
+        # reap
+        if s.wphase == "exited":
+            if s.drv_knows:
+                return ("driver reap: known drain exited (clean "
+                        "departure)", ns._replace(wphase="reaped"))
+            last_chance = (s.kv_drain or s.kv_drained_record) and \
+                not self.no_last_chance
+            if last_chance:
+                return ("driver reap: exit 0 + last-chance drain check "
+                        "hits (KV key / DRAINED record) -> treated as "
+                        "drain", ns._replace(wphase="reaped",
+                                             drv_knows=True))
+            return ("driver reap: exit 0 misread as JOB COMPLETION",
+                    ns._replace(wphase="reaped", false_completion=True))
+        if s.wphase == "killed":
+            return ("driver reap: kill detected -> failure path "
+                    "(blacklist/rebalance)", ns._replace(wphase="reaped"))
+        return "driver heartbeat: reap (nothing exited)", ns
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        def no_shard_loss(s: DrainState) -> bool:
+            if not s.committed or not s.was_killed:
+                return True
+            return s.kv_handoff or s.buddy
+
+        return [
+            Invariant(
+                "no_false_completion",
+                "a drained worker's exit 0 is never misread as job "
+                "completion (the PR-9 same-heartbeat race)",
+                lambda s: not s.false_completion),
+            Invariant(
+                "no_placement_on_announced_host",
+                "a rebalance never places onto a host whose drain "
+                "announcement was visible before the heartbeat began "
+                "(drain scan runs before discovery refresh)",
+                lambda s: not s.placed_on_doomed),
+            Invariant(
+                "no_acknowledged_shard_loss",
+                "once a commit acknowledged the shard, a kill leaves a "
+                "recovery source (KV handoff or ring-buddy replica)",
+                no_shard_loss),
+        ]
+
+
+# ===========================================================================
+# Cycle-boundary TunedParams broadcast
+# ===========================================================================
+
+class TuneState(NamedTuple):
+    staged: int     # version staged on the coordinator
+    applied: tuple  # per rank: applied version
+    pushes_left: int
+
+
+class TuneSpec(Spec):
+    """The frontend tuner pushes knob records (``hvdtpu_set_tuned_params``)
+    that must be adopted by EVERY rank at the same coordination-cycle
+    boundary — rank-divergent fusion knobs desync exec order. The
+    ``apply_inline`` mutation re-introduces the hazard the staged
+    broadcast exists to prevent: applying the push immediately on the
+    coordinator."""
+
+    def __init__(self, ranks: int = 2, apply_inline: bool = False):
+        super().__init__(name="tune", mutations=tuple(
+            m for m, on in [("apply_inline", apply_inline)] if on))
+        self.ranks = ranks
+        self.apply_inline = apply_inline
+
+    def initial(self) -> TuneState:
+        return TuneState(staged=0, applied=(0,) * self.ranks,
+                         pushes_left=2)
+
+    def actions(self, s: TuneState):
+        # A lost/aborted param broadcast needs no explicit fault action:
+        # "the cycle didn't apply" is just the scheduler never picking
+        # the cycle transition, which the interleaving exploration
+        # already covers (a real broadcast failure fast-aborts the whole
+        # cycle — CycleSpec's territory).
+        out = []
+        if s.pushes_left > 0:
+            v = s.staged + 1
+            applied = s.applied
+            label = f"tuner pushes TunedParams v{v} (staged)"
+            if self.apply_inline:
+                applied = _rep(applied, 0, v)
+                label = (f"tuner pushes TunedParams v{v} (MUTATION: "
+                         "applied inline on the coordinator)")
+            out.append((label, s._replace(
+                staged=v, applied=applied,
+                pushes_left=s.pushes_left - 1)))
+        out.append((
+            f"cycle boundary: SynchronizeParameters broadcast applies "
+            f"v{s.staged} on every rank",
+            s._replace(applied=(s.staged,) * self.ranks)))
+        return out
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        return [
+            Invariant(
+                "params_agree_between_cycles",
+                "between coordination cycles every rank runs the same "
+                "applied TunedParams (rank-divergent fusion/express "
+                "knobs desync exec order)",
+                lambda s: len(set(s.applied)) == 1),
+            Invariant(
+                "applied_never_ahead_of_staged",
+                "no rank applies a params version the coordinator has "
+                "not staged",
+                lambda s: all(v <= s.staged for v in s.applied)),
+        ]
+
+
+# ===========================================================================
+# Registries
+# ===========================================================================
+
+SPECS: Dict[str, type] = {
+    "cycle": CycleSpec,
+    "epoch": EpochSpec,
+    "drain": DrainSpec,
+    "tune": TuneSpec,
+}
+
+# mutant name -> (spec name, constructor kwarg, description). Each is a
+# seeded historical bug (or a deliberate weakening proving an invariant
+# has teeth); `hvd-check --mutant <name>` must find a counterexample.
+MUTANTS: Dict[str, Tuple[str, str, str]] = {
+    "drain_scan_after_refresh": (
+        "drain", "scan_after_refresh",
+        "PR-9 same-heartbeat drain race: the heartbeat refreshed "
+        "discovery before scanning drain keys, so a rebalance could "
+        "place onto a host whose drain was already announced"),
+    "drain_no_last_chance": (
+        "drain", "no_last_chance",
+        "PR-9 satellite: without the reap-time last-chance KV/registry "
+        "check, a fast drain's exit 0 reads as job completion"),
+    "drain_no_buddy": (
+        "drain", "no_buddy",
+        "commit-time ring-buddy replication removed: a kill between "
+        "commit and handoff loses the acknowledged shard"),
+    "epoch_accept_stale_notify": (
+        "epoch", "accept_stale_notify",
+        "PR-10 bug: a worker without the epoch floor accepts a "
+        "fenced-out pre-crash driver's stale notify and resets backward "
+        "into an older generation"),
+    "epoch_no_fence": (
+        "epoch", "no_fence",
+        "KV-side 409 fencing removed: a lingering older-epoch driver's "
+        "mutation lands after the recovered driver's (split-brain)"),
+    "epoch_no_adoption_check": (
+        "epoch", "no_adoption_check",
+        "driver recovery spawns every expected slot without the "
+        "heartbeat adoption check: live workers get double-spawned"),
+    "cycle_rank_divergent_express": (
+        "cycle", "rank_divergent_express",
+        "rank-divergent express-lane partition (serving-mode hazard "
+        "class): ranks peel different response sets onto the express "
+        "lane and execute collectives in different orders"),
+    "cycle_abort_ignored": (
+        "cycle", "ignore_abort",
+        "fast-abort flag dropped from the coordination word: a crash or "
+        "hvdtpu_abort signal is never honored and cycles keep "
+        "negotiating past it"),
+    "tune_apply_inline": (
+        "tune", "apply_inline",
+        "TunedParams applied inline at push instead of staged for the "
+        "cycle-boundary broadcast: the coordinator runs different knobs "
+        "than its peers mid-cycle"),
+}
+
+
+def make_spec(name: str, mutant: Optional[str] = None) -> Spec:
+    """Instantiate a spec, optionally with one seeded mutation."""
+    if mutant is not None:
+        spec_name, kwarg, _ = MUTANTS[mutant]
+        if name not in (None, spec_name):
+            raise ValueError(f"mutant {mutant} belongs to spec "
+                             f"{spec_name}, not {name}")
+        return SPECS[spec_name](**{kwarg: True})
+    return SPECS[name]()

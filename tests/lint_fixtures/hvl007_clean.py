@@ -1,0 +1,24 @@
+"""HVL007 clean: keys built through the typed registry.
+
+Docstrings may cite patterns like ``drain/<host>/<slot>`` freely — only
+constructed keys are in scope.
+"""
+
+from horovod_tpu.common import kv_keys
+
+
+def announce(client, host, slot):
+    client.put_json(kv_keys.drain(host, slot), {"ts": 0})
+
+
+def gc(kv, gen):
+    kv.delete_prefix(kv_keys.rank_and_size_prefix(gen))
+
+
+def discover(client):
+    return client.get_json(kv_keys.metrics_targets())
+
+
+def unrelated(client):
+    # non-KV strings that merely mention family words are fine
+    return client.get_json("generation_report/summary".split("/")[0] + "x")

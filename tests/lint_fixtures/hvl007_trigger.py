@@ -1,0 +1,16 @@
+"""HVL007 trigger: raw KV key construction in every flagged form."""
+
+
+def announce(client, host, slot):
+    # f-string with a registered family prefix
+    client.put_json(f"drain/{host}/{slot}", {"ts": 0})
+
+
+def gc(kv, gen):
+    # plain literal participating in concatenation
+    kv.delete_prefix("rank_and_size/g" + str(gen) + "/")
+
+
+def discover(client):
+    # singleton key passed straight to a KV accessor
+    return client.get_json("metrics_targets")

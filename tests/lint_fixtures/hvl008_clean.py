@@ -1,0 +1,20 @@
+"""HVL008 clean: every driver-originated mutation claims the epoch."""
+
+from horovod_tpu.runner.http_kv import KVServer
+
+
+class Driver:
+    def __init__(self):
+        self.kv = KVServer(port=0)
+        self.epoch = self.kv.epoch
+
+    def push(self, key, value):
+        self.kv.put_json(key, value, epoch=self.epoch)
+
+    def gc(self, prefix, key):
+        self.kv.delete_prefix(prefix, epoch=self.epoch)
+        self.kv.delete(key, epoch=self.epoch)
+
+    def read(self, key):
+        # reads never claim (get_json is not a mutation)
+        return self.kv.get_json(key)

@@ -1,0 +1,17 @@
+"""HVL008 trigger: a driver-side module (owns a KVServer) mutating the
+store without claiming its control epoch."""
+
+from horovod_tpu.runner.http_kv import KVServer
+
+
+class Driver:
+    def __init__(self):
+        self.kv = KVServer(port=0)
+        self.epoch = self.kv.epoch
+
+    def push(self, key, value):
+        self.kv.put_json(key, value)          # missing epoch claim
+
+    def gc(self, prefix, key):
+        self.kv.delete_prefix(prefix)         # missing epoch claim
+        self.kv.delete(key)                   # missing epoch claim

@@ -1,0 +1,13 @@
+"""HVL104 clean pair, Python side."""
+
+import ctypes
+
+ABI_VERSION = 3
+
+
+def load(lib):
+    lib.hvdtpu_abi_version.restype = ctypes.c_int32
+    lib.hvdtpu_widget_poke.restype = ctypes.c_int32
+    lib.hvdtpu_widget_poke.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_double]
+    return lib

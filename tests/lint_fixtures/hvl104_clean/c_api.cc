@@ -1,0 +1,11 @@
+// HVL104 clean pair, C side.
+
+extern "C" {
+
+int32_t hvdtpu_abi_version() { return 3; }
+
+int32_t hvdtpu_widget_poke(int64_t session, int32_t flags, double scale) {
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,14 @@
+"""HVL104 trigger pair, Python side."""
+
+import ctypes
+
+ABI_VERSION = 8  # drifted: the C side returns 9
+
+
+def load(lib):
+    # arity drift: the C signature takes 3 parameters
+    lib.hvdtpu_widget_poke.restype = ctypes.c_int32
+    lib.hvdtpu_widget_poke.argtypes = [ctypes.c_int64, ctypes.c_int32]
+    # referenced symbol the C side does not export
+    lib.hvdtpu_widget_missing.restype = ctypes.c_int32
+    return lib

@@ -1,0 +1,16 @@
+// HVL104 trigger pair, C side: version drift + an export the bindings
+// never reference.
+
+extern "C" {
+
+int32_t hvdtpu_abi_version() { return 9; }
+
+// bound in bindings.py but with the wrong argtypes arity there
+int32_t hvdtpu_widget_poke(int64_t session, int32_t flags, double scale) {
+  return 0;
+}
+
+// never referenced by the bindings at all
+int64_t hvdtpu_widget_forgotten(int64_t session) { return -1; }
+
+}  // extern "C"

@@ -228,6 +228,16 @@ def test_chaos_soak_64_ranks_with_driver_kills(tmp_path):
         assert len(cp.epochs) > pre_soak_epochs, \
             "seeded schedule produced no driver_kill event"
         assert cp.epochs == sorted(cp.epochs)  # epochs only move forward
+        # Every soak run doubles as a conformance oracle: replay the
+        # surviving WAL against the protocol rules (typed key registry,
+        # epoch monotonicity). Export BEFORE asserting — a diverging
+        # soak is precisely the one whose WAL `make conformance` must be
+        # able to replay after the tmp dir is gone.
+        cp.kill()
+        from horovod_tpu.verify import conformance
+        conformance.copy_soak_artifacts(kv_dir=cp.kv_dir)
+        divergences = conformance.check_kv_wal(cp.kv_dir)
+        assert divergences == [], divergences
     finally:
         cp.close()
         headless._reset_for_tests()

@@ -698,6 +698,13 @@ def test_driver_restart_smoke_subprocess(tmp_path):
     # and the worker logs survived in the durable dir
     logs = os.listdir(os.path.join(str(tmp_path / "kvdir"), "logs"))
     assert len(logs) == 2
+    # the surviving WAL — a REAL driver-crash-and-recovery trace — must
+    # replay clean against the protocol specs' rules (hvd-check
+    # conformance: typed key registry, epoch monotonicity, go-barrier
+    # ordering)
+    from horovod_tpu.verify import conformance
+    divergences = conformance.check_kv_wal(str(tmp_path / "kvdir"))
+    assert divergences == [], divergences
 
 
 # ---------------------------------------------------------------------------

@@ -37,9 +37,12 @@ def rules_in(*names, rules=None):
     ("HVL003", "hvl003_trigger.py", "hvl003_clean.py"),
     ("HVL004", "hvl004_trigger.py", "hvl004_clean.py"),
     ("HVL005", "hvl005_trigger.py", "hvl005_clean.py"),
+    ("HVL007", "hvl007_trigger.py", "hvl007_clean.py"),
+    ("HVL008", "hvl008_trigger.py", "hvl008_clean.py"),
     ("HVL101", "hvl101_trigger.cc", "hvl101_clean.cc"),
     ("HVL102", "hvl102_trigger.cc", "hvl102_clean.cc"),
     ("HVL103", "hvl103_trigger.h", "hvl103_clean.h"),
+    ("HVL104", "hvl104_trigger", "hvl104_clean"),  # (c_api, bindings) pairs
 ])
 def test_rule_fixture_pair(rule, trigger, clean):
     _, fired = rules_in(trigger, rules={rule})
@@ -166,11 +169,59 @@ def test_registry_covers_the_contract():
     assert len(cpp) >= 20  # engine-side vars are declared too
 
 
+def test_hvl007_names_all_three_forms():
+    findings, _ = rules_in("hvl007_trigger.py", rules={"HVL007"})
+    messages = "\n".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "f-string" in messages
+    assert "string literal" in messages
+    assert "singleton key" in messages
+    assert "kv_keys" in messages
+
+
+def test_hvl008_flags_each_mutator_once():
+    findings, _ = rules_in("hvl008_trigger.py", rules={"HVL008"})
+    assert sorted(f.message.split("`")[1] for f in findings) == \
+        ["delete", "delete_prefix", "put_json"]
+
+
+def test_hvl008_ignores_client_only_modules():
+    # worker-side modules (no KVServer ownership) write epoch-less by
+    # design; the rule must not fire there
+    findings, fired = rules_in("hvl007_trigger.py", rules={"HVL008"})
+    assert fired == []
+
+
+def test_hvl104_names_all_four_drift_kinds():
+    findings, _ = rules_in("hvl104_trigger", rules={"HVL104"})
+    messages = "\n".join(f.message for f in findings)
+    assert "ABI version drift" in messages
+    assert "never referenced" in messages          # hvdtpu_widget_forgotten
+    assert "does not export" in messages           # hvdtpu_widget_missing
+    assert "ctypes will silently corrupt" in messages  # arity drift
+    assert len(findings) == 4
+
+
+def test_hvl104_real_abi_pair_is_in_sync():
+    # the same agreement the load-time handshake enforces dynamically,
+    # proven statically: version literal + export/reference sets + arity
+    from horovod_tpu.verify.engine_constants import (abi_version,
+                                                     bindings_view,
+                                                     c_exports)
+    abi, argtype_lens, referenced = bindings_view()
+    assert abi == abi_version()
+    exports = c_exports()
+    assert set(exports) <= referenced | {"hvdtpu_abi_version"}
+    for sym, n in argtype_lens.items():
+        assert exports[sym] == n, sym
+
+
 def test_all_rules_have_fixture_coverage():
     # every advertised rule id appears in this test module's fixtures or
     # dedicated tests above; guards against adding a rule without tests
     covered = {"HVL001", "HVL002", "HVL003", "HVL004", "HVL005",
-               "HVL006", "HVL101", "HVL102", "HVL103"}
+               "HVL006", "HVL007", "HVL008",
+               "HVL101", "HVL102", "HVL103", "HVL104"}
     assert covered == set(RULES)
 
 

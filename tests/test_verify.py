@@ -1,0 +1,441 @@
+"""hvd-check: protocol specs, model checker, seeded mutants, and runtime
+trace conformance (ISSUE 13).
+
+The acceptance matrix: (a) the four specs explore EXHAUSTIVELY at the CI
+depth bound with zero invariant violations; (b) every seeded historical
+bug (the PR-9 same-heartbeat drain race, the PR-10 stale-epoch notify
+acceptance, the rank-divergent express-lane partition, and friends)
+produces a counterexample; (c) the spec constants agree with the real
+code they model (engine flag bits, the C ABI, the KV epoch rule, the
+worker floor); (d) conformance mode replays real artifacts — a live
+KVServer's WAL and real 2-rank engine flight dumps — end to end and
+flags crafted divergences.
+"""
+
+import base64
+import json
+import uuid
+import zlib
+
+import pytest
+
+from horovod_tpu.common import kv_keys
+from horovod_tpu.verify import (MUTANTS, SPECS, check, conformance,
+                                make_spec)
+from horovod_tpu.verify import engine_constants, rules
+from horovod_tpu.verify.cli import CI_DEPTH, CI_MAX_STATES
+from horovod_tpu.verify.cli import main as check_main
+
+# ---------------------------------------------------------------------------
+# spec constants vs the real code
+
+
+def test_flag_bits_parsed_from_controller():
+    flags = engine_constants.flag_bits()
+    # the protocols modeled here ride these exact flags
+    assert {"kFlagUncached", "kFlagShutdown", "kFlagJoin",
+            "kFlagStallReport", "kFlagAbort"} <= set(flags)
+    bits = list(flags.values())
+    assert len(bits) == len(set(bits)), "flag bits must be distinct"
+
+
+def test_abi_version_matches_bindings():
+    abi, _, _ = engine_constants.bindings_view()
+    assert engine_constants.abi_version() == abi
+
+
+def test_express_threshold_parsed():
+    assert engine_constants.low_latency_threshold_default() > 0
+
+
+def test_epoch_rule_agrees_with_real_kv_server():
+    """rules.admit_epoch IS KVServer._check_epoch_locked — proven on the
+    live implementation, not by reading it."""
+    from horovod_tpu.runner.http_kv import KVServer, StaleEpochError
+    for current in (0, 1, 3):
+        for claimed in (None, 0, 1, 2, 3, 5):
+            srv = KVServer(port=0)
+            srv.epoch = current
+            outcome, new_epoch = rules.admit_epoch(current, claimed)
+            try:
+                srv._put("notify", b"{}", epoch=claimed)
+                real = rules.ADOPT if srv.epoch > current else rules.OK
+            except StaleEpochError:
+                real = rules.FENCED
+            assert real == outcome, (current, claimed)
+            assert srv.epoch == new_epoch, (current, claimed)
+            srv._httpd.server_close()
+
+
+def test_worker_floor_agrees_with_observe_epoch(monkeypatch):
+    from horovod_tpu.runner.elastic import worker
+    monkeypatch.setenv("HOROVOD_CONTROL_EPOCH", "2")
+    for offered in (None, 0, 1, 2, 3):
+        worker._reset_epoch_for_tests()
+        accepted, floor = rules.worker_accepts(2, offered)
+        assert worker.observe_epoch(offered) == accepted, offered
+        if accepted and offered is not None:
+            assert worker._epoch_floor == floor
+    worker._reset_epoch_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# typed KV key registry
+
+
+def test_every_builder_roundtrips_through_match():
+    cases = {
+        kv_keys.generation(): "generation",
+        kv_keys.control_epoch(): "control_epoch",
+        kv_keys.notify(): "notify",
+        kv_keys.go(3): "go",
+        kv_keys.rank_and_size(2, "hostA", 1): "rank_and_size",
+        kv_keys.worker_state(0, "h", 0): "worker_state",
+        kv_keys.worker_heartbeat("h", 4): "worker_heartbeat",
+        kv_keys.drain("h", 0): "drain",
+        kv_keys.shard_handoff(8, 3): "shard_handoff",
+        kv_keys.reset_request(9): "reset_request",
+        kv_keys.straggler(1, 5): "straggler",
+        kv_keys.anomaly(1, 5): "anomaly",
+        kv_keys.metrics_targets(): "metrics_targets",
+        kv_keys.serve_targets(): "serve_targets",
+        kv_keys.serve_addr("h", 0): "serve_addr",
+        kv_keys.serve_stop(): "serve_stop",
+        kv_keys.metrics_addr("h", 0): "metrics_addr",
+        kv_keys.tune_config("job"): "tune_config",
+        kv_keys.tune_epoch("job", 7): "tune_epoch",
+        kv_keys.task_fn(): "task_fn",
+        kv_keys.task_started(3): "task_started",
+        kv_keys.task_result(0, 3): "task_result",
+        kv_keys.cluster_controller("j", 1): "cluster_controller",
+        kv_keys.subset_ports([0, 2], 1): "subset_ports",
+    }
+    for key, family in cases.items():
+        m = kv_keys.match(key)
+        assert m is not None and m[0] == family, (key, m)
+    assert kv_keys.match("freeform/unregistered") is None
+
+
+def test_match_extracts_args_and_prefixes_scope_gc():
+    _, args = kv_keys.match(kv_keys.rank_and_size(7, "host3", 2))
+    assert args == {"gen": "7", "host": "host3", "local_rank": "2"}
+    assert kv_keys.match_prefix(kv_keys.rank_and_size_prefix(7)) == \
+        "rank_and_size"
+    assert kv_keys.match_prefix("bogus_namespace/") is None
+    # g1 must not swallow g10 (the trailing-slash contract)
+    assert kv_keys.rank_and_size_prefix(1) != \
+        kv_keys.rank_and_size(10, "h", 0)[:len(
+            kv_keys.rank_and_size_prefix(1))]
+
+
+def test_registry_writer_roles_partition_epoch_claims():
+    for fam in kv_keys.FAMILIES.values():
+        assert fam.writer in ("driver", "worker", "serve-worker", "tuner",
+                              "task")
+        assert fam.epoch_claimed == (fam.writer == "driver"), fam.name
+
+
+# ---------------------------------------------------------------------------
+# the checker: exhaustive clean runs at the CI bound
+
+
+@pytest.mark.parametrize("spec_name", sorted(SPECS))
+def test_spec_exhaustive_and_clean_at_ci_depth(spec_name):
+    res = check(make_spec(spec_name), depth=CI_DEPTH,
+                max_states=CI_MAX_STATES)
+    assert res.violations == [], res.violations[0].render()
+    assert not res.truncated, \
+        f"{spec_name} no longer closes at the CI depth bound"
+    assert res.states > 5  # a spec that degenerates to nothing is a bug
+
+
+def test_fault_actions_are_reachable():
+    """The exploration actually injects faults — a crash, an abort, a
+    kill, and a partition each appear on some explored transition."""
+    seen = set()
+
+    def walk(spec, depth):
+        frontier, visited = [(spec.initial(), 0)], set()
+        while frontier:
+            s, d = frontier.pop()
+            if s in visited or d >= depth:
+                continue
+            visited.add(s)
+            for label, succ in spec.actions(s):
+                if label.startswith("fault:"):
+                    seen.add(label.split()[1] + " " + label.split()[2])
+                frontier.append((succ, d + 1))
+
+    for name in SPECS:
+        walk(make_spec(name), 6)
+    assert any("crashes" in x for x in seen), seen
+    assert any("partitioned" in x for x in seen), seen
+
+
+# ---------------------------------------------------------------------------
+# seeded historical-bug mutants -> counterexamples
+
+HISTORICAL = ["drain_scan_after_refresh", "epoch_accept_stale_notify",
+              "cycle_rank_divergent_express"]
+
+
+@pytest.mark.parametrize("mutant", sorted(MUTANTS))
+def test_every_mutant_produces_a_counterexample(mutant):
+    spec = make_spec(MUTANTS[mutant][0], mutant=mutant)
+    res = check(spec, depth=CI_DEPTH, max_states=CI_MAX_STATES)
+    assert res.violations, f"seeded bug {mutant} was not caught"
+    v = res.violations[0]
+    assert v.trace, "counterexample must carry an event sequence"
+    rendered = v.render()
+    assert "INVARIANT VIOLATED" in rendered
+    assert " 1. " in rendered  # numbered, readable event list
+
+
+def test_historical_bugs_hit_their_named_invariants():
+    expectations = {
+        "drain_scan_after_refresh": "no_placement_on_announced_host",
+        "epoch_accept_stale_notify": "worker_generation_monotonic",
+        "cycle_rank_divergent_express": "exec_order_agreement",
+    }
+    for mutant in HISTORICAL:
+        res = check(make_spec(MUTANTS[mutant][0], mutant=mutant),
+                    depth=CI_DEPTH)
+        assert res.violations[0].invariant == expectations[mutant]
+
+
+def test_counterexamples_are_shortest_first():
+    # BFS contract: the drain-race counterexample is its minimal repro
+    res = check(make_spec("drain", mutant="drain_scan_after_refresh"),
+                depth=CI_DEPTH)
+    assert len(res.violations[0].trace) <= 4, res.violations[0].render()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_all_specs_clean_exit_zero(capsys):
+    assert check_main([]) == 0
+    out = capsys.readouterr().out
+    assert "exhaustive" in out
+
+
+def test_cli_mutant_exits_one_and_prints_trace(capsys):
+    assert check_main(["--mutant", "epoch_accept_stale_notify"]) == 1
+    out = capsys.readouterr().out
+    assert "INVARIANT VIOLATED" in out
+    assert "reproduced" in out
+
+
+def test_cli_lists_and_json(capsys):
+    assert check_main(["--list-specs"]) == 0
+    assert check_main(["--list-mutants"]) == 0
+    assert check_main(["--spec", "tune", "--json"]) == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index("{"):])
+    assert doc["results"][0]["spec"] == "tune"
+    assert doc["results"][0]["exhaustive"] is True
+
+
+# ---------------------------------------------------------------------------
+# conformance: KV WAL replay
+
+
+def _driver_shaped_session(tmp_path):
+    """A real KVServer run shaped like a two-generation elastic job."""
+    from horovod_tpu.runner.http_kv import KVServer
+    kv = KVServer(port=0, kv_dir=str(tmp_path))
+    epoch = kv.epoch
+    for gen in (0, 1):
+        for slot in (0, 1):
+            kv.put_json(kv_keys.rank_and_size(gen, "localhost", slot),
+                        {"rank": slot, "size": 2, "epoch": epoch},
+                        epoch=epoch)
+            kv.put_json(kv_keys.worker_state(gen, "localhost", slot),
+                        {"state": "READY"})
+        kv.put_json(kv_keys.generation(),
+                    {"generation": gen, "epoch": epoch}, epoch=epoch)
+        kv.put_json(kv_keys.go(gen), {"ts": 1.0, "epoch": epoch},
+                    epoch=epoch)
+        kv.put_json(kv_keys.notify(),
+                    {"generation": gen, "epoch": epoch}, epoch=epoch)
+    kv.put_json(kv_keys.drain("localhost", 1), {"generation": 1})
+    kv.delete(kv_keys.go(0), epoch=epoch)
+    kv.delete_prefix(kv_keys.rank_and_size_prefix(0), epoch=epoch)
+    kv._httpd.server_close()
+    if kv._wal:
+        kv._wal.close()
+    return epoch
+
+
+def _append_wal_record(tmp_path, op: dict):
+    payload = json.dumps(op).encode()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    with open(tmp_path / "wal.log", "ab") as f:
+        f.write(len(payload).to_bytes(4, "little") +
+                crc.to_bytes(4, "little") + payload)
+
+
+def test_kv_wal_conformance_clean_on_real_session(tmp_path):
+    _driver_shaped_session(tmp_path)
+    assert conformance.check_kv_wal(tmp_path) == []
+
+
+def test_kv_wal_flags_epoch_regression(tmp_path):
+    epoch = _driver_shaped_session(tmp_path)
+    # a fenced-out stale driver's write landing is exactly what the live
+    # KV's 409 prevents — craft it into the WAL and the replay must see
+    # the split-brain
+    _append_wal_record(tmp_path, {
+        "op": "put", "k": kv_keys.notify(),
+        "v": base64.b64encode(b'{"generation": 0}').decode(),
+        "e": epoch - 1})
+    divs = conformance.check_kv_wal(tmp_path)
+    assert any("split-brain" in d for d in divs), divs
+
+
+def test_kv_wal_flags_unregistered_key(tmp_path):
+    _driver_shaped_session(tmp_path)
+    _append_wal_record(tmp_path, {
+        "op": "put", "k": "rogue_namespace/x",
+        "v": base64.b64encode(b"{}").decode()})
+    divs = conformance.check_kv_wal(tmp_path)
+    assert any("no registered family" in d for d in divs), divs
+
+
+def test_kv_wal_flags_go_before_topology(tmp_path):
+    from horovod_tpu.runner.http_kv import KVServer
+    kv = KVServer(port=0, kv_dir=str(tmp_path))
+    kv.put_json(kv_keys.go(5), {"ts": 1.0, "epoch": kv.epoch},
+                epoch=kv.epoch)
+    kv._httpd.server_close()
+    kv._wal.close()
+    divs = conformance.check_kv_wal(tmp_path)
+    assert any("go barrier released before" in d for d in divs), divs
+
+
+def test_kv_wal_generation_regression_flagged(tmp_path):
+    from horovod_tpu.runner.http_kv import KVServer
+    kv = KVServer(port=0, kv_dir=str(tmp_path))
+    kv.put_json(kv_keys.generation(), {"generation": 4}, epoch=kv.epoch)
+    kv.put_json(kv_keys.generation(), {"generation": 2}, epoch=kv.epoch)
+    kv._httpd.server_close()
+    kv._wal.close()
+    divs = conformance.check_kv_wal(tmp_path)
+    assert any("generation regressed" in d for d in divs), divs
+
+
+def test_kv_wal_survives_snapshot_compaction(tmp_path):
+    """go/gN ordering must consult the snapshot: compaction truncates
+    the WAL, so the topology writes may predate it."""
+    from horovod_tpu.runner.http_kv import KVServer
+    kv = KVServer(port=0, kv_dir=str(tmp_path), snapshot_bytes=1)
+    e = kv.epoch
+    kv.put_json(kv_keys.rank_and_size(3, "h", 0), {"rank": 0}, epoch=e)
+    # snapshot_bytes=1: every append compacts; the WAL the go lands in
+    # no longer holds the topology record
+    kv.put_json(kv_keys.go(3), {"ts": 1.0}, epoch=e)
+    kv._httpd.server_close()
+    kv._wal.close()
+    assert conformance.check_kv_wal(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# conformance: flight dumps (synthetic + real engine)
+
+
+def _dump(rank, size, order, sigs=None):
+    events = []
+    for i, name in enumerate(order):
+        for phase in ("ENQUEUE", "NEGOTIATE", "EXEC", "DONE"):
+            e = {"name": name, "phase": phase,
+                 "ts_us": 1000.0 * i + {"ENQUEUE": 0, "NEGOTIATE": 1,
+                                        "EXEC": 2, "DONE": 3}[phase]}
+            if phase == "NEGOTIATE" and sigs:
+                e["aux"] = sigs.get(name, 0)
+            events.append(e)
+    return {"rank": rank, "size": size, "events": events}
+
+
+def test_flight_conformance_agreeing_ranks_clean():
+    dumps = {0: _dump(0, 2, ["a", "b", "c"]),
+             1: _dump(1, 2, ["a", "b", "c"])}
+    assert conformance.check_flight_dumps(dumps) == []
+
+
+def test_flight_conformance_ring_wrap_suffix_is_clean():
+    # rank 1's ring wrapped: it only retains a suffix — still conformant
+    dumps = {0: _dump(0, 2, ["a", "b", "c"]),
+             1: _dump(1, 2, ["b", "c"])}
+    assert conformance.check_flight_dumps(dumps) == []
+
+
+def test_flight_conformance_flags_exec_reorder():
+    dumps = {0: _dump(0, 2, ["a", "b", "c"]),
+             1: _dump(1, 2, ["a", "c", "b"])}
+    divs = conformance.check_flight_dumps(dumps)
+    assert any("exec-order divergence" in d for d in divs), divs
+
+
+def test_flight_conformance_flags_signature_mismatch():
+    dumps = {0: _dump(0, 2, ["a"], sigs={"a": 111}),
+             1: _dump(1, 2, ["a"], sigs={"a": 222})}
+    divs = conformance.check_flight_dumps(dumps)
+    assert any("signature mismatch" in d.lower() for d in divs), divs
+
+
+def test_real_engine_flight_dumps_conform(tmp_path):
+    """End-to-end on the real engine: a healthy 2-rank loopback job's
+    dumps replay clean; check_artifacts finds and validates them."""
+    from horovod_tpu.engine import OP_ALLREDUCE, EngineSession
+    group = f"verify-{uuid.uuid4().hex[:8]}"
+    sessions = [EngineSession(rank=r, size=2, transport="loopback",
+                              group=group, cycle_time_ms=1.0)
+                for r in range(2)]
+    try:
+        for step in range(3):
+            handles = [s.enqueue(f"grad.{step}", OP_ALLREDUCE, "float32",
+                                 [16]) for s in sessions]
+            for s, h in zip(sessions, handles):
+                s.wait(h, timeout=10.0)
+        for s in sessions:
+            s.flight_dump(str(tmp_path))
+    finally:
+        for s in sessions:
+            s._lib.hvdtpu_shutdown(s._session)
+        for s in sessions:
+            s.destroy()
+    report = conformance.check_artifacts(tmp_path)
+    assert report["divergences"] == [], report
+    assert any("flight" in c for c in report["checked"])
+
+
+def test_cli_conformance_end_to_end(tmp_path, capsys):
+    _driver_shaped_session(tmp_path / "kv")
+    assert check_main(["--conformance", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "0 divergence(s)" in out
+    _append_wal_record(tmp_path / "kv", {
+        "op": "put", "k": "rogue/x",
+        "v": base64.b64encode(b"{}").decode()})
+    assert check_main(["--conformance", str(tmp_path)]) == 1
+
+
+def test_flight_analyzer_carries_conformance_lines():
+    from horovod_tpu.profiler import flight
+    dumps = {0: _dump(0, 2, ["a", "b"]), 1: _dump(1, 2, ["b", "a"])}
+    verdict = flight.analyze(dumps)
+    assert any("protocol conformance" in line
+               for line in verdict["lines"]), verdict["lines"]
+    assert verdict["conformance"]
+
+
+def test_soak_artifact_copy_roundtrip(tmp_path, monkeypatch):
+    src = tmp_path / "src"
+    src.mkdir()
+    _driver_shaped_session(src)
+    dest = tmp_path / "artifacts"
+    monkeypatch.setenv("HOROVOD_SOAK_ARTIFACT_DIR", str(dest))
+    assert conformance.copy_soak_artifacts(kv_dir=str(src)) == str(dest)
+    report = conformance.check_artifacts(dest)
+    assert report["divergences"] == [], report
