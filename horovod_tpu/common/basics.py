@@ -42,6 +42,7 @@ class _HorovodTpuContext:
         self.local_size = 1
         self.cross_rank = 0
         self.cross_size = 1
+        self._has_host_map = False
         self.mesh = None
         self.engine = None  # native engine session, when booted
         self.metrics_exporter = None  # HOROVOD_METRICS_PORT endpoint
@@ -79,6 +80,14 @@ class _HorovodTpuContext:
             self.local_size = env_int("HOROVOD_LOCAL_SIZE")
             self.cross_rank = env_int("HOROVOD_CROSS_RANK", self.rank)
             self.cross_size = env_int("HOROVOD_CROSS_SIZE", self.size)
+            # A host-locality map exists only when the launcher actually
+            # exported one — the env defaults above (cross_rank=rank)
+            # would otherwise make every rank of a hand-rolled
+            # multi-process job look like its own host, silently turning
+            # on the engine's topology exchange (and with it the
+            # hierarchical route, degenerate at one rank per "host").
+            self._has_host_map = (env_is_set("HOROVOD_CROSS_RANK") or
+                                  env_is_set("HOROVOD_CROSS_SIZE"))
             # From here on every hvd_logging record carries rank/local_rank
             # so multi-rank logs interleave legibly (re-stamped below if a
             # comm= subset re-ranks this process).
@@ -121,6 +130,9 @@ class _HorovodTpuContext:
                     self.size = len(members)
                     self.cross_rank = self.rank
                     self.cross_size = self.size
+                    # synthetic cross dims — the subset's physical host
+                    # placement is unknown, so no locality map
+                    self._has_host_map = False
                     # keep the context self-consistent: world-scoped local
                     # dims can exceed the subset (local placement of the
                     # other members is unknown here)
@@ -135,6 +147,7 @@ class _HorovodTpuContext:
                     self.rank = 0
                     self.size = 1
                     self.cross_rank, self.cross_size = 0, 1
+                    self._has_host_map = False
                 set_rank_context(self.rank, self.local_rank)
             try:
                 self.mesh = mesh_lib.build_mesh(mesh_spec, devices)
@@ -161,6 +174,13 @@ class _HorovodTpuContext:
                             rank=self.rank, size=self.size,
                             local_rank=self.local_rank,
                             local_size=self.local_size,
+                            # Locality map for the topology-aware data
+                            # plane: the launcher's host index, or -1
+                            # (flat) for single-host jobs and jobs whose
+                            # cross dims are synthetic defaults.
+                            host_id=self.cross_rank
+                            if self._has_host_map and self.cross_size > 1
+                            else -1,
                             port=subset_ports[0] if subset_ports else None,
                             data_port=subset_ports[1] if subset_ports
                             else None)

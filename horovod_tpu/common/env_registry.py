@@ -128,8 +128,11 @@ _decl("HOROVOD_STALL_CHECK_DISABLE", "bool", False,
 _decl("HOROVOD_ENGINE_LIB", "str", None,
       "path override for libhvdtpu_core.so (skips the build probe)")
 _decl("HOROVOD_HIERARCHICAL_ALLREDUCE", "bool", False,
-      "two-level gradient reduction (reduce-scatter over fast axes, "
-      "cross-slice allreduce, all-gather back)")
+      "two-level topology-aware allreduce: in-jit (reduce-scatter over "
+      "fast axes, cross-slice allreduce, all-gather back) AND the host "
+      "data plane (intra-host reduce-scatter -> inter-host leaders -> "
+      "intra-host allgather); engine seed only — retunable per cycle "
+      "via TunedParams", "both")
 _decl("HOROVOD_BUCKET_BYTES", "int", 0,
       "gradient-exchange bucket bound in bytes: >0 issues the backward "
       "collectives as size-bounded buckets overlapped with backward "
@@ -261,8 +264,13 @@ _decl("HOROVOD_MAX_FRAME_BYTES", "int", (1 << 31) - 1,
 _decl("HOROVOD_DATA_FAULT_INJECT", "str", None,
       "data-plane fault toggles (truncate_star_allgatherv, ...)", "cpp")
 _decl("HOROVOD_RING_THRESHOLD_BYTES", "int", 1 << 20,
-      "payload size where the host data plane switches star -> ring",
-      "cpp")
+      "payload size where the host data plane switches star -> ring "
+      "(session seed; cycle-fenced TunedParams knob thereafter, so the "
+      "tuner can search it at runtime)", "cpp")
+_decl("HOROVOD_SMALL_TENSOR_ALGO", "str", "star",
+      "sub-express-lane allreduce route: 'star' (rank-0 hub, 2 hops) or "
+      "'rd' (log2(p) recursive doubling, no hub); session seed — "
+      "cycle-fenced TunedParams knob thereafter", "cpp")
 _decl("HOROVOD_CONNECT_RETRIES", "int", 0,
       "max connect attempts per TCP link (0 = bounded by deadline only)",
       "cpp")

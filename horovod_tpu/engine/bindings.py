@@ -62,7 +62,14 @@ _lib_lock = threading.Lock()
 #    cycle time / fusion threshold / cache / express-lane knobs through
 #    the parameter-sync broadcast (HOROVOD_TUNE); the TunedParams wire
 #    record gains low_latency_threshold_bytes + express_lane.
-ABI_VERSION = 9
+# 10: topology-aware data plane — hvdtpu_create_session gains host_id
+#     (launcher locality map; loopback multi-host simulation);
+#     hvdtpu_set_tuned_params gains ring_threshold_bytes / hierarchical /
+#     small_tensor_algo (cycle-fenced routing); hvdtpu_data_algo_ops.
+ABI_VERSION = 10
+
+# TunedParams.small_tensor_algo ids (engine/src/data_plane.h).
+SMALL_TENSOR_ALGOS = {"star": 0, "rd": 1}
 
 
 def _lib_path() -> Path:
@@ -115,6 +122,7 @@ def load_library():
         lib.hvdtpu_create_session.restype = ctypes.c_int64
         lib.hvdtpu_create_session.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32,
             ctypes.c_int32,
             ctypes.c_double, ctypes.c_double, ctypes.c_int64,
@@ -183,6 +191,8 @@ def load_library():
                                           ctypes.c_int64]
         lib.hvdtpu_data_ring_ops.restype = ctypes.c_int64
         lib.hvdtpu_data_ring_ops.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_data_algo_ops.restype = ctypes.c_int64
+        lib.hvdtpu_data_algo_ops.argtypes = [ctypes.c_int64, ctypes.c_int32]
         lib.hvdtpu_bench_combine.restype = ctypes.c_double
         lib.hvdtpu_bench_combine.argtypes = [
             ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
@@ -206,7 +216,8 @@ def load_library():
         lib.hvdtpu_set_tuned_params.restype = ctypes.c_int32
         lib.hvdtpu_set_tuned_params.argtypes = [
             ctypes.c_int64, ctypes.c_double, ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_int64, ctypes.c_int32]
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
         lib.hvdtpu_get_tuned_params.restype = ctypes.c_int64
         lib.hvdtpu_get_tuned_params.argtypes = [
             ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
@@ -259,6 +270,7 @@ class EngineSession:
                  size: int,
                  local_rank: int = 0,
                  local_size: int = 1,
+                 host_id: Optional[int] = None,
                  transport: str = "tcp",
                  group: str = "default",
                  addr: Optional[str] = None,
@@ -271,6 +283,15 @@ class EngineSession:
                  stall_shutdown_sec: Optional[float] = None,
                  timeout_sec: Optional[float] = None):
         self._lib = load_library()
+        if host_id is None:
+            # Launcher topology contract: HOROVOD_CROSS_RANK is this
+            # worker's host index. A single-host job (HOROVOD_CROSS_SIZE
+            # <= 1) passes -1 = "no locality map", keeping the data
+            # plane's wire traffic byte-identical to the flat build.
+            # Loopback tests simulate multi-host grouping by passing
+            # distinct host_id values per in-process rank.
+            host_id = env_int("HOROVOD_CROSS_RANK") \
+                if env_int("HOROVOD_CROSS_SIZE") > 1 else -1
         addr = addr or env_str("HOROVOD_CONTROLLER_ADDR")
         port = port if port is not None else \
             env_int("HOROVOD_CONTROLLER_PORT")
@@ -297,7 +318,7 @@ class EngineSession:
         timeline_cycles = env_bool("HOROVOD_TIMELINE_MARK_CYCLES")
 
         self._session = self._lib.hvdtpu_create_session(
-            rank, size, local_rank, local_size,
+            rank, size, local_rank, local_size, host_id,
             transport.encode(),
             (group if transport == "loopback" else addr).encode(),
             port, data_port, timeout_sec, cycle_time_ms, fusion_threshold,
@@ -351,6 +372,15 @@ class EngineSession:
     def data_ring_ops(self) -> int:
         """Collectives served by the ring data path (diagnostics)."""
         return self._lib.hvdtpu_data_ring_ops(self._session)
+
+    def data_algo_ops(self, algo: str) -> int:
+        """Collectives served by a data-plane routing algorithm:
+        ``"ring"``, ``"rd"`` (recursive doubling), or ``"hier"``
+        (hierarchical). Star = total minus these; the full per-algorithm
+        breakdown (plus inter-host vs intra-host wire bytes) is in
+        :meth:`metrics` under ``data_{star,ring,rd,hier}_ops``."""
+        ids = {"ring": 0, "rd": 1, "hier": 2}
+        return self._lib.hvdtpu_data_algo_ops(self._session, ids[algo])
 
     def _json_call(self, fn) -> Optional[dict]:
         """Shared buffer dance for the JSON-returning C calls: the return
@@ -418,13 +448,20 @@ class EngineSession:
                          fusion_threshold_bytes: Optional[int] = None,
                          cache_enabled: Optional[bool] = None,
                          low_latency_threshold_bytes: Optional[int] = None,
-                         express_lane: Optional[bool] = None):
+                         express_lane: Optional[bool] = None,
+                         ring_threshold_bytes: Optional[int] = None,
+                         hierarchical: Optional[bool] = None,
+                         small_tensor_algo: Optional[str] = None):
         """Push engine knobs at runtime (the frontend autotuner's engine
         hook). The record is staged and adopted by every rank at the same
         coordination-cycle boundary via the parameter-sync broadcast —
         requires ``HOROVOD_TUNE=1`` on multi-rank sessions (single-rank
         sessions apply on the next cycle unconditionally). ``None`` keeps
-        the current value. Raises on a session that cannot sync."""
+        the current value. The data-plane routing knobs
+        (``ring_threshold_bytes``, ``hierarchical``,
+        ``small_tensor_algo`` in {"star", "rd"}) ride the same fence, so
+        the tuner can search them without ever splitting ranks across
+        algorithms. Raises on a session that cannot sync."""
         rc = self._lib.hvdtpu_set_tuned_params(
             self._session,
             -1.0 if cycle_time_ms is None else float(cycle_time_ms),
@@ -433,7 +470,12 @@ class EngineSession:
             -1 if cache_enabled is None else int(bool(cache_enabled)),
             -1 if low_latency_threshold_bytes is None
             else int(low_latency_threshold_bytes),
-            -1 if express_lane is None else int(bool(express_lane)))
+            -1 if express_lane is None else int(bool(express_lane)),
+            -1 if ring_threshold_bytes is None
+            else int(ring_threshold_bytes),
+            -1 if hierarchical is None else int(bool(hierarchical)),
+            -1 if small_tensor_algo is None
+            else SMALL_TENSOR_ALGOS[small_tensor_algo])
         if rc != 0:
             raise HorovodInternalError(
                 self._lib.hvdtpu_last_error().decode())
@@ -441,8 +483,9 @@ class EngineSession:
     def tuned_params(self) -> dict:
         """The currently applied engine knobs: ``{"cycle_time_ms",
         "fusion_threshold_bytes", "low_latency_threshold_bytes",
-        "cache_enabled", "tuning_active", "express_lane"}``. Reflects a
-        :meth:`set_tuned_params` push only after the next coordination
+        "ring_threshold_bytes", "cache_enabled", "tuning_active",
+        "express_lane", "hierarchical", "small_tensor_algo"}``. Reflects
+        a :meth:`set_tuned_params` push only after the next coordination
         cycle applied/broadcast it."""
         return self._json_call(self._lib.hvdtpu_get_tuned_params) or {}
 

@@ -54,7 +54,13 @@ extern "C" {
 //    cycle time / fusion threshold / cache / express-lane knobs through
 //    the parameter-sync broadcast (HOROVOD_TUNE); TunedParams wire record
 //    gains low_latency_threshold_bytes + express_lane.
-int32_t hvdtpu_abi_version() { return 9; }
+// 10: topology-aware data plane — hvdtpu_create_session gains host_id
+//     (launcher locality map; loopback multi-host simulation);
+//     hvdtpu_set_tuned_params gains ring_threshold_bytes / hierarchical /
+//     small_tensor_algo (cycle-fenced data-plane routing; TunedParams
+//     wire record extended to match); hvdtpu_data_algo_ops exposes the
+//     per-algorithm routing counters.
+int32_t hvdtpu_abi_version() { return 10; }
 
 namespace {
 
@@ -145,16 +151,21 @@ int32_t hvdtpu_step_end(int64_t session, int64_t step_id) {
 
 // Frontend-tuner knob push: stage a TunedParams record for the next
 // coordination cycle's parameter broadcast (every rank adopts at the
-// same cycle boundary — rank-divergent fusion/express partitions would
-// desync the exec order). Sentinels keep the current value: cycle_ms
-// <= 0, fusion_bytes <= 0, low_latency_bytes < 0, cache/express < 0.
-// Effective on the coordinator; other ranks' pushes are ignored (they
-// adopt via the broadcast). Returns 0, or nonzero with the reason via
+// same cycle boundary — rank-divergent fusion/express/routing partitions
+// would desync the exec order or deadlock the data plane). Sentinels keep
+// the current value: cycle_ms <= 0, fusion_bytes <= 0, low_latency_bytes
+// < 0, cache/express < 0, ring_threshold_bytes <= 0, hierarchical < 0,
+// small_tensor_algo < 0 (1 = recursive doubling, 0 = star). Effective on
+// the coordinator; other ranks' pushes are ignored (they adopt via the
+// broadcast). Returns 0, or nonzero with the reason via
 // hvdtpu_last_error (multi-rank session without HOROVOD_TUNE=1).
 int32_t hvdtpu_set_tuned_params(int64_t session, double cycle_ms,
                                 int64_t fusion_bytes, int32_t cache_enabled,
                                 int64_t low_latency_bytes,
-                                int32_t express_lane) {
+                                int32_t express_lane,
+                                int64_t ring_threshold_bytes,
+                                int32_t hierarchical,
+                                int32_t small_tensor_algo) {
   Engine* e = GetSession(session);
   if (!e) return -1;
   TunedParams p = e->TunedSnapshot();
@@ -164,6 +175,18 @@ int32_t hvdtpu_set_tuned_params(int64_t session, double cycle_ms,
   if (low_latency_bytes >= 0) p.low_latency_threshold_bytes =
       low_latency_bytes;
   if (express_lane >= 0) p.express_lane = express_lane != 0 ? 1 : 0;
+  if (ring_threshold_bytes > 0) p.ring_threshold_bytes =
+      ring_threshold_bytes;
+  if (hierarchical >= 0) p.hierarchical = hierarchical != 0 ? 1 : 0;
+  if (small_tensor_algo >= 0) {
+    if (small_tensor_algo != kSmallTensorStar &&
+        small_tensor_algo != kSmallTensorRecursiveDoubling) {
+      SetError("small_tensor_algo must be 0 (star) or 1 (recursive "
+               "doubling)");
+      return 1;
+    }
+    p.small_tensor_algo = static_cast<uint8_t>(small_tensor_algo);
+  }
   auto st = e->SetTunedParams(p);
   if (!st.ok()) {
     SetError(st.reason);
@@ -174,22 +197,28 @@ int32_t hvdtpu_set_tuned_params(int64_t session, double cycle_ms,
 
 // Currently applied engine knobs as JSON (CopyJson buffer contract):
 // {"cycle_time_ms","fusion_threshold_bytes","low_latency_threshold_bytes",
-//  "cache_enabled","tuning_active","express_lane"}.
+//  "ring_threshold_bytes","cache_enabled","tuning_active","express_lane",
+//  "hierarchical","small_tensor_algo"}.
 int64_t hvdtpu_get_tuned_params(int64_t session, char* buf, int64_t len) {
   Engine* e = GetSession(session);
   if (!e) return -1;
   TunedParams p = e->TunedSnapshot();
-  char json[256];
+  char json[384];
   std::snprintf(json, sizeof(json),
                 "{\"cycle_time_ms\":%.6f,\"fusion_threshold_bytes\":%lld,"
-                "\"low_latency_threshold_bytes\":%lld,\"cache_enabled\":%d,"
-                "\"tuning_active\":%d,\"express_lane\":%d}",
+                "\"low_latency_threshold_bytes\":%lld,"
+                "\"ring_threshold_bytes\":%lld,\"cache_enabled\":%d,"
+                "\"tuning_active\":%d,\"express_lane\":%d,"
+                "\"hierarchical\":%d,\"small_tensor_algo\":%d}",
                 p.cycle_time_ms,
                 static_cast<long long>(p.fusion_threshold_bytes),
                 static_cast<long long>(p.low_latency_threshold_bytes),
+                static_cast<long long>(p.ring_threshold_bytes),
                 static_cast<int>(p.cache_enabled),
                 static_cast<int>(p.tuning_active),
-                static_cast<int>(p.express_lane));
+                static_cast<int>(p.express_lane),
+                static_cast<int>(p.hierarchical),
+                static_cast<int>(p.small_tensor_algo));
   return CopyJson(json, buf, len);
 }
 
@@ -209,10 +238,28 @@ int64_t hvdtpu_data_ring_ops(int64_t session) {
   return e->data_plane()->ring_ops();
 }
 
+// Collectives served by each data-plane routing algorithm:
+// 0 = ring, 1 = recursive doubling, 2 = hierarchical (diagnostics/tests;
+// star = total ops minus these, or read the metrics snapshot).
+int64_t hvdtpu_data_algo_ops(int64_t session, int32_t algo) {
+  Engine* e = GetSession(session);
+  if (!e || !e->data_plane()) return -1;
+  switch (algo) {
+    case 0: return e->data_plane()->ring_ops();
+    case 1: return e->data_plane()->rd_ops();
+    case 2: return e->data_plane()->hier_ops();
+    default: return -1;
+  }
+}
+
 // Returns session id > 0, or <= 0 on failure (error via
-// hvdtpu_last_error()). transport_kind: "loopback" or "tcp".
+// hvdtpu_last_error()). transport_kind: "loopback" or "tcp". host_id is
+// this rank's host index from the launcher topology records (< 0 = no
+// locality map — the data plane stays flat); loopback tests pass
+// distinct host ids per in-process rank to simulate multi-host grouping.
 int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,
-                              int32_t local_size, const char* transport_kind,
+                              int32_t local_size, int32_t host_id,
+                              const char* transport_kind,
                               const char* group_or_addr, int32_t port,
                               int32_t data_port,
                               double timeout_sec, double cycle_time_ms,
@@ -247,6 +294,30 @@ int64_t hvdtpu_create_session(int32_t rank, int32_t size, int32_t local_rank,
   }
   if (const char* v = std::getenv("HOROVOD_SERVING_CYCLE_TIME")) {
     opts.serving_cycle_time_ms = std::atof(v);
+  }
+
+  // Data-plane routing seeds (cycle-fenced thereafter via the TunedParams
+  // broadcast): the star-vs-ring boundary, the hierarchical allreduce
+  // gate (the launcher's --hierarchical-allreduce flag, finally honored
+  // by the engine), and the small-tensor route.
+  opts.host_id = host_id;
+  if (const char* v = std::getenv("HOROVOD_RING_THRESHOLD_BYTES")) {
+    if (*v) opts.ring_threshold_bytes = std::atoll(v);
+  }
+  const char* ha = std::getenv("HOROVOD_HIERARCHICAL_ALLREDUCE");
+  opts.hierarchical_allreduce = ha != nullptr && std::strcmp(ha, "0") != 0 &&
+                                std::strcmp(ha, "") != 0;
+  if (const char* v = std::getenv("HOROVOD_SMALL_TENSOR_ALGO")) {
+    if (std::strcmp(v, "rd") == 0 ||
+        std::strcmp(v, "recursive_doubling") == 0) {
+      opts.small_tensor_algo = kSmallTensorRecursiveDoubling;
+    } else if (std::strcmp(v, "star") == 0 || *v == '\0') {
+      opts.small_tensor_algo = kSmallTensorStar;
+    } else {
+      SetError(std::string("HOROVOD_SMALL_TENSOR_ALGO must be 'star' or "
+                           "'rd', got '") + v + "'");
+      return -1;
+    }
   }
 
   // Frontend-tuner parameter sync: HOROVOD_TUNE keeps the per-cycle

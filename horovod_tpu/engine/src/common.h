@@ -206,6 +206,15 @@ struct EngineOptions {
   // small-tensor latency route for training jobs (runtime-tunable via the
   // TunedParams broadcast; never read directly off env).
   bool express_lane = false;
+  // Data-plane routing knobs — cycle-fenced via the TunedParams broadcast
+  // (env values below are the session seed only; see data_plane.h).
+  int64_t ring_threshold_bytes = 1 << 20;  // HOROVOD_RING_THRESHOLD_BYTES
+  bool hierarchical_allreduce = false;     // HOROVOD_HIERARCHICAL_ALLREDUCE
+  // 0 = star, 1 = recursive doubling (HOROVOD_SMALL_TENSOR_ALGO).
+  int32_t small_tensor_algo = 0;
+  // This rank's host index from the launcher topology records; < 0 = no
+  // locality map (flat plane, no topology exchange).
+  int32_t host_id = -1;
   // Frontend-tuner parameter sync (HOROVOD_TUNE): broadcast the
   // coordinator's TunedParams every cycle so hvdtpu_set_tuned_params
   // pushes reach all ranks at the same cycle boundary.

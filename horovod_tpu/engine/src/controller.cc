@@ -763,6 +763,8 @@ Status Controller::SynchronizeParameters(CycleOutput* out) {
     cached_pending_.clear();
   }
   out->tuned_cycle_time_ms = p.cycle_time_ms;
+  out->params_synced = true;
+  out->applied_params = p;
   // param_sync keeps the channel open for future frontend pushes even
   // after the engine-side tuner (if any) fixed its configuration.
   if (!p.tuning_active && !opts_.param_sync) autotune_sync_ = false;

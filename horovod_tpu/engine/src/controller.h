@@ -73,6 +73,12 @@ class Controller {
     bool should_shut_down = false;
     // Autotuner decision for the engine's loop pacing; 0 = unchanged.
     double tuned_cycle_time_ms = 0;
+    // Set when SynchronizeParameters ran this cycle: the record every rank
+    // just adopted. The engine applies the data-plane routing knobs
+    // (ring threshold / hierarchy / small-tensor algo) from it BETWEEN
+    // cycles — the cycle fence that keeps rank routing identical.
+    bool params_synced = false;
+    TunedParams applied_params;
   };
 
   Status RunCycle(const CycleInput& in, CycleOutput* out);

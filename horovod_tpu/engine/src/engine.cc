@@ -189,6 +189,14 @@ Status Engine::Init() {
   }
   data_plane_ = std::make_unique<DataPlane>(data_transport);
   data_plane_->set_metrics(&metrics_);
+  // Seed the topology + routing knobs from the session options; from here
+  // on the knobs only move via the cycle-fenced TunedParams broadcast
+  // (BackgroundLoopImpl re-applies after every SynchronizeParameters).
+  data_plane_->SetHostId(opts_.host_id);
+  data_plane_->SetRouting(opts_.ring_threshold_bytes,
+                          opts_.hierarchical_allreduce,
+                          opts_.small_tensor_algo,
+                          opts_.low_latency_threshold_bytes);
   // Coordinator-only, like the reference: every worker gets the same
   // HOROVOD_TIMELINE path, and concurrent writers would interleave
   // corrupt JSON into one file.
@@ -528,6 +536,12 @@ void Engine::PerformOperation(const Response& response) {
         } else {
           err = "data plane execution failed (rc=" + std::to_string(rc) +
                 ") on tensor(s) [" + names + "]";
+          // Same thread as the data-plane call: its failure reason (the
+          // specific exchange and got/expected sizes for wire-validation
+          // errors) survives into the handle error and the abort reason.
+          if (data_plane_ != nullptr && !data_plane_->last_error().empty()) {
+            err += ": " + data_plane_->last_error();
+          }
         }
         // rc==2 (PRECONDITION) marks a local input-validation failure:
         // only this op fails and the session stays usable. Everything
@@ -635,6 +649,18 @@ void Engine::BackgroundLoopImpl() {
       handles_.FailAll("coordination failure: " + st.reason +
                        " (HorovodInternalError)");
       break;
+    }
+    // Cycle-fenced routing: the TunedParams record every rank adopted in
+    // THIS cycle's SynchronizeParameters broadcast lands on the data
+    // plane before this cycle's responses execute — the same boundary on
+    // every rank, so a retuned ring threshold / hierarchy bit can never
+    // split ranks across algorithms for one collective (the documented
+    // "raw hvdtpu_data_* not cycle-fenced" limitation, now closed).
+    if (out.params_synced && data_plane_ != nullptr) {
+      const TunedParams& ap = out.applied_params;
+      data_plane_->SetRouting(ap.ring_threshold_bytes, ap.hierarchical != 0,
+                              static_cast<int32_t>(ap.small_tensor_algo),
+                              ap.low_latency_threshold_bytes);
     }
     // CYCLE anchor: all ranks leave RunCycle's final collective exchange
     // together, so non-idle cycles give the analyzer per-rank timestamps

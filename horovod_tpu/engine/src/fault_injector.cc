@@ -31,7 +31,8 @@ bool ParseDouble(const std::string& s, double* out) {
 
 bool ValidPoint(const std::string& p) {
   return p == "send" || p == "recv" || p == "ring_send" ||
-         p == "ring_recv" || p == "connect" || p == "frame";
+         p == "ring_recv" || p == "peer_send" || p == "peer_recv" ||
+         p == "connect" || p == "frame";
 }
 
 std::vector<std::string> Split(const std::string& s, char sep) {
@@ -171,7 +172,8 @@ Status FaultInjector::OnEvent(const char* channel, const char* point,
       const bool point_match =
           r.point == point || (r.point == "frame" &&
                                (std::strcmp(point, "send") == 0 ||
-                                std::strcmp(point, "ring_send") == 0));
+                                std::strcmp(point, "ring_send") == 0 ||
+                                std::strcmp(point, "peer_send") == 0));
       if (!point_match) continue;
       if (!r.channel.empty() && r.channel != channel) continue;
       if (r.rank >= 0 && r.rank != rank) continue;

@@ -10,7 +10,8 @@
 // Grammar (';'-separated rules):
 //   rule    := [channel '.'] point ':' action ['@' cond (',' cond)*]
 //   channel := 'control' | 'data'            (default: any channel)
-//   point   := 'send' | 'recv' | 'ring_send' | 'ring_recv' | 'connect'
+//   point   := 'send' | 'recv' | 'ring_send' | 'ring_recv'
+//            | 'peer_send' | 'peer_recv' | 'connect'
 //            | 'frame'                        ('frame' = any framed send)
 //   action  := 'drop'        fail the op with Status::Aborted (and tear the
 //                            link down, like a peer death)

@@ -99,6 +99,10 @@ std::string MetricsStore::SnapshotJson(int rank) const {
   AppendKV(&out, "stalled_tensors", v(stalled_tensors), &first);
   AppendKV(&out, "data_ring_ops", v(data_ring_ops), &first);
   AppendKV(&out, "data_star_ops", v(data_star_ops), &first);
+  AppendKV(&out, "data_rd_ops", v(data_rd_ops), &first);
+  AppendKV(&out, "data_hier_ops", v(data_hier_ops), &first);
+  AppendKV(&out, "data_interhost_bytes", v(data_interhost_bytes), &first);
+  AppendKV(&out, "data_intrahost_bytes", v(data_intrahost_bytes), &first);
   AppendKV(&out, "aborts", v(aborts_total), &first);
   AppendKV(&out, "connect_retries", v(connect_retries), &first);
   AppendKV(&out, "crc_failures", v(crc_failures), &first);

@@ -82,6 +82,12 @@ struct MetricsStore {
   std::atomic<int64_t> stalled_tensors{0};      // tensors named across scans
   std::atomic<int64_t> data_ring_ops{0};        // host data plane ring path
   std::atomic<int64_t> data_star_ops{0};        // host data plane star path
+  std::atomic<int64_t> data_rd_ops{0};          // recursive-doubling path
+  std::atomic<int64_t> data_hier_ops{0};        // hierarchical path
+  // Logical wire bytes this rank sent, split by the locality map (no map
+  // = everything intra-host): the hierarchical route's acceptance metric.
+  std::atomic<int64_t> data_interhost_bytes{0};
+  std::atomic<int64_t> data_intrahost_bytes{0};
   std::atomic<int64_t> aborts_total{0};         // fast-abort teardowns
   std::atomic<int64_t> connect_retries{0};      // failed connect attempts
   std::atomic<int64_t> crc_failures{0};         // frames rejected by CRC32C

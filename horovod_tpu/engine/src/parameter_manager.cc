@@ -30,7 +30,7 @@ double FromUnit(double t, double lo, double hi) {
 }  // namespace
 
 void TunedParams::SerializeTo(std::string* out) const {
-  out->resize(sizeof(double) + 2 * sizeof(int64_t) + 3);
+  out->resize(sizeof(double) + 3 * sizeof(int64_t) + 5);
   char* p = &(*out)[0];
   std::memcpy(p, &cycle_time_ms, sizeof(double));
   p += sizeof(double);
@@ -38,14 +38,18 @@ void TunedParams::SerializeTo(std::string* out) const {
   p += sizeof(int64_t);
   std::memcpy(p, &low_latency_threshold_bytes, sizeof(int64_t));
   p += sizeof(int64_t);
+  std::memcpy(p, &ring_threshold_bytes, sizeof(int64_t));
+  p += sizeof(int64_t);
   p[0] = static_cast<char>(cache_enabled);
   p[1] = static_cast<char>(tuning_active);
   p[2] = static_cast<char>(express_lane);
+  p[3] = static_cast<char>(hierarchical);
+  p[4] = static_cast<char>(small_tensor_algo);
 }
 
 TunedParams TunedParams::Deserialize(const std::string& payload) {
   TunedParams p;
-  if (payload.size() < sizeof(double) + 2 * sizeof(int64_t) + 3) return p;
+  if (payload.size() < sizeof(double) + 3 * sizeof(int64_t) + 5) return p;
   const char* q = payload.data();
   std::memcpy(&p.cycle_time_ms, q, sizeof(double));
   q += sizeof(double);
@@ -53,9 +57,13 @@ TunedParams TunedParams::Deserialize(const std::string& payload) {
   q += sizeof(int64_t);
   std::memcpy(&p.low_latency_threshold_bytes, q, sizeof(int64_t));
   q += sizeof(int64_t);
+  std::memcpy(&p.ring_threshold_bytes, q, sizeof(int64_t));
+  q += sizeof(int64_t);
   p.cache_enabled = static_cast<uint8_t>(q[0]);
   p.tuning_active = static_cast<uint8_t>(q[1]);
   p.express_lane = static_cast<uint8_t>(q[2]);
+  p.hierarchical = static_cast<uint8_t>(q[3]);
+  p.small_tensor_algo = static_cast<uint8_t>(q[4]);
   return p;
 }
 
@@ -70,9 +78,12 @@ void ParameterManager::Initialize(const EngineOptions& opts,
   current_.cycle_time_ms = opts.cycle_time_ms;
   current_.fusion_threshold_bytes = opts.fusion_threshold_bytes;
   current_.low_latency_threshold_bytes = opts.low_latency_threshold_bytes;
+  current_.ring_threshold_bytes = opts.ring_threshold_bytes;
   current_.cache_enabled = opts.cache_enabled ? 1 : 0;
   current_.tuning_active = active_ ? 1 : 0;
   current_.express_lane = opts.express_lane ? 1 : 0;
+  current_.hierarchical = opts.hierarchical_allreduce ? 1 : 0;
+  current_.small_tensor_algo = static_cast<uint8_t>(opts.small_tensor_algo);
   warmup_remaining_ = opts.autotune_warmup_samples;
   steps_remaining_ = opts.autotune_steps;
   sample_cycles_ = opts.autotune_sample_cycles;

@@ -38,9 +38,19 @@ struct TunedParams {
   // the fusion buffer and run ahead of bulk traffic when the lane is on
   // (serving mode, or express_lane enabled by the tuner for training).
   int64_t low_latency_threshold_bytes = 4096;
+  // Data-plane routing (ABI 10): the star-vs-ring payload boundary, the
+  // hierarchical (two-level, topology-aware) allreduce gate, and the
+  // small-tensor route (0 star / 1 recursive doubling). Riding this
+  // record is what makes them safe to retune at runtime: the per-cycle
+  // SynchronizeParameters broadcast lands them on every rank at ONE
+  // cycle boundary, so two ranks can never route the same collective
+  // through different algorithms (which would deadlock the transports).
+  int64_t ring_threshold_bytes = 1 << 20;
   uint8_t cache_enabled = 1;
   uint8_t tuning_active = 1;
   uint8_t express_lane = 0;
+  uint8_t hierarchical = 0;
+  uint8_t small_tensor_algo = 0;
 
   void SerializeTo(std::string* out) const;
   static TunedParams Deserialize(const std::string& payload);

@@ -25,7 +25,13 @@ namespace hvdtpu {
 
 LoopbackHub::LoopbackHub(int size_in)
     : size(size_in), slots(size_in), ring_slots(size_in),
-      ring_full(size_in, false) {}
+      ring_full(size_in, false),
+      peer_slots(static_cast<size_t>(size_in) * size_in),
+      peer_full(new std::atomic<uint8_t>[static_cast<size_t>(size_in) *
+                                         size_in]),
+      peer_cvs(size_in) {
+  for (int i = 0; i < size_in * size_in; ++i) peer_full[i].store(0);
+}
 
 void LoopbackHub::BarrierWait() {
   std::unique_lock<std::mutex> lock(mu);
@@ -43,6 +49,7 @@ void LoopbackHub::Abort() {
   std::lock_guard<std::mutex> lock(mu);
   aborted = true;
   cv.notify_all();
+  for (auto& pcv : peer_cvs) pcv.notify_all();
 }
 
 LoopbackTransport::LoopbackTransport(std::shared_ptr<LoopbackHub> hub,
@@ -190,6 +197,110 @@ Status LoopbackTransport::RingExchange(const void* send, int64_t send_len,
 }
 
 namespace {
+
+// Brief spin before a cv sleep: pairwise exchanges usually complete in
+// microseconds, and the syscall + wakeup of a cv round trip would
+// dominate the latency the recursive-doubling route exists to cut.
+// Oversubscribed hosts (in-process ranks >= cores — CI containers) skip
+// the spin entirely: the partner can only progress when THIS thread
+// yields the core, so spinning strictly delays it.
+inline int PeerSpinIters(int hub_size) {
+  static const unsigned cores = std::thread::hardware_concurrency();
+  return (cores != 0 && static_cast<unsigned>(hub_size) >= cores)
+             ? 0
+             : 4000;
+}
+
+}  // namespace
+
+Status LoopbackTransport::PeerSend(int peer, const void* data, int64_t len) {
+  auto ist = Inject("peer_send");
+  if (!ist.ok()) return ist;
+  if (peer < 0 || peer >= hub_->size) {
+    return Status::InvalidArgument("peer rank out of range");
+  }
+  const size_t slot = static_cast<size_t>(rank_) * hub_->size + peer;
+  auto& full = hub_->peer_full[slot];
+  const int spin = PeerSpinIters(hub_->size);
+  // wait for the consumer to drain the single slot (SPSC: the flag's
+  // release/acquire pair is the only synchronization on the payload)
+  for (int i = 0;
+       full.load(std::memory_order_acquire) != 0 && !hub_->aborted;
+       ++i) {
+    if (i >= spin) {
+      std::unique_lock<std::mutex> lock(hub_->mu);
+      hub_->peer_cvs[rank_].wait(lock, [&] {
+        return full.load(std::memory_order_acquire) == 0 || hub_->aborted;
+      });
+      break;
+    }
+  }
+  if (hub_->aborted) return Status::Aborted("loopback hub aborted");
+  hub_->peer_slots[slot].assign(static_cast<const char*>(data), len);
+  full.store(1, std::memory_order_release);
+  {
+    // lock-then-notify so a consumer between its predicate check and its
+    // wait cannot miss the wakeup
+    std::lock_guard<std::mutex> lock(hub_->mu);
+  }
+  hub_->peer_cvs[peer].notify_one();
+  return Status::OK();
+}
+
+Status LoopbackTransport::PeerRecv(int peer, std::string* payload) {
+  auto ist = Inject("peer_recv");
+  if (!ist.ok()) return ist;
+  if (peer < 0 || peer >= hub_->size) {
+    return Status::InvalidArgument("peer rank out of range");
+  }
+  const size_t slot = static_cast<size_t>(peer) * hub_->size + rank_;
+  auto& full = hub_->peer_full[slot];
+  const int spin = PeerSpinIters(hub_->size);
+  for (int i = 0;
+       full.load(std::memory_order_acquire) == 0 && !hub_->aborted;
+       ++i) {
+    if (i >= spin) {
+      std::unique_lock<std::mutex> lock(hub_->mu);
+      hub_->peer_cvs[rank_].wait(lock, [&] {
+        return full.load(std::memory_order_acquire) != 0 || hub_->aborted;
+      });
+      break;
+    }
+  }
+  if (hub_->aborted) return Status::Aborted("loopback hub aborted");
+  *payload = std::move(hub_->peer_slots[slot]);
+  hub_->peer_slots[slot].clear();
+  full.store(0, std::memory_order_release);
+  {
+    std::lock_guard<std::mutex> lock(hub_->mu);
+  }
+  hub_->peer_cvs[peer].notify_one();
+  return Status::OK();
+}
+
+Status LoopbackTransport::PeerExchange(int peer, const void* send,
+                                       int64_t send_len, std::string* recv) {
+  // Deposit the outgoing payload before blocking on the incoming one:
+  // both sides of a pairwise exchange write first, so neither can wait on
+  // a mailbox the other hasn't filled (each (src,dst) slot has a distinct
+  // single producer/consumer).
+  auto st = PeerSend(peer, send, send_len);
+  if (!st.ok()) return st;
+  return PeerRecv(peer, recv);
+}
+
+Status LoopbackTransport::PeerShift(int send_peer, int recv_peer,
+                                    const void* send, int64_t send_len,
+                                    std::string* recv) {
+  // Same write-first discipline as PeerExchange: the round is a
+  // permutation, so every deposit lands in an empty slot and every recv's
+  // producer has already deposited (or will, without waiting on us).
+  auto st = PeerSend(send_peer, send, send_len);
+  if (!st.ok()) return st;
+  return PeerRecv(recv_peer, recv);
+}
+
+namespace {
 std::mutex g_hub_mu;
 std::unordered_map<std::string, std::shared_ptr<LoopbackHub>> g_hubs;
 }  // namespace
@@ -305,6 +416,10 @@ TcpTransport::~TcpTransport() {
   if (ring_listen_fd_ >= 0) ::close(ring_listen_fd_);
   if (ring_next_fd_ >= 0) ::close(ring_next_fd_);
   if (ring_prev_fd_ >= 0) ::close(ring_prev_fd_);
+  if (peer_listen_fd_ >= 0) ::close(peer_listen_fd_);
+  for (auto& fd : peer_fds_) {
+    if (fd && fd->load() >= 0) ::close(fd->load());
+  }
 }
 
 Status TcpTransport::Init() {
@@ -534,6 +649,9 @@ void TcpTransport::AbortPeers(const std::string& reason) {
   }
   send_to(ring_next_fd_);
   send_to(ring_prev_fd_);
+  for (auto& fd : peer_fds_) {
+    if (fd) send_to(fd->load());
+  }
 }
 
 Status TcpTransport::Gather(const std::string& mine,
@@ -734,28 +852,37 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
                                   std::string* recv) {
   auto st = EnsureRing();
   if (!st.ok()) return st;
-  // Full-duplex: interleave the outgoing frame to the successor with the
-  // incoming frame from the predecessor via poll(), so simultaneous large
-  // frames around the ring can't deadlock on filled socket buffers. Sends
+  return DuplexExchange(ring_next_fd_.load(), ring_prev_fd_.load(), send,
+                        send_len, recv, "ring_send", "ring_recv");
+}
+
+Status TcpTransport::DuplexExchange(int send_fd, int recv_fd,
+                                    const void* send, int64_t send_len,
+                                    std::string* recv,
+                                    const char* send_point,
+                                    const char* recv_point) {
+  // Full-duplex: interleave the outgoing frame with the incoming one via
+  // poll(), so simultaneous large frames (around the ring, or both ways of
+  // a pairwise exchange) can't deadlock on filled socket buffers. Sends
   // and recvs use MSG_DONTWAIT — poll() only guarantees *some* progress is
   // possible, and a blocking send of a frame larger than the socket buffer
   // would stall the receive side and re-create the deadlock.
-  // Same [len|crc] framing as SendFrame/RecvFrame, so RingSend/RingRecv and
-  // RingExchange can be mixed across (lockstep) collectives. The payload is
+  // Same [len|crc] framing as SendFrame/RecvFrame, so one-way and duplex
+  // transfers can be mixed across (lockstep) collectives. The payload is
   // streamed straight from the caller's buffer (header kept separately) —
   // no staging copy; the CRC is computed in one pass up front.
   bool corrupt = false;
-  auto ist = Inject("ring_send", &corrupt);
+  auto ist = Inject(send_point, &corrupt);
   if (!ist.ok()) return ist;
-  ist = Inject("ring_recv");
+  ist = Inject(recv_point);
   if (!ist.ok()) return ist;
   if (send_len > MaxFrameBytes()) {
     return Status::InvalidArgument(
         "ring frame payload of " + std::to_string(send_len) +
         " bytes exceeds HOROVOD_MAX_FRAME_BYTES");
   }
-  const int next_fd = ring_next_fd_.load();
-  const int prev_fd = ring_prev_fd_.load();
+  const int next_fd = send_fd;
+  const int prev_fd = recv_fd;
   const char* send_data = static_cast<const char*>(send);
   uint32_t send_hdr[2];
   send_hdr[0] = static_cast<uint32_t>(send_len);
@@ -853,6 +980,222 @@ Status TcpTransport::RingExchange(const void* send, int64_t send_len,
         " bytes) — wire corruption detected");
   }
   return Status::OK();
+}
+
+Status TcpTransport::EnsureMesh() {
+  if (peer_listen_fd_ >= 0 || size_ == 1) return Status::OK();
+  // A second ephemeral listener, distinct from the ring's: ring accepts
+  // carry no handshake, so sharing one backlog would let a mesh connect be
+  // mis-paired with the predecessor's ring connect.
+  peer_listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (peer_listen_fd_ < 0) return Status::Unknown("mesh socket() failed");
+  int one = 1;
+  setsockopt(peer_listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = INADDR_ANY;
+  sa.sin_port = 0;
+  if (::bind(peer_listen_fd_, reinterpret_cast<sockaddr*>(&sa),
+             sizeof(sa)) != 0 ||
+      ::listen(peer_listen_fd_, size_) != 0) {
+    ::close(peer_listen_fd_);
+    peer_listen_fd_ = -1;
+    return Status::Unknown("mesh bind/listen failed");
+  }
+  socklen_t slen = sizeof(sa);
+  getsockname(peer_listen_fd_, reinterpret_cast<sockaddr*>(&sa), &slen);
+  const int my_port = ntohs(sa.sin_port);
+  std::string my_ip = addr_;
+  if (rank_ != 0) {
+    sockaddr_in local{};
+    socklen_t llen = sizeof(local);
+    getsockname(root_fd_, reinterpret_cast<sockaddr*>(&local), &llen);
+    char buf[INET_ADDRSTRLEN];
+    inet_ntop(AF_INET, &local.sin_addr, buf, sizeof(buf));
+    my_ip = buf;
+  }
+  // The address table rides the star — all ranks reach EnsureMesh in
+  // lockstep (the data plane's first pairwise schedule), like EnsureRing.
+  std::vector<std::string> table;
+  auto st = Gather(my_ip + ":" + std::to_string(my_port),
+                   rank_ == 0 ? &table : nullptr);
+  if (!st.ok()) return st;
+  std::string packed;
+  if (rank_ == 0) {
+    for (auto& a : table) packed += a + "\n";
+  }
+  st = Bcast(&packed);
+  if (!st.ok()) return st;
+  peer_addrs_.clear();
+  size_t pos = 0;
+  while (pos < packed.size()) {
+    size_t nl = packed.find('\n', pos);
+    peer_addrs_.push_back(packed.substr(pos, nl - pos));
+    pos = nl + 1;
+  }
+  if (static_cast<int>(peer_addrs_.size()) != size_) {
+    ::close(peer_listen_fd_);
+    peer_listen_fd_ = -1;
+    return Status::Unknown("mesh address table size mismatch");
+  }
+  peer_fds_.clear();
+  for (int r = 0; r < size_; ++r) {
+    peer_fds_.push_back(std::make_unique<std::atomic<int>>(-1));
+  }
+  return Status::OK();
+}
+
+Status TcpTransport::EnsurePeer(int peer, int* out_fd) {
+  auto st = EnsureMesh();
+  if (!st.ok()) return st;
+  if (peer < 0 || peer >= size_ || peer == rank_) {
+    return Status::InvalidArgument("bad mesh peer rank " +
+                                   std::to_string(peer));
+  }
+  int fd = peer_fds_[peer]->load();
+  if (fd >= 0) {
+    *out_fd = fd;
+    return Status::OK();
+  }
+  if (rank_ < peer) {
+    // Deterministic roles: the lower rank connects, the higher accepts —
+    // both sides of a (lockstep) pairwise schedule agree without traffic.
+    const std::string& a = peer_addrs_[peer];
+    const size_t colon = a.rfind(':');
+    sockaddr_in pa{};
+    pa.sin_family = AF_INET;
+    pa.sin_port = htons(static_cast<uint16_t>(
+        std::stoi(a.substr(colon + 1))));
+    if (inet_pton(AF_INET, a.substr(0, colon).c_str(), &pa.sin_addr) != 1) {
+      return Status::Unknown("bad mesh peer address " + a);
+    }
+    int nfd = -1;
+    st = ConnectWithBackoff(pa, "mesh peer " + std::to_string(peer),
+                            timeout_sec_ > 0 ? timeout_sec_ : 60.0, &nfd);
+    if (!st.ok()) return st;
+    uint32_t my_rank = static_cast<uint32_t>(rank_);
+    st = WriteAll(nfd, reinterpret_cast<const char*>(&my_rank),
+                  sizeof(my_rank));
+    if (!st.ok()) {
+      ::close(nfd);
+      return st;
+    }
+    peer_fds_[peer]->store(nfd);
+    *out_fd = nfd;
+    return Status::OK();
+  }
+  // Acceptor side: connects from OTHER lower-ranked peers may already sit
+  // in the backlog (their exchange with this rank is scheduled later) —
+  // stash them by handshake rank until the expected peer's arrives. The
+  // star link rides in the poll set so a fast-abort frame (a peer died
+  // before its connect) unblocks this rank NOW instead of at the accept
+  // deadline — the mesh-establishment analog of the abort frames that
+  // unblock ranks stuck in data receives.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(
+                      timeout_sec_ > 0 ? timeout_sec_ : 60.0);
+  while (true) {
+    fd = peer_fds_[peer]->load();  // an earlier accept may have stashed it
+    if (fd >= 0) {
+      *out_fd = fd;
+      return Status::OK();
+    }
+    std::vector<struct pollfd> fds;
+    fds.push_back({peer_listen_fd_, POLLIN, 0});
+    if (rank_ != 0 && root_fd_ >= 0) {
+      fds.push_back({root_fd_, POLLIN, 0});
+    } else if (rank_ == 0) {
+      for (int wfd : worker_fds_) {
+        if (wfd >= 0) fds.push_back({wfd, POLLIN, 0});
+      }
+    }
+    auto remain = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now()).count();
+    if (remain <= 0 ||
+        ::poll(fds.data(), fds.size(), static_cast<int>(remain)) <= 0) {
+      return Status::Unknown("timed out waiting for mesh peer " +
+                             std::to_string(peer) + " to connect");
+    }
+    if (!(fds[0].revents & POLLIN)) {
+      // Traffic on a star link while this rank sits in (lockstep) mesh
+      // establishment can only be an abort announcement or a torn-down
+      // peer — either way the collective is over.
+      for (size_t i = 1; i < fds.size(); ++i) {
+        if (fds[i].revents & (POLLIN | POLLERR | POLLHUP)) {
+          std::string frame;
+          auto st = RecvFrame(fds[i].fd, &frame, "peer_recv");
+          if (st.ok()) {
+            st = Status::Unknown(
+                "unexpected data frame during mesh accept");
+          }
+          return st;
+        }
+      }
+      continue;
+    }
+    int nfd = ::accept(peer_listen_fd_, nullptr, nullptr);
+    if (nfd < 0) return Status::Unknown("mesh accept failed");
+    int one = 1;
+    setsockopt(nfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    SetTimeout(nfd, timeout_sec_);
+    uint32_t hrank = 0;
+    st = ReadAll(nfd, reinterpret_cast<char*>(&hrank), sizeof(hrank));
+    if (!st.ok()) {
+      ::close(nfd);
+      return st;
+    }
+    // Only lower ranks connect to this listener; anything else is a
+    // protocol violation (or a stray connection) and is rejected.
+    if (hrank >= static_cast<uint32_t>(rank_)) {
+      ::close(nfd);
+      return Status::Unknown("mesh handshake from unexpected rank " +
+                             std::to_string(hrank));
+    }
+    peer_fds_[hrank]->store(nfd);
+  }
+}
+
+Status TcpTransport::PeerSend(int peer, const void* data, int64_t len) {
+  int fd = -1;
+  auto st = EnsurePeer(peer, &fd);
+  if (!st.ok()) return st;
+  return SendFrame(fd, std::string(static_cast<const char*>(data), len),
+                   "peer_send");
+}
+
+Status TcpTransport::PeerRecv(int peer, std::string* payload) {
+  int fd = -1;
+  auto st = EnsurePeer(peer, &fd);
+  if (!st.ok()) return st;
+  return RecvFrame(fd, payload, "peer_recv");
+}
+
+Status TcpTransport::PeerExchange(int peer, const void* send,
+                                  int64_t send_len, std::string* recv) {
+  int fd = -1;
+  auto st = EnsurePeer(peer, &fd);
+  if (!st.ok()) return st;
+  // One socket carries both directions of the pairwise exchange.
+  return DuplexExchange(fd, fd, send, send_len, recv, "peer_send",
+                        "peer_recv");
+}
+
+Status TcpTransport::PeerShift(int send_peer, int recv_peer,
+                               const void* send, int64_t send_len,
+                               std::string* recv) {
+  if (send_peer == recv_peer) {
+    return PeerExchange(send_peer, send, send_len, recv);
+  }
+  // Establishment cannot deadlock: connects (lower rank) complete against
+  // the kernel backlog without the acceptor's participation, so every
+  // accept-wait is on a connect that needs no reciprocal action from us.
+  int send_fd = -1, recv_fd = -1;
+  auto st = EnsurePeer(send_peer, &send_fd);
+  if (!st.ok()) return st;
+  st = EnsurePeer(recv_peer, &recv_fd);
+  if (!st.ok()) return st;
+  return DuplexExchange(send_fd, recv_fd, send, send_len, recv, "peer_send",
+                        "peer_recv");
 }
 
 }  // namespace hvdtpu

@@ -19,6 +19,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <deque>
 #include <memory>
 #include <mutex>
 #include <random>
@@ -83,6 +84,27 @@ class ControllerTransport {
   virtual Status RingExchange(const void* send, int64_t send_len,
                               std::string* recv) = 0;
 
+  // -- arbitrary-pair p2p (topology-aware data plane) -----------------------
+  // Framed transfers between any two ranks: the recursive-doubling and
+  // hierarchical allreduce routes pair ranks at log-step distances the
+  // neighbor ring cannot reach. Links are established lazily on first use
+  // (TCP: a per-rank mesh listener + rank-handshake connects; loopback:
+  // per-(src,dst) hub mailboxes). Both sides of a transfer must call in
+  // matched order — the data plane invokes these in lockstep schedules
+  // where every rank knows its peer. PeerExchange writes the outgoing
+  // payload before blocking on the incoming one, so simultaneous pairwise
+  // exchanges cannot deadlock.
+  virtual Status PeerSend(int peer, const void* data, int64_t len) = 0;
+  virtual Status PeerRecv(int peer, std::string* payload) = 0;
+  virtual Status PeerExchange(int peer, const void* send, int64_t send_len,
+                              std::string* recv) = 0;
+  // Shift step: send to one peer while receiving from another (the round
+  // shape of the pairwise-alltoall schedules — round t sends to (i+t) and
+  // receives from (i-t), a permutation, so simultaneous duplex rounds
+  // cannot deadlock). send_peer == recv_peer degenerates to PeerExchange.
+  virtual Status PeerShift(int send_peer, int recv_peer, const void* send,
+                           int64_t send_len, std::string* recv) = 0;
+
  protected:
   MetricsStore* metrics_ = nullptr;
   const char* channel_ = "control";
@@ -117,6 +139,19 @@ struct LoopbackHub {
   // ring mailboxes: slot r is written by rank r, consumed by rank (r+1)%size
   std::vector<std::string> ring_slots;
   std::vector<bool> ring_full;
+  // Pairwise mailboxes: slot src*size+dst is written by rank src, consumed
+  // by rank dst (single-slot: a second send to the same peer blocks until
+  // the first was consumed, mirroring a bounded socket buffer). Each slot
+  // is a lock-free SPSC handoff — the `full` flag (release/acquire) is
+  // the only synchronization on the payload string, and waiters spin
+  // briefly before falling back to a PER-RANK cv (rank r waits only on
+  // peer_cvs[r]; its counterpart notifies that one cv) so the pairwise
+  // routes never pay the barrier cv's thundering herd. That's what lets
+  // the recursive-doubling route beat the star on in-process latency,
+  // not just on real wires.
+  std::vector<std::string> peer_slots;
+  std::unique_ptr<std::atomic<uint8_t>[]> peer_full;
+  std::deque<std::condition_variable> peer_cvs;  // one per rank
 
   void BarrierWait();
   void Abort();
@@ -139,6 +174,12 @@ class LoopbackTransport : public ControllerTransport {
   Status RingRecv(std::string* payload) override;
   Status RingExchange(const void* send, int64_t send_len,
                       std::string* recv) override;
+  Status PeerSend(int peer, const void* data, int64_t len) override;
+  Status PeerRecv(int peer, std::string* payload) override;
+  Status PeerExchange(int peer, const void* send, int64_t send_len,
+                      std::string* recv) override;
+  Status PeerShift(int send_peer, int recv_peer, const void* send,
+                   int64_t send_len, std::string* recv) override;
   void AbortPeers(const std::string& reason) override;
 
  private:
@@ -182,6 +223,12 @@ class TcpTransport : public ControllerTransport {
   Status RingRecv(std::string* payload) override;
   Status RingExchange(const void* send, int64_t send_len,
                       std::string* recv) override;
+  Status PeerSend(int peer, const void* data, int64_t len) override;
+  Status PeerRecv(int peer, std::string* payload) override;
+  Status PeerExchange(int peer, const void* send, int64_t send_len,
+                      std::string* recv) override;
+  Status PeerShift(int send_peer, int recv_peer, const void* send,
+                   int64_t send_len, std::string* recv) override;
   void AbortPeers(const std::string& reason) override;
 
  private:
@@ -202,10 +249,25 @@ class TcpTransport : public ControllerTransport {
   Status ConnectWithBackoff(const ::sockaddr_in& peer,
                             const std::string& what, double timeout_sec,
                             int* out_fd);
+  // Full-duplex framed exchange over an arbitrary (send_fd, recv_fd) pair
+  // — the poll() interleave behind both RingExchange and PeerExchange.
+  Status DuplexExchange(int send_fd, int recv_fd, const void* send,
+                        int64_t send_len, std::string* recv,
+                        const char* send_point, const char* recv_point);
   // Lazily builds neighbor links: every rank binds an ephemeral listener,
   // addresses ride a Gather+Bcast on the star, then each rank connects to
   // its successor and accepts from its predecessor.
   Status EnsureRing();
+  // Lazily builds the pairwise mesh rendezvous: every rank binds a second
+  // ephemeral listener (distinct from the ring's so accepts can't
+  // mis-pair) and the address table rides a Gather+Bcast on the star.
+  // Links themselves connect on first use (EnsurePeer).
+  Status EnsureMesh();
+  // One live fd to `peer`, connecting (lower rank) or accepting with a
+  // rank handshake (higher rank) on first use. Out-of-order accepts —
+  // a fast peer's connect landing while this rank still converses with
+  // another — are stashed by handshake rank until their exchange starts.
+  Status EnsurePeer(int peer, int* out_fd);
 
   int rank_;
   int size_;
@@ -222,6 +284,11 @@ class TcpTransport : public ControllerTransport {
   // background thread exists, so plain ints are fine there.
   std::atomic<int> ring_next_fd_{-1};  // to (rank+1)%size
   std::atomic<int> ring_prev_fd_{-1};  // from (rank-1+size)%size
+  // Pairwise mesh (recursive-doubling / hierarchical routes). peer_fds_
+  // entries are atomic for the same AbortPeers reason as the ring fds.
+  int peer_listen_fd_ = -1;
+  std::vector<std::string> peer_addrs_;        // mesh rendezvous table
+  std::vector<std::unique_ptr<std::atomic<int>>> peer_fds_;
   std::atomic<bool> abort_sent_{false};
   std::mt19937 jitter_rng_;          // backoff jitter (seeded by rank)
 };

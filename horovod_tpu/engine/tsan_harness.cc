@@ -30,6 +30,25 @@ int32_t NoopExecute(const char* /*response_json*/, void* /*user_data*/) {
   return 0;
 }
 
+// Phase-2 executor: run a REAL host data-plane allreduce per response so
+// the topology routes (pairwise SPSC mailboxes, recursive doubling,
+// hierarchical phases) execute under the sanitizer. Responses arrive in
+// lockstep order on every rank, so the collective calls pair up.
+int32_t DataPlaneExecute(const char* /*response_json*/, void* user_data) {
+  auto* e = static_cast<Engine*>(user_data);
+  float buf[512];
+  for (int i = 0; i < 512; ++i) buf[i] = 1.0f + e->rank();
+  // one sub-lane payload (256B -> recursive doubling) and one bulk
+  // payload (2KiB >= the 512B lane -> hierarchical) per response, so
+  // both topology routes run under the sanitizer every cycle
+  auto st = e->data_plane()->Allreduce(buf, 64, DataType::FLOAT32,
+                                       ReduceKind::SUM, 1.0, 1.0);
+  if (!st.ok()) return 1;
+  st = e->data_plane()->Allreduce(buf, 512, DataType::FLOAT32,
+                                  ReduceKind::SUM, 1.0, 1.0);
+  return st.ok() ? 0 : 1;
+}
+
 }  // namespace
 
 int main() {
@@ -96,6 +115,61 @@ int main() {
   poller.join();
   for (auto& e : engines) e->Finalize();
   engines.clear();
+
+  // Phase 2: the topology-aware data plane under the sanitizer — a
+  // 2-simulated-host session whose execute callback runs REAL data-plane
+  // allreduces through BOTH routes per response (256B sub-lane ->
+  // recursive doubling; 2KiB >= the 512B lane -> hierarchical),
+  // exercising the pairwise SPSC mailboxes and the canonical reduce.
+  EngineOptions topts = opts;
+  topts.hierarchical_allreduce = true;
+  topts.small_tensor_algo = 1;  // recursive doubling
+  topts.low_latency_threshold_bytes = 512;  // split 256B rd / 1KiB hier
+  TransportConfig ttcfg;
+  ttcfg.kind = "loopback";
+  ttcfg.group = "tsan-topo";
+  std::vector<std::unique_ptr<Engine>> topo;
+  for (int r = 0; r < kRanks; ++r) {
+    topts.host_id = r / 2;
+    topo.push_back(std::make_unique<Engine>(r, kRanks, r % 2, 2, topts,
+                                            ttcfg));
+    auto st = topo.back()->Init();
+    if (!st.ok()) {
+      std::fprintf(stderr, "topo init failed: %s\n", st.reason.c_str());
+      return 1;
+    }
+    topo.back()->SetExecuteCallback(&DataPlaneExecute, topo.back().get());
+  }
+  std::vector<std::thread> tfronts;
+  std::atomic<int> tfailures{0};
+  for (int r = 0; r < kRanks; ++r) {
+    tfronts.emplace_back([&, r] {
+      for (int it = 0; it < 20; ++it) {
+        TensorTableEntry entry;
+        // alternate the payload class across the lane boundary so both
+        // the rd route (64 elems = 256B) and the hierarchical route
+        // (512 elems = 2KiB >= lane) serve traffic
+        entry.name = "topo" + std::to_string(it);
+        entry.dtype = DataType::FLOAT32;
+        entry.shape.dims = {it % 2 == 0 ? 64 : 512};
+        int64_t handle = -1;
+        auto st = topo[r]->EnqueueTensor(entry, &handle);
+        if (st.ok()) st = topo[r]->WaitHandle(handle, 30.0);
+        if (!st.ok()) {
+          tfailures.fetch_add(1);
+          return;
+        }
+      }
+    });
+  }
+  for (auto& t : tfronts) t.join();
+  for (auto& e : topo) e->Finalize();
+  topo.clear();
+  if (tfailures.load() != 0) {
+    std::fprintf(stderr, "topology phase failures: %d\n",
+                 tfailures.load());
+    return 1;
+  }
   std::printf("tsan workload OK (failures after abort: %d)\n",
               failures.load());
   return 0;

@@ -247,6 +247,13 @@ _ENGINE_HELP = {
     "stalled_tensors": "tensors named in stall warnings",
     "data_ring_ops": "data-plane ops routed over the ring",
     "data_star_ops": "data-plane ops routed over the star",
+    "data_rd_ops": "data-plane ops routed over recursive doubling",
+    "data_hier_ops": "data-plane ops routed over the hierarchical "
+                     "two-level path",
+    "data_interhost_bytes": "data-plane payload bytes sent to peers on "
+                            "other hosts (locality map)",
+    "data_intrahost_bytes": "data-plane payload bytes sent to same-host "
+                            "peers (no locality map = all traffic)",
     "aborts": "fast-abort protocol activations",
     "connect_retries": "failed transport connect attempts",
     "crc_failures": "frames rejected by CRC32C",

@@ -72,14 +72,18 @@ _SERVING_FMT = "{:>5} {:>7} {:>4} {:>5} {:>5} {:>8} {:>8} {:>7} {:>6} {:>6}"
 
 # Tune view (--tune): the frontend autotuner's live state per rank, from
 # the hvd_tune_* gauges (horovod_tpu/tune). BUCKET/FUSE/CYC/LANE are the
-# currently applied knobs, PHASE the search stage, OBJ/BEST the last and
-# best measured exposed-comm objective, N the samples spent.
-TUNE_COLUMNS = ("RANK", "BUCKET", "FUSE MB", "CYC ms", "LANE", "COMP",
-                "PHASE", "OBJ ms", "BEST ms", "N")
-_TUNE_FMT = ("{:>5} {:>9} {:>8} {:>7} {:>6} {:>5} {:>9} {:>8} {:>8} "
-             "{:>4}")
+# currently applied knobs, ALGO the data-plane routing decision
+# ("hier+rd@1M" = hierarchical on, recursive-doubling small route, ring
+# threshold 1M — the cycle-fenced TunedParams routing trio), PHASE the
+# search stage, OBJ/BEST the last and best measured exposed-comm
+# objective, N the samples spent.
+TUNE_COLUMNS = ("RANK", "BUCKET", "FUSE MB", "CYC ms", "LANE", "ALGO",
+                "COMP", "PHASE", "OBJ ms", "BEST ms", "N")
+_TUNE_FMT = ("{:>5} {:>9} {:>8} {:>7} {:>6} {:>12} {:>5} {:>9} {:>8} "
+             "{:>8} {:>4}")
 _TUNE_PHASES = {0: "warmup", 1: "sweep", 2: "refine", 3: "converged"}
 _TUNE_COMP = {0: "none", 1: "bf16", 2: "int8"}
+_TUNE_SMALL_ALGO = {0: "star", 1: "rd"}
 
 
 def _parse_hostports(arg: str) -> List[dict]:
@@ -235,6 +239,9 @@ def tune_row_from_snapshot(target: dict, snap: dict) -> dict:
                       else None),
         "cycle_ms": v("hvd_tune_cycle_time_ms"),
         "lane_bytes": v("hvd_tune_low_latency_threshold_bytes"),
+        "ring_threshold_bytes": v("hvd_tune_ring_threshold_bytes"),
+        "hierarchical": v("hvd_tune_hierarchical"),
+        "small_tensor_algo": v("hvd_tune_small_tensor_algo"),
         "compression": (_TUNE_COMP.get(int(comp))
                         if comp is not None else None),
         "phase": (_TUNE_PHASES.get(int(phase))
@@ -256,6 +263,21 @@ def _fmt_bucket(v) -> str:
     return f"{v / 1024:.0f}K"
 
 
+def _fmt_algo(row: dict) -> str:
+    """The routing decision as one cell: "<flat|hier>+<star|rd>@<ring>"
+    — e.g. "hier+rd@1M". "-" when the routing gauges are absent (an older
+    tuner or a space without the routing dimensions)."""
+    hier = row.get("hierarchical")
+    small = row.get("small_tensor_algo")
+    ring = row.get("ring_threshold_bytes")
+    if hier is None and small is None and ring is None:
+        return "-"
+    level = "hier" if hier else "flat"
+    route = _TUNE_SMALL_ALGO.get(int(small), "star") \
+        if small is not None else "star"
+    return f"{level}+{route}@{_fmt_bucket(ring)}"
+
+
 def render_tune(rows: List[dict], unreachable: int = 0,
                 title: str = "") -> str:
     lines = []
@@ -268,6 +290,7 @@ def render_tune(rows: List[dict], unreachable: int = 0,
             _fmt(r["fusion_mb"], "{:.0f}"),
             _fmt(r["cycle_ms"], "{:.2f}"),
             _fmt_bucket(r["lane_bytes"]),
+            _fmt_algo(r),
             r["compression"] or "-", r["phase"] or "-",
             _fmt(r["objective_ms"], "{:.2f}"),
             _fmt(r["best_ms"], "{:.2f}"),

@@ -284,10 +284,21 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--stall-shutdown-time-seconds", type=float, default=None)
     p.add_argument("--no-stall-check", action="store_true")
     p.add_argument("--hierarchical-allreduce", action="store_true",
-                   help="two-level gradient reduction: reduce-scatter over "
-                        "the fast (ICI) mesh axes, cross-slice allreduce "
-                        "over the slow axis, all-gather back "
-                        "(HOROVOD_HIERARCHICAL_ALLREDUCE)")
+                   help="two-level topology-aware allreduce "
+                        "(HOROVOD_HIERARCHICAL_ALLREDUCE): in-jit, "
+                        "reduce-scatter over the fast (ICI) mesh axes + "
+                        "cross-slice allreduce + all-gather back; on the "
+                        "host data plane, intra-host reduce-scatter -> "
+                        "inter-host allreduce among local leaders -> "
+                        "intra-host allgather (the engine groups ranks by "
+                        "the HOROVOD_CROSS_RANK host index this launcher "
+                        "exports per slot)")
+    p.add_argument("--small-tensor-algo", choices=("star", "rd"),
+                   default=None,
+                   help="host data-plane route for sub-express-lane "
+                        "allreduces (HOROVOD_SMALL_TENSOR_ALGO): 'star' "
+                        "(rank-0 hub) or 'rd' (log2(p) recursive "
+                        "doubling, no hub hotspot)")
     p.add_argument("--autotune", action="store_true",
                    help="enable online Bayesian tuning of cycle time / "
                         "fusion threshold / cache (HOROVOD_AUTOTUNE)")
@@ -327,6 +338,8 @@ def _engine_env(args) -> dict:
         env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
     if args.hierarchical_allreduce:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.small_tensor_algo is not None:
+        env["HOROVOD_SMALL_TENSOR_ALGO"] = args.small_tensor_algo
     if args.autotune:
         env["HOROVOD_AUTOTUNE"] = "1"
     if args.autotune_log:

@@ -99,6 +99,17 @@ def default_space(engine_knobs: bool = True,
             # fusion (the serving express lane, opened to training).
             Knob("low_latency_threshold_bytes", "choice", 0,
                  choices=(0, 1 * KIB, 4 * KIB, 16 * KIB)),
+            # Data-plane routing (cycle-fenced through the TunedParams
+            # broadcast since ABI 10, so the search is safe at runtime):
+            # the star->ring payload boundary, the two-level hierarchical
+            # allreduce gate (only pays off with a multi-host locality
+            # map — the engine falls back to flat routing without one),
+            # and the sub-express-lane allreduce route.
+            Knob("ring_threshold_bytes", "log_int", 1 * MIB,
+                 lo=64 * KIB, hi=64 * MIB),
+            Knob("hierarchical_allreduce", "choice", 0, choices=(0, 1)),
+            Knob("small_tensor_algo", "choice", "star",
+                 choices=("star", "rd")),
         ]
     if compression:
         knobs.append(Knob("compression", "choice", "none",

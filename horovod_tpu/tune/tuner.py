@@ -47,13 +47,18 @@ from horovod_tpu.tune.search import CoordinateSearch
 from horovod_tpu.tune.space import Knob, default_space
 
 # Knobs the engine adopts via the runtime push; everything else is in-jit
-# and needs the staged recompile.
+# and needs the staged recompile. The data-plane routing trio
+# (ring threshold / hierarchy / small-tensor algo) became pushable at
+# engine ABI 10 — the per-cycle TunedParams broadcast fences them, so the
+# search never splits ranks across algorithms.
 ENGINE_KNOBS = ("fusion_threshold_bytes", "cycle_time_ms",
-                "low_latency_threshold_bytes")
+                "low_latency_threshold_bytes", "ring_threshold_bytes",
+                "hierarchical_allreduce", "small_tensor_algo")
 IN_JIT_KNOBS = ("bucket_bytes", "compression")
 
 PHASES = {"warmup": 0, "sweep": 1, "refine": 2, "converged": 3}
 _COMPRESSION_CODE = {"none": 0, "bf16": 1, "int8": 2}
+_SMALL_ALGO_CODE = {"star": 0, "rd": 1}
 
 
 def resolve_compression(name: str):
@@ -343,6 +348,15 @@ class TuningSession:
             lane = int(self.config["low_latency_threshold_bytes"])
             kwargs["low_latency_threshold_bytes"] = lane if lane > 0 else 0
             kwargs["express_lane"] = lane > 0
+        if "ring_threshold_bytes" in self.config:
+            kwargs["ring_threshold_bytes"] = int(
+                self.config["ring_threshold_bytes"])
+        if "hierarchical_allreduce" in self.config:
+            kwargs["hierarchical"] = bool(
+                self.config["hierarchical_allreduce"])
+        if "small_tensor_algo" in self.config:
+            kwargs["small_tensor_algo"] = str(
+                self.config["small_tensor_algo"])
         if not kwargs:
             return
         try:
@@ -360,13 +374,18 @@ class TuningSession:
             self._log_file.write(
                 "objective_seconds,source,bucket_bytes,"
                 "fusion_threshold_bytes,cycle_time_ms,"
-                "low_latency_threshold_bytes,compression,phase,banned\n")
+                "low_latency_threshold_bytes,ring_threshold_bytes,"
+                "hierarchical_allreduce,small_tensor_algo,compression,"
+                "phase,banned\n")
         c = self.config
         self._log_file.write(
             f"{objective:.9f},{source},{c.get('bucket_bytes', '')},"
             f"{c.get('fusion_threshold_bytes', '')},"
             f"{c.get('cycle_time_ms', '')},"
             f"{c.get('low_latency_threshold_bytes', '')},"
+            f"{c.get('ring_threshold_bytes', '')},"
+            f"{c.get('hierarchical_allreduce', '')},"
+            f"{c.get('small_tensor_algo', '')},"
             f"{c.get('compression', '')},{self._search.phase},"
             f"{int(banned)}\n")
         self._log_file.flush()
@@ -400,6 +419,20 @@ class TuningSession:
             g("hvd_tune_low_latency_threshold_bytes",
               "express-lane class boundary (0 = lane off)").set(
                   float(c["low_latency_threshold_bytes"]))
+        if "ring_threshold_bytes" in c:
+            g("hvd_tune_ring_threshold_bytes",
+              "data-plane star->ring payload boundary pushed by the tuner"
+              ).set(float(c["ring_threshold_bytes"]))
+        if "hierarchical_allreduce" in c:
+            g("hvd_tune_hierarchical",
+              "two-level topology-aware allreduce gate (0 flat / 1 "
+              "hierarchical)").set(float(c["hierarchical_allreduce"]))
+        if "small_tensor_algo" in c:
+            g("hvd_tune_small_tensor_algo",
+              "sub-express-lane allreduce route (0 star / 1 recursive "
+              "doubling)").set(
+                  float(_SMALL_ALGO_CODE.get(str(c["small_tensor_algo"]),
+                                             0)))
         if "compression" in c:
             g("hvd_tune_compression",
               "gradient wire format (0 none / 1 bf16 / 2 int8)").set(

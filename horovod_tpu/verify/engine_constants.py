@@ -63,6 +63,40 @@ def abi_version() -> int:
     return int(m.group(1))
 
 
+_RING_THRESH_RE = re.compile(
+    r"ring_threshold_bytes\s*=\s*(\d+)(?:\s*<<\s*(\d+))?\s*;")
+_SMALL_ALGO_RE = re.compile(
+    r"constexpr\s+int32_t\s+kSmallTensor(\w+)\s*=\s*(\d+)\s*;")
+
+
+@lru_cache(maxsize=None)
+def ring_threshold_default() -> int:
+    """Default star->ring payload boundary in bytes (common.h
+    EngineOptions) — the TunedParams routing seed the tune spec's
+    env-divergence mutant models, asserted against the env registry's
+    HOROVOD_RING_THRESHOLD_BYTES default by tests."""
+    m = _RING_THRESH_RE.search(_read("common.h"))
+    if m is None:
+        raise RuntimeError(
+            "ring_threshold_bytes default not found in common.h")
+    base = int(m.group(1))
+    return base << int(m.group(2)) if m.group(2) else base
+
+
+@lru_cache(maxsize=None)
+def small_tensor_algo_ids() -> Dict[str, int]:
+    """{algo name: wire id} for TunedParams.small_tensor_algo, parsed
+    from data_plane.h (kSmallTensorStar / kSmallTensorRecursiveDoubling)
+    — tests assert agreement with bindings.SMALL_TENSOR_ALGOS so the
+    Python push surface can't drift from the engine's ids."""
+    ids = {name: int(v)
+           for name, v in _SMALL_ALGO_RE.findall(_read("data_plane.h"))}
+    if not ids:
+        raise RuntimeError(
+            "no kSmallTensor* constants parsed from data_plane.h")
+    return ids
+
+
 @lru_cache(maxsize=None)
 def low_latency_threshold_default() -> int:
     """Default express-lane eligibility threshold in bytes (common.h) —

@@ -572,26 +572,45 @@ class DrainSpec(Spec):
 class TuneState(NamedTuple):
     staged: int     # version staged on the coordinator
     applied: tuple  # per rank: applied version
+    routing: tuple  # per rank: applied data-plane routing version
     pushes_left: int
+    env_reads_left: int  # budget for the env-divergence mutation
+
+
+# Sentinel routing value an env read installs (distinct from any staged
+# broadcast version, like a rank-local HOROVOD_RING_THRESHOLD_BYTES).
+_ENV_ROUTING = -7
 
 
 class TuneSpec(Spec):
     """The frontend tuner pushes knob records (``hvdtpu_set_tuned_params``)
     that must be adopted by EVERY rank at the same coordination-cycle
-    boundary — rank-divergent fusion knobs desync exec order. The
-    ``apply_inline`` mutation re-introduces the hazard the staged
-    broadcast exists to prevent: applying the push immediately on the
-    coordinator."""
+    boundary — rank-divergent fusion knobs desync exec order, and
+    rank-divergent data-plane ROUTING knobs (ring threshold / hierarchy /
+    small-tensor algo, carried by the same record since ABI 10) would put
+    two ranks on different collective algorithms and deadlock the
+    transports. The ``apply_inline`` mutation re-introduces the hazard
+    the staged broadcast exists to prevent: applying the push immediately
+    on the coordinator. The ``env_divergent_routing`` mutation
+    re-introduces the pre-ABI-10 behavior this PR removed: a rank reading
+    ``HOROVOD_RING_THRESHOLD_BYTES`` straight off its own environment
+    instead of adopting the broadcast."""
 
-    def __init__(self, ranks: int = 2, apply_inline: bool = False):
+    def __init__(self, ranks: int = 2, apply_inline: bool = False,
+                 env_divergent_routing: bool = False):
         super().__init__(name="tune", mutations=tuple(
-            m for m, on in [("apply_inline", apply_inline)] if on))
+            m for m, on in [("apply_inline", apply_inline),
+                            ("env_divergent_routing",
+                             env_divergent_routing)] if on))
         self.ranks = ranks
         self.apply_inline = apply_inline
+        self.env_divergent_routing = env_divergent_routing
 
     def initial(self) -> TuneState:
         return TuneState(staged=0, applied=(0,) * self.ranks,
-                         pushes_left=2)
+                         routing=(0,) * self.ranks, pushes_left=2,
+                         env_reads_left=1 if self.env_divergent_routing
+                         else 0)
 
     def actions(self, s: TuneState):
         # A lost/aborted param broadcast needs no explicit fault action:
@@ -611,10 +630,19 @@ class TuneSpec(Spec):
             out.append((label, s._replace(
                 staged=v, applied=applied,
                 pushes_left=s.pushes_left - 1)))
+        if s.env_reads_left > 0:
+            for r in range(self.ranks):
+                out.append((
+                    f"rank {r} seeds its routing from its own env "
+                    "(MUTATION: HOROVOD_RING_THRESHOLD_BYTES read "
+                    "outside the broadcast)",
+                    s._replace(routing=_rep(s.routing, r, _ENV_ROUTING),
+                               env_reads_left=s.env_reads_left - 1)))
         out.append((
             f"cycle boundary: SynchronizeParameters broadcast applies "
-            f"v{s.staged} on every rank",
-            s._replace(applied=(s.staged,) * self.ranks)))
+            f"v{s.staged} (params + routing) on every rank",
+            s._replace(applied=(s.staged,) * self.ranks,
+                       routing=(s.staged,) * self.ranks)))
         return out
 
     @property
@@ -626,6 +654,13 @@ class TuneSpec(Spec):
                 "applied TunedParams (rank-divergent fusion/express "
                 "knobs desync exec order)",
                 lambda s: len(set(s.applied)) == 1),
+            Invariant(
+                "routing_agrees_between_cycles",
+                "between coordination cycles every rank runs the same "
+                "data-plane routing knobs (a split ring-threshold / "
+                "hierarchy / small-tensor decision deadlocks the "
+                "transports mid-collective)",
+                lambda s: len(set(s.routing)) == 1),
             Invariant(
                 "applied_never_ahead_of_staged",
                 "no rank applies a params version the coordinator has "
@@ -690,6 +725,12 @@ MUTANTS: Dict[str, Tuple[str, str, str]] = {
         "TunedParams applied inline at push instead of staged for the "
         "cycle-boundary broadcast: the coordinator runs different knobs "
         "than its peers mid-cycle"),
+    "tune_env_divergent_routing": (
+        "tune", "env_divergent_routing",
+        "pre-ABI-10 data-plane routing: a rank seeds its ring threshold "
+        "from its own HOROVOD_RING_THRESHOLD_BYTES instead of the "
+        "cycle-fenced TunedParams broadcast — two ranks route the same "
+        "collective through different algorithms and deadlock"),
 }
 
 

@@ -89,13 +89,14 @@ def test_stall_report_empty_before_any_warning():
 # ABI guard
 
 
-def test_abi_version_is_9():
-    # 8 → 9: hvdtpu_set_tuned_params / hvdtpu_get_tuned_params (runtime
-    # engine-knob push through the parameter-sync broadcast); TunedParams
-    # wire record gains low_latency_threshold_bytes + express_lane
+def test_abi_version_is_10():
+    # 9 → 10: topology-aware data plane — hvdtpu_create_session gains
+    # host_id (launcher locality map), hvdtpu_set_tuned_params gains the
+    # cycle-fenced routing knobs (ring_threshold_bytes / hierarchical /
+    # small_tensor_algo), hvdtpu_data_algo_ops added
     lib = bindings.load_library()
-    assert bindings.ABI_VERSION == 9
-    assert lib.hvdtpu_abi_version() == 9
+    assert bindings.ABI_VERSION == 10
+    assert lib.hvdtpu_abi_version() == 10
 
 
 def test_stale_library_refused(monkeypatch):
