@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all lint lock-graph check-protocols conformance engine top tune-smoke tsan asan ubsan sanitizers test test-fast soak clean
+.PHONY: all lint lock-graph check-protocols conformance engine top tune-smoke autoscale-smoke tsan asan ubsan sanitizers test test-fast soak clean
 
 all: engine
 
@@ -56,6 +56,19 @@ TUNE_SMOKE_STEPS ?= 20
 tune-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.tune.smoke \
 	    --steps $(TUNE_SMOKE_STEPS)
+
+# Bounded closed-loop autoscale demo (serve/autoscale_smoke.py): loadgen
+# flash crowd -> scale-up (chaos kill injected mid-resize, re-routed with
+# zero accepted-request loss) -> recede -> drain-based scale-down, driven
+# by the real Autoscaler + epoch-claimed KV decision records. Minutes,
+# not hours; exit 1 if any acceptance flag fails. AUTOSCALE_TRACE picks
+# flash (default) or diurnal.
+AUTOSCALE_TRACE ?= flash
+AUTOSCALE_SCALE ?= 3.0
+autoscale-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m horovod_tpu.serve.autoscale_smoke \
+	    --trace $(AUTOSCALE_TRACE) --chaos-kill \
+	    --seconds-scale $(AUTOSCALE_SCALE)
 
 # Sanitizer matrix over the pure-C++ engine harness (tsan_harness.cc):
 # data races (tsan), heap errors + leaks (asan), undefined behavior

@@ -38,7 +38,9 @@ Modes: ``--scaling-probe`` (internal subprocess), ``--host-microbench``
 (host data-plane Combine kernel bytes/s incl. the scalar-baseline speedup;
 prints its own JSON line and exits — no TPU needed), ``--tuning-only``
 (refresh just the ``tuning`` block: the bounded CPU-backend autotuner
-session, horovod_tpu/tune/smoke.py — no TPU needed).
+session, horovod_tpu/tune/smoke.py — no TPU needed), ``--autoscale-only``
+(refresh just the ``autoscale`` block: the closed-loop fleet sim,
+serve/autoscale_smoke.py — no TPU needed).
 """
 
 import json
@@ -700,6 +702,20 @@ def main():
             print(f"serving bench failed: {e!r}", file=sys.stderr)
             serving = {"error": repr(e)}
 
+    # Traffic-driven autoscaling (ISSUE 15 acceptance: `autoscale` block —
+    # diurnal + flash-crowd traces through the real Autoscaler closed
+    # loop, a chaos kill injected mid-resize, p99 held within the SLO
+    # bound, accepted-request loss pinned at zero, and a fleet trace
+    # showing scale-up AND drain-based scale-down with no flapping).
+    if "autoscale" in SKIP:
+        autoscale_block = {"skipped": True}
+    else:
+        try:
+            autoscale_block = _autoscale_bench()
+        except Exception as e:  # must not sink the training bench
+            print(f"autoscale bench failed: {e!r}", file=sys.stderr)
+            autoscale_block = {"error": repr(e)}
+
     # Elastic resize (ISSUE 9 acceptance: `elastic` block — recovery time
     # after a kill, resize cost in seconds + wire bytes for 8→7 and 7→8,
     # checkpoint-restore vs live-reshard comparison).
@@ -772,6 +788,7 @@ def main():
         "flight_recorder_overhead": flight_overhead,
         "step_attribution": step_attribution,
         "serving": serving,
+        "autoscale": autoscale_block,
         "elastic": elastic_block,
         "control_plane": control_plane,
         "tuning": tuning,
@@ -1023,6 +1040,53 @@ def _control_plane_bench():
         "(20ms beat, 0.5s total-deadline PUTs); headless = last pre-kill "
         "ack -> first post-recovery ack; replay seconds from the "
         "hvd_kv_replay_seconds gauge's source")
+    return out
+
+
+def _autoscale_bench():
+    """The BENCH ``autoscale`` block: the full closed loop from offered
+    load to fleet size (serve/autoscale_smoke.py — real Autoscaler, real
+    router, epoch-claimed KV decision records).
+
+    Method: a flash-crowd trace (base load, a crowd ~2.4x one worker's
+    capacity, recession) with a chaos kill dropped on the original worker
+    WHILE the scale-up resize is in flight — the router re-routes its
+    in-flight requests and the fleet re-grows; and a diurnal staircase
+    with no chaos. Acceptance per trace: accepted-request loss == 0
+    (429s/sheds are backpressure, not loss), every completed-load
+    window's p99 inside the SLO bound, at least one scale-up AND one
+    drain-based scale-down in the decision log, and no opposite-direction
+    decisions inside one hysteresis window (no flapping)."""
+    from horovod_tpu.serve.autoscale_smoke import run_smoke
+
+    out = {}
+    for trace, chaos in (("flash", True), ("diurnal", False)):
+        r = run_smoke(trace=trace, chaos_kill=chaos, seconds_scale=3.0)
+        fleet_sizes = [p["fleet"] for p in r["fleet_trace"]
+                       if "fleet" in p]
+        out[trace] = {
+            "single_worker_capacity_qps": r[
+                "single_worker_capacity_qps"],
+            "p99_bound_ms": r["p99_bound_ms"],
+            "windows": [{k: w[k] for k in (
+                "offered_qps", "completed_ok", "rejected", "expired",
+                "failed", "achieved_qps", "p50_ms", "p99_ms",
+                "fleet_at_end")} for w in r["windows"]],
+            "decisions": r["decisions"],
+            "fleet_sizes": fleet_sizes,
+            "fleet_max": r["fleet_max"],
+            "chaos": r["chaos"],
+            "rerouted": r["rerouted"],
+            "accepted_loss": r["accepted_loss"],
+            "max_p99_ms": r["max_p99_ms"],
+            "acceptance": {
+                "p99_within_bound": r["p99_within_bound"],
+                "zero_accepted_loss": r["accepted_loss"] == 0,
+                "scale_up_seen": r["scale_up_seen"],
+                "scale_down_seen": r["scale_down_seen"],
+                "no_flap": r["no_flap"],
+            },
+        }
     return out
 
 
@@ -1432,5 +1496,11 @@ if __name__ == "__main__":
         # multi-host simulation, inter-host wire accounting); one JSON
         # line, no TPU needed.
         print(json.dumps(_dataplane_bench()))
+    elif "--autoscale-only" in sys.argv:
+        # Refresh just the autoscale block (closed-loop fleet sim —
+        # flash crowd w/ chaos kill + diurnal trace); one JSON line,
+        # no TPU needed.
+        print(json.dumps({"metric": "autoscale",
+                          "autoscale": _autoscale_bench()}))
     else:
         main()

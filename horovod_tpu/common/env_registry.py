@@ -168,6 +168,43 @@ _decl("HOROVOD_SERVE_DRAIN_TIMEOUT_SECONDS", "float", 10.0,
       "requests before they are re-routed")
 _decl("HOROVOD_SERVE_RETRY_LIMIT", "int", 3,
       "re-route attempts per accepted request before it fails loudly")
+_decl("HOROVOD_SERVE_PRIORITY_CLASSES", "str", "batch,standard,premium",
+      "comma-separated priority classes, lowest first; under queue "
+      "pressure the lowest classes are shed first (each class admits "
+      "only while the queue is under its fill threshold)")
+_decl("HOROVOD_SERVE_TENANT_QPS", "float", 0.0,
+      "per-tenant token-bucket refill rate in requests/sec (0 = quotas "
+      "off); exhausted tenants get 429 + Retry-After")
+_decl("HOROVOD_SERVE_TENANT_BURST", "float", 10.0,
+      "per-tenant token-bucket capacity (burst size)")
+
+# -- traffic-driven autoscaler (driver policy loop) --
+_decl("HOROVOD_AUTOSCALE", "bool", False,
+      "driver-side autoscaler: watch serving SLOs scraped from worker "
+      "/metrics.json and grow/shrink the fleet (scale-up on sustained "
+      "queue depth / p99 breach, scale-down by draining idle workers)")
+_decl("HOROVOD_AUTOSCALE_MIN_WORKERS", "int", 1,
+      "fleet floor: scale-down never drains below this many workers")
+_decl("HOROVOD_AUTOSCALE_MAX_WORKERS", "int", 8,
+      "fleet ceiling: scale-up never targets more than this many workers")
+_decl("HOROVOD_AUTOSCALE_UP_WINDOWS", "int", 2,
+      "consecutive breached observation windows before a scale-up "
+      "(hysteresis — a one-window spike never resizes the fleet)")
+_decl("HOROVOD_AUTOSCALE_DOWN_WINDOWS", "int", 2,
+      "consecutive idle observation windows before a scale-down drain")
+_decl("HOROVOD_AUTOSCALE_UP_COOLDOWN_SECONDS", "float", 5.0,
+      "minimum seconds between scale-up decisions")
+_decl("HOROVOD_AUTOSCALE_DOWN_COOLDOWN_SECONDS", "float", 15.0,
+      "minimum seconds between scale-down decisions (longer than up: "
+      "shedding capacity is the riskier direction)")
+_decl("HOROVOD_AUTOSCALE_QUEUE_BOUND", "int", 8,
+      "per-worker admission queue depth above which a window counts as "
+      "breached (scale-up pressure)")
+_decl("HOROVOD_AUTOSCALE_P99_MS_BOUND", "float", 500.0,
+      "request p99 latency SLO in ms; a window past it counts as breached")
+_decl("HOROVOD_AUTOSCALE_IDLE_OCCUPANCY", "float", 0.25,
+      "fleet mean in-flight requests per worker at or below which (with "
+      "every queue empty) a window counts as idle (scale-down pressure)")
 
 # -- frontend exposed-comm tuner (horovod_tpu/tune) --
 _decl("HOROVOD_TUNE", "bool", False,

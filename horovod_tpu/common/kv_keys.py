@@ -121,6 +121,13 @@ _decl("serve_addr", "serve_addr/<host>/<local_rank>", "serve-worker", False,
 _decl("serve_stop", "serve_stop", "serve-worker", False,
       "cooperative stop signal polled by serving workers")
 
+# -- traffic-driven autoscaler (driver-published, epoch-claimed) ------------
+_decl("autoscale_decision", "autoscale/decision", "driver", True,
+      "the autoscaler's current decision record (decide→drain→resize→ack "
+      "state machine; a recovered driver resumes it instead of re-deciding)")
+_decl("autoscale_event", "autoscale/event/<seq>", "driver", True,
+      "per-decision audit record (action, reason, victim, outcome)")
+
 # -- autotuner parameter sync ----------------------------------------------
 _decl("tune_config", "tune_config/<job>", "tuner", False,
       "converged tuner config for a job (follower adoption)")
@@ -228,6 +235,14 @@ def serve_stop() -> str:
 
 def metrics_addr(host, local_rank) -> str:
     return f"metrics_addr/{host}/{local_rank}"
+
+
+def autoscale_decision() -> str:
+    return "autoscale/decision"
+
+
+def autoscale_event(seq: int) -> str:
+    return f"autoscale/event/{int(seq)}"
 
 
 def tune_config(job: str) -> str:

@@ -37,6 +37,13 @@ threshold / cycle time / express-lane class / compression, search phase,
 last and best exposed-comm objective, samples spent — the ``hvd_tune_*``
 gauges the frontend tuner exports, :mod:`horovod_tpu.tune`).
 
+``--autoscale`` switches to the autoscaler view: a banner with the fleet
+size and the last scaling decision (action, state, reason, age — the
+epoch-claimed ``autoscale/decision`` KV record, when ``--kv`` or the
+rendezvous env points at the KV), then per-rank queue depth, in-flight,
+p99, SLO headroom (the policy's own :func:`slo_headroom` formula) and
+the admission plane's per-class admit/shed counters.
+
 CLI::
 
     hvd-top --targets 127.0.0.1:9090,127.0.0.1:9091
@@ -85,6 +92,16 @@ _TUNE_PHASES = {0: "warmup", 1: "sweep", 2: "refine", 3: "converged"}
 _TUNE_COMP = {0: "none", 1: "bf16", 2: "int8"}
 _TUNE_SMALL_ALGO = {0: "star", 1: "rd"}
 
+# Autoscale view (--autoscale): per-rank serving SLO headroom + the
+# admission plane's per-class counters, plus a banner line carrying the
+# fleet size and the autoscaler's last decision (reason + age) when a
+# rendezvous KV is reachable (the epoch-claimed autoscale/decision
+# record). HEADRM is the shared slo_headroom() formula the policy's
+# breach test uses: 1.0 idle, 0.0 at the bound, negative = breached.
+AUTOSCALE_COLUMNS = ("RANK", "QD", "INFL", "p99ms", "HEADRM", "ADM",
+                     "SHED", "QUOTA")
+_AUTOSCALE_FMT = "{:>5} {:>5} {:>5} {:>8} {:>7} {:>8} {:>7} {:>6}"
+
 
 def _parse_hostports(arg: str) -> List[dict]:
     out = []
@@ -102,23 +119,28 @@ def _parse_hostports(arg: str) -> List[dict]:
     return out
 
 
-def discover_targets(args) -> List[dict]:
-    """[{addr, port, rank?}] per the priority order in the module doc."""
-    if args.targets:
-        return _parse_hostports(args.targets)
-    kv = None
+def _kv_coords(args) -> Optional[Tuple[str, int]]:
+    """(host, port) of the rendezvous KV per --kv / the env, or None."""
     if args.kv:
         host, _, port = args.kv.rpartition(":")
         try:
-            kv = (host or "127.0.0.1", int(port))
+            return (host or "127.0.0.1", int(port))
         except ValueError:
             raise ValueError(
                 f"invalid --kv address {args.kv!r} (want host:port)") \
                 from None
-    elif env_str("HOROVOD_RENDEZVOUS_ADDR") and \
+    if env_str("HOROVOD_RENDEZVOUS_ADDR") and \
             env_int("HOROVOD_RENDEZVOUS_PORT"):
-        kv = (env_str("HOROVOD_RENDEZVOUS_ADDR"),
-              env_int("HOROVOD_RENDEZVOUS_PORT"))
+        return (env_str("HOROVOD_RENDEZVOUS_ADDR"),
+                env_int("HOROVOD_RENDEZVOUS_PORT"))
+    return None
+
+
+def discover_targets(args) -> List[dict]:
+    """[{addr, port, rank?}] per the priority order in the module doc."""
+    if args.targets:
+        return _parse_hostports(args.targets)
+    kv = _kv_coords(args)
     if kv is not None:
         from horovod_tpu.runner.http_kv import KVClient
         from horovod_tpu.common import kv_keys
@@ -252,6 +274,88 @@ def tune_row_from_snapshot(target: dict, snap: dict) -> dict:
     }
 
 
+def admission_class_counters(snap: dict) -> Dict[str, Dict[str, float]]:
+    """``{class: {"admitted": n, "shed": n}}`` from one snapshot — the
+    per-class admit/shed families serve/admission.py exports."""
+    out: Dict[str, Dict[str, float]] = {}
+    for m in snap.get("metrics", []):
+        field = {"hvd_serve_admit_total": "admitted",
+                 "hvd_serve_shed_total": "shed"}.get(m.get("name"))
+        if field is None:
+            continue
+        for s in m.get("samples", []):
+            cls = s.get("labels", {}).get("class")
+            if cls is None or "value" not in s:
+                continue
+            out.setdefault(cls, {"admitted": 0.0, "shed": 0.0})
+            out[cls][field] += float(s["value"])
+    return out
+
+
+def autoscale_row_from_snapshot(target: dict, snap: dict) -> dict:
+    """One autoscale-view row: the same WorkerSLO extraction the driver's
+    policy loop uses, plus the admission counters."""
+    from horovod_tpu.metrics import histogram_quantile, snapshot_histogram
+    from horovod_tpu.runner.elastic.autoscaler import slo_headroom
+    qd = snapshot_value(snap, "hvd_serve_queue_depth")
+    lat = snapshot_histogram(snap, "hvd_serve_request_latency_seconds")
+    p99 = histogram_quantile(lat, 0.99) if lat else None
+    p99_ms = p99 * 1e3 if p99 is not None else None
+    classes = admission_class_counters(snap)
+    return {
+        "rank": _rank_of(target, snap),
+        "queue_depth": qd,
+        "inflight": snapshot_value(snap, "hvd_serve_inflight"),
+        "p99_ms": p99_ms,
+        "headroom": slo_headroom(qd, p99_ms),
+        "admitted": sum(c["admitted"] for c in classes.values())
+        if classes else None,
+        "shed": sum(c["shed"] for c in classes.values())
+        if classes else None,
+        "quota_shed": snapshot_value(snap, "hvd_serve_quota_shed_total"),
+        "classes": classes,
+    }
+
+
+def render_autoscale(rows: List[dict], unreachable: int = 0,
+                     title: str = "", status: Optional[dict] = None) -> str:
+    lines = []
+    if title:
+        lines.append(title)
+    if status is not None:
+        age = status.get("age_seconds")
+        lines.append(
+            f"fleet={status.get('fleet', '-')} "
+            f"last={status.get('action', '-')}"
+            f"[{status.get('state', '-')}] "
+            f"reason={status.get('reason') or '-'} "
+            f"age={age if age is not None else '-'}s")
+    else:
+        lines.append(f"fleet={len(rows)} (no KV: last decision unknown — "
+                     f"pass --kv for the autoscale/decision record)")
+    lines.append(_AUTOSCALE_FMT.format(*AUTOSCALE_COLUMNS))
+    classes: Dict[str, Dict[str, float]] = {}
+    for r in rows:
+        for cls, c in r.get("classes", {}).items():
+            agg = classes.setdefault(cls, {"admitted": 0.0, "shed": 0.0})
+            agg["admitted"] += c["admitted"]
+            agg["shed"] += c["shed"]
+        lines.append(_AUTOSCALE_FMT.format(
+            r["rank"], _fmt(r["queue_depth"], "{:.0f}"),
+            _fmt(r["inflight"], "{:.0f}"),
+            _fmt(r["p99_ms"], "{:.2f}"),
+            _fmt(r["headroom"], "{:+.2f}"),
+            _fmt(r["admitted"], "{:.0f}"), _fmt(r["shed"], "{:.0f}"),
+            _fmt(r["quota_shed"], "{:.0f}")))
+    if classes:
+        lines.append("classes (admit/shed): " + "  ".join(
+            f"{cls} {int(c['admitted'])}/{int(c['shed'])}"
+            for cls, c in sorted(classes.items())))
+    if unreachable:
+        lines.append(f"({unreachable} target(s) unreachable)")
+    return "\n".join(lines)
+
+
 def _fmt_bucket(v) -> str:
     if v is None:
         return "-"
@@ -360,10 +464,13 @@ class TopState:
     succeeds again — ``stale_age_seconds`` is None while fresh."""
 
     def __init__(self, targets: List[dict], serving: bool = False,
-                 tune: bool = False):
+                 tune: bool = False, autoscale: bool = False,
+                 kv: Optional[Tuple[str, int]] = None):
         self.targets = targets
         self.serving = serving
         self.tune = tune
+        self.autoscale = autoscale
+        self._kv = kv
         self._prev: Dict[int, Tuple] = {}
         self._last_rows: List[dict] = []
         self._last_scrape: Optional[float] = None  # monotonic
@@ -377,7 +484,9 @@ class TopState:
                 unreachable += 1
                 continue
             prev = self._prev.get(i) if window else None
-            if self.tune:
+            if self.autoscale:
+                row = autoscale_row_from_snapshot(t, snap)
+            elif self.tune:
                 row = tune_row_from_snapshot(t, snap)
             elif self.serving:
                 row = serving_row_from_snapshot(t, snap, prev)
@@ -399,9 +508,26 @@ class TopState:
             return list(self._last_rows), unreachable
         return rows, unreachable
 
+    def autoscale_status(self) -> Optional[dict]:
+        """The KV's autoscale/decision record (banner), when reachable."""
+        if self._kv is None:
+            return None
+        try:
+            from horovod_tpu.runner.elastic.autoscaler import \
+                autoscale_status
+            from horovod_tpu.runner.http_kv import KVClient
+            client = KVClient(*self._kv)
+            return autoscale_status(
+                lambda key: client.get_json(key, timeout=2.0))
+        except Exception:  # noqa: BLE001 — KV outage: banner only
+            return None
+
     def render(self, rows: List[dict], unreachable: int,
                title: str) -> str:
-        if self.tune:
+        if self.autoscale:
+            text = render_autoscale(rows, unreachable, title,
+                                    status=self.autoscale_status())
+        elif self.tune:
             text = render_tune(rows, unreachable, title)
         elif self.serving:
             text = render_serving(rows, unreachable, title)
@@ -473,14 +599,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="tuner view: current bucket/fusion/cycle/"
                              "express-lane knobs, search phase, objective "
                              "trend (hvd_tune_* gauges)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="autoscale view: fleet size + last decision "
+                             "(KV autoscale/decision record), per-rank "
+                             "SLO headroom, per-class admit/shed "
+                             "counters")
     args = parser.parse_args(argv)
-    if args.serving and args.tune:
-        print("hvd-top: --serving and --tune are mutually exclusive",
-              file=sys.stderr)
+    if sum((args.serving, args.tune, args.autoscale)) > 1:
+        print("hvd-top: --serving, --tune and --autoscale are mutually "
+              "exclusive", file=sys.stderr)
         return 2
 
     try:
         targets = discover_targets(args)
+        kv = _kv_coords(args)
     except ValueError as e:
         print(f"hvd-top: {e}", file=sys.stderr)
         return 2
@@ -489,7 +621,8 @@ def main(argv: Optional[List[str]] = None) -> int:
               "at the rendezvous KV, or set HOROVOD_METRICS_PORT)",
               file=sys.stderr)
         return 2
-    state = TopState(targets, serving=args.serving, tune=args.tune)
+    state = TopState(targets, serving=args.serving, tune=args.tune,
+                     autoscale=args.autoscale, kv=kv)
 
     if args.once:
         rows, unreachable = state.refresh(window=False)

@@ -1,0 +1,477 @@
+"""Traffic-driven autoscaler: serving SLOs in, fleet size out.
+
+The policy loop that closes the gap ROADMAP calls the most direct "heavy
+traffic" demonstration the repo can make: the ``hvd_serve_*`` families
+(PR 8) already say when a fleet is drowning or idle, checkpoint-free
+resize + drain (PR 9) already grows and shrinks a fleet without dropping
+work, and the epoch-fenced driver (PR 10) already survives crashes — this
+module connects them.
+
+Two layers, deliberately separable:
+
+- :class:`AutoscalePolicy` — pure decision logic. Each observation window
+  it classifies the fleet as *breached* (any worker's queue depth over
+  ``HOROVOD_AUTOSCALE_QUEUE_BOUND`` or p99 over
+  ``HOROVOD_AUTOSCALE_P99_MS_BOUND``), *idle* (every queue empty and mean
+  in-flight per worker at or under ``HOROVOD_AUTOSCALE_IDLE_OCCUPANCY``),
+  or neither.
+  A decision needs a **sustained streak** (``HOROVOD_AUTOSCALE_UP_WINDOWS``
+  / ``DOWN_WINDOWS`` consecutive windows — hysteresis: a one-window spike
+  never resizes), respects **per-direction cooldowns** (``UP_COOLDOWN`` /
+  ``DOWN_COOLDOWN`` — shedding capacity is the riskier direction, so its
+  default is longer), and clamps to ``[MIN_WORKERS, MAX_WORKERS]``.
+  Scale-down picks the **least-loaded non-draining** worker and drains it
+  through the PR-9 preemption machinery — never a kill.
+
+- :class:`Autoscaler` — the KV-recording state machine around the policy.
+  Every decision is an **epoch-claimed** record under
+  ``autoscale/decision`` advancing ``decide → drain → resize → ack``
+  (scale-up skips ``drain``), written *before* the action it describes.
+  A recovered driver calls :meth:`Autoscaler.recover` and **resumes** a
+  half-finished decision instead of re-deciding — the crash-window story
+  :class:`~horovod_tpu.verify.specs.AutoscaleSpec` model-checks, mutants
+  included. Acked decisions append an ``autoscale/event/<seq>`` audit
+  record.
+
+The driver side (``runner/elastic/driver.py``) feeds the loop from the
+same ``/metrics.json`` scrape that powers straggler detection, and acts
+on it by moving its live target fleet size and SIGTERMing scale-down
+victims (the preemption-notice drain path). The in-process fleet sim
+(``serve/autoscale_smoke.py``) drives the identical Autoscaler against a
+router+batcher fleet for the BENCH ``autoscale`` block.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+from horovod_tpu.common import kv_keys
+from horovod_tpu.common.env_registry import env_float, env_int
+from horovod_tpu.common.hvd_logging import get_logger
+from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+
+UP = "up"
+DOWN = "down"
+HOLD = "hold"
+
+# Decision-record states (the decide→drain→resize→ack machine).
+DECIDE = "decide"
+DRAIN = "drain"
+RESIZE = "resize"
+ACK = "ack"
+
+
+class WorkerSLO(NamedTuple):
+    """One worker's serving-health sample for a policy window."""
+    key: str                      # "host/local_rank"
+    queue_depth: float
+    p99_ms: Optional[float]
+    occupancy: Optional[float]    # mean batch occupancy (0..max_batch)
+    inflight: float
+
+
+def worker_slo_from_snapshot(key: str, snap: dict,
+                             max_batch: Optional[float] = None) \
+        -> Optional[WorkerSLO]:
+    """Extract a :class:`WorkerSLO` from a ``/metrics.json`` snapshot, or
+    None when the worker exports no serving metrics (a pure training
+    rank must not read as an idle serving worker)."""
+    from horovod_tpu.metrics import (histogram_quantile, snapshot_histogram,
+                                     snapshot_value)
+    qd = snapshot_value(snap, "hvd_serve_queue_depth")
+    if qd is None:
+        return None
+    lat = snapshot_histogram(snap, "hvd_serve_request_latency_seconds")
+    p99 = histogram_quantile(lat, 0.99) if lat else None
+    occ = snapshot_histogram(snap, "hvd_serve_batch_occupancy")
+    occupancy = occ["sum"] / occ["count"] if occ and occ["count"] else None
+    if occupancy is not None and max_batch:
+        occupancy = occupancy / max_batch
+    return WorkerSLO(
+        key=key, queue_depth=float(qd),
+        p99_ms=p99 * 1e3 if p99 is not None else None,
+        occupancy=occupancy,
+        inflight=float(snapshot_value(snap, "hvd_serve_inflight") or 0.0))
+
+
+def slo_headroom(queue_depth: Optional[float], p99_ms: Optional[float],
+                 queue_bound: Optional[float] = None,
+                 p99_bound_ms: Optional[float] = None) -> Optional[float]:
+    """Fractional distance to the nearest SLO bound, in [-1, 1]: 1.0 =
+    fully idle, 0.0 = at the bound, negative = breached. The shared
+    formula behind the policy's breach test and ``hvd-top --autoscale``'s
+    HEADRM column."""
+    if queue_bound is None:
+        queue_bound = env_int("HOROVOD_AUTOSCALE_QUEUE_BOUND")
+    if p99_bound_ms is None:
+        p99_bound_ms = env_float("HOROVOD_AUTOSCALE_P99_MS_BOUND")
+    rooms = []
+    if queue_depth is not None and queue_bound > 0:
+        rooms.append((queue_bound - queue_depth) / queue_bound)
+    if p99_ms is not None and p99_bound_ms > 0:
+        rooms.append((p99_bound_ms - p99_ms) / p99_bound_ms)
+    if not rooms:
+        return None
+    return max(-1.0, min(1.0, min(rooms)))
+
+
+class Decision(NamedTuple):
+    action: str                 # UP | DOWN | HOLD
+    victim: Optional[str]       # DOWN only: "host/local_rank"
+    reason: str
+
+
+class AutoscalePolicy:
+    """Hysteresis + cooldown + clamp logic; no I/O, fully test-drivable.
+
+    Call :meth:`update` once per observation window, then :meth:`decide`
+    when no prior decision is in flight (the :class:`Autoscaler` does
+    both in its tick)."""
+
+    def __init__(self, min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 queue_bound: Optional[float] = None,
+                 p99_bound_ms: Optional[float] = None,
+                 idle_occupancy: Optional[float] = None,
+                 up_windows: Optional[int] = None,
+                 down_windows: Optional[int] = None,
+                 up_cooldown: Optional[float] = None,
+                 down_cooldown: Optional[float] = None):
+        self.min_workers = min_workers if min_workers is not None \
+            else env_int("HOROVOD_AUTOSCALE_MIN_WORKERS")
+        self.max_workers = max_workers if max_workers is not None \
+            else env_int("HOROVOD_AUTOSCALE_MAX_WORKERS")
+        self.queue_bound = queue_bound if queue_bound is not None \
+            else float(env_int("HOROVOD_AUTOSCALE_QUEUE_BOUND"))
+        self.p99_bound_ms = p99_bound_ms if p99_bound_ms is not None \
+            else env_float("HOROVOD_AUTOSCALE_P99_MS_BOUND")
+        self.idle_occupancy = idle_occupancy if idle_occupancy is not None \
+            else env_float("HOROVOD_AUTOSCALE_IDLE_OCCUPANCY")
+        self.up_windows = up_windows if up_windows is not None \
+            else env_int("HOROVOD_AUTOSCALE_UP_WINDOWS")
+        self.down_windows = down_windows if down_windows is not None \
+            else env_int("HOROVOD_AUTOSCALE_DOWN_WINDOWS")
+        self.up_cooldown = up_cooldown if up_cooldown is not None \
+            else env_float("HOROVOD_AUTOSCALE_UP_COOLDOWN_SECONDS")
+        self.down_cooldown = down_cooldown if down_cooldown is not None \
+            else env_float("HOROVOD_AUTOSCALE_DOWN_COOLDOWN_SECONDS")
+        self.hot_streak = 0
+        self.idle_streak = 0
+        self._last_up: Optional[float] = None
+        self._last_down: Optional[float] = None
+
+    # -- observation ---------------------------------------------------------
+
+    def classify(self, fleet: Sequence[WorkerSLO]) -> str:
+        """One window's verdict: "breach" | "idle" | "ok"."""
+        if not fleet:
+            return "ok"
+        for w in fleet:
+            room = slo_headroom(w.queue_depth, w.p99_ms,
+                                self.queue_bound, self.p99_bound_ms)
+            if room is not None and room < 0:
+                return "breach"
+        if all(w.queue_depth == 0 for w in fleet):
+            mean_inflight = sum(w.inflight for w in fleet) / len(fleet)
+            if mean_inflight <= self.idle_occupancy:
+                return "idle"
+        return "ok"
+
+    def update(self, fleet: Sequence[WorkerSLO]) -> str:
+        """Advance the hysteresis streaks with one window; returns the
+        window's classification."""
+        verdict = self.classify(fleet)
+        self.hot_streak = self.hot_streak + 1 if verdict == "breach" else 0
+        self.idle_streak = self.idle_streak + 1 if verdict == "idle" else 0
+        return verdict
+
+    # -- decisions -----------------------------------------------------------
+
+    def _cooled(self, last: Optional[float], cooldown: float,
+                now: float) -> bool:
+        return last is None or now - last >= cooldown
+
+    def decide(self, fleet: Sequence[WorkerSLO],
+               draining: Sequence[str] = (),
+               now: Optional[float] = None) -> Decision:
+        """The direction (if any) the streaks currently justify. Stamps
+        the per-direction cooldown and resets both streaks on a non-HOLD
+        result, so callers must act on what they get."""
+        now = time.monotonic() if now is None else now
+        size = len(fleet)
+        if self.hot_streak >= self.up_windows:
+            if size >= self.max_workers:
+                return Decision(HOLD, None,
+                                f"breached but at max_workers="
+                                f"{self.max_workers}")
+            if not self._cooled(self._last_up, self.up_cooldown, now):
+                return Decision(HOLD, None, "scale-up cooling down")
+            self._last_up = now
+            self.hot_streak = self.idle_streak = 0
+            return Decision(
+                UP, None,
+                f"SLO breached {self.up_windows}+ consecutive windows")
+        if self.idle_streak >= self.down_windows:
+            if size - 1 < self.min_workers:
+                return Decision(HOLD, None,
+                                f"idle but at min_workers="
+                                f"{self.min_workers}")
+            if not self._cooled(self._last_down, self.down_cooldown, now):
+                return Decision(HOLD, None, "scale-down cooling down")
+            victim = self.pick_victim(fleet, draining)
+            if victim is None:
+                return Decision(HOLD, None,
+                                "idle but no non-draining victim")
+            self._last_down = now
+            self.hot_streak = self.idle_streak = 0
+            return Decision(
+                DOWN, victim,
+                f"fleet idle {self.down_windows}+ consecutive windows")
+        return Decision(HOLD, None, "")
+
+    @staticmethod
+    def pick_victim(fleet: Sequence[WorkerSLO],
+                    draining: Sequence[str] = ()) -> Optional[str]:
+        """Least-loaded *sheddable* worker NOT already draining (ties by
+        key for determinism). Selecting a draining worker would
+        double-resize and strand its acked requests — the seeded
+        ``autoscale_victim_draining`` mutant proves the checker catches
+        exactly that.
+
+        Sheddable: the elastic assignment packs local_ranks contiguously
+        per host (``hosts.get_host_assignments``), so on a multi-slot
+        host only the HIGHEST occupied slot can actually leave the
+        topology — draining a lower one would evict a different,
+        healthy worker at the rebalance. Keys without a ``host/slot``
+        shape (the fleet sim's flat ids) are all sheddable."""
+        candidates = [w for w in fleet if w.key not in set(draining)]
+        top_slot: Dict[str, tuple] = {}
+        for w in candidates:
+            host, sep, slot = w.key.rpartition("/")
+            if not sep or not slot.isdigit():
+                top_slot[w.key] = (0, w)
+                continue
+            s = int(slot)
+            cur = top_slot.get(host)
+            if cur is None or s > cur[0]:
+                top_slot[host] = (s, w)
+        sheddable = [w for _s, w in top_slot.values()]
+        if not sheddable:
+            return None
+        return min(sheddable,
+                   key=lambda w: (w.inflight, w.queue_depth, w.key)).key
+
+
+class Autoscaler:
+    """The policy wrapped in the epoch-claimed KV decision machine.
+
+    ``fleet_ops`` is the actuation surface (duck-typed; the elastic
+    driver and the fleet sim both provide one):
+
+    - ``scale_up()`` — begin adding one worker (asynchronous; completion
+      is observed as fleet growth on later ticks);
+    - ``start_drain(victim_key)`` — begin draining a worker through the
+      preemption machinery (never a kill; completion is observed as the
+      victim leaving the fleet and then the draining set).
+
+    ``kv`` is any ``put_json(key, value, epoch=...)`` /
+    ``get_json(key)`` surface (KVServer, KVClient) or None for a
+    KV-less policy loop (the fleet sim's default)."""
+
+    def __init__(self, fleet_ops, kv=None, epoch: int = 0,
+                 policy: Optional[AutoscalePolicy] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 pending_timeout: float = 120.0):
+        self.policy = policy if policy is not None else AutoscalePolicy()
+        self.fleet_ops = fleet_ops
+        self.kv = kv
+        self.epoch = epoch
+        self.pending: Optional[dict] = None
+        self.decisions: List[dict] = []       # acted decisions, in order
+        self._seq = 0
+        self._pending_since: Optional[float] = None
+        self._pending_timeout = pending_timeout
+        self._target: Optional[int] = None
+        self._log = get_logger("elastic.autoscaler")
+        reg = registry if registry is not None else get_registry()
+        self._g_fleet = reg.gauge(
+            "hvd_autoscale_fleet_size",
+            "accepting serving workers at the last observation")
+        self._g_last = reg.gauge(
+            "hvd_autoscale_last_decision",
+            "last decision direction (+1 up, -1 down, 0 none yet)")
+        self._c_up = reg.counter("hvd_autoscale_up_total",
+                                 "scale-up decisions acted on")
+        self._c_down = reg.counter("hvd_autoscale_down_total",
+                                   "scale-down (drain) decisions acted on")
+        self._g_pending = reg.gauge(
+            "hvd_autoscale_pending",
+            "1 while a decision is between decide and ack")
+
+    # -- KV record -----------------------------------------------------------
+
+    def _write(self, state: str, **extra):
+        assert self.pending is not None
+        self.pending = dict(self.pending, state=state, ts=time.time(),
+                            **extra)
+        if self.kv is not None:
+            self.kv.put_json(kv_keys.autoscale_decision(), self.pending,
+                             epoch=self.epoch)
+
+    def _open(self, decision: Decision, fleet_size: int):
+        self._seq += 1
+        self.pending = {
+            "seq": self._seq, "action": decision.action,
+            "victim": decision.victim, "reason": decision.reason,
+            "fleet": fleet_size, "epoch": self.epoch, "state": DECIDE,
+            "ts": time.time(),
+        }
+        self._pending_since = time.monotonic()
+        if self.kv is not None:
+            self.kv.put_json(kv_keys.autoscale_decision(), self.pending,
+                             epoch=self.epoch)
+        self._g_pending.set(1)
+
+    def _ack(self, outcome: str = "completed"):
+        self._write(ACK, outcome=outcome)
+        rec = self.pending
+        self.decisions.append(rec)
+        if self.kv is not None:
+            self.kv.put_json(kv_keys.autoscale_event(rec["seq"]), rec,
+                             epoch=self.epoch)
+        self._log.warning("autoscale decision acked: %s", json.dumps(rec))
+        self.pending = None
+        self._pending_since = None
+        self._target = None
+        self._g_pending.set(0)
+
+    def recover(self) -> Optional[dict]:
+        """Adopt a predecessor driver's in-flight decision from the KV —
+        the recovered driver *resumes* a half-finished resize instead of
+        re-deciding (and instead of leaving a drained worker's slot
+        half-removed). Returns the adopted record, or None."""
+        if self.kv is None:
+            return None
+        rec = self.kv.get_json(kv_keys.autoscale_decision())
+        if not isinstance(rec, dict):
+            return None
+        self._seq = max(self._seq, int(rec.get("seq", 0)))
+        if rec.get("state") == ACK:
+            return None
+        self.pending = dict(rec, epoch=self.epoch, resumed=True)
+        self._pending_since = time.monotonic()
+        self._g_pending.set(1)
+        self._log.warning(
+            "autoscale recovery: resuming %s decision seq %s at state %s "
+            "(old epoch %s -> %s)", rec.get("action"), rec.get("seq"),
+            rec.get("state"), rec.get("epoch"), self.epoch)
+        # the re-claimed record fences the dead driver's epoch out of the
+        # rest of this decision's writes
+        if self.kv is not None:
+            self.kv.put_json(kv_keys.autoscale_decision(), self.pending,
+                             epoch=self.epoch)
+        return self.pending
+
+    # -- the loop ------------------------------------------------------------
+
+    def tick(self, fleet: Sequence[WorkerSLO],
+             draining: Sequence[str] = (),
+             now: Optional[float] = None):
+        """One observation window: advance hysteresis, then either push
+        the in-flight decision through its state machine or (when clear)
+        ask the policy for a new one."""
+        now = time.monotonic() if now is None else now
+        self._g_fleet.set(len(fleet))
+        self.policy.update(fleet)
+        if self.pending is not None:
+            self._advance(fleet, draining)
+            return
+        decision = self.policy.decide(fleet, draining, now=now)
+        if decision.action == HOLD:
+            return
+        self._open(decision, len(fleet))
+        self._log.warning("autoscale decision: %s",
+                          json.dumps(self.pending))
+        if decision.action == UP:
+            self._g_last.set(1)
+            self._c_up.inc()
+            self._target = len(fleet) + 1
+            self.fleet_ops.scale_up()
+            # members at decide time: completion is "a NEW worker
+            # joined", not an absolute size — a concurrent kill must not
+            # wedge the decision open forever
+            self._write(RESIZE, target=self._target,
+                        members=sorted(w.key for w in fleet))
+        else:
+            self._g_last.set(-1)
+            self._c_down.inc()
+            self.fleet_ops.start_drain(decision.victim)
+            self._write(DRAIN)
+
+    def _advance(self, fleet: Sequence[WorkerSLO],
+                 draining: Sequence[str]):
+        rec = self.pending
+        state = rec.get("state")
+        if self._pending_since is not None and \
+                time.monotonic() - self._pending_since > \
+                self._pending_timeout:
+            self._log.error(
+                "autoscale decision seq %s stuck in state %s for %.0fs; "
+                "abandoning (fleet may not match the decision)",
+                rec.get("seq"), state, self._pending_timeout)
+            self._ack(outcome="timeout")
+            return
+        if state == DECIDE:
+            # a resumed record caught between decide and the first act:
+            # re-issue the action idempotently
+            if rec["action"] == UP:
+                self._target = int(rec.get("target") or len(fleet) + 1)
+                self.fleet_ops.scale_up()
+                self._write(RESIZE, target=self._target,
+                            members=sorted(w.key for w in fleet))
+            else:
+                victim = rec.get("victim")
+                keys = {w.key for w in fleet}
+                if victim in keys and victim not in set(draining):
+                    self.fleet_ops.start_drain(victim)
+                    self._write(DRAIN)
+                else:
+                    # victim already gone (the drain outlived the crash)
+                    self._write(DRAIN)
+            return
+        if state == RESIZE and rec["action"] == UP:
+            target = int(rec.get("target") or 0)
+            members = set(rec.get("members") or ())
+            joined = any(w.key not in members for w in fleet)
+            if len(fleet) >= target or joined:
+                self._ack()
+            return
+        victim = rec.get("victim")
+        in_fleet = any(w.key == victim for w in fleet)
+        if state == DRAIN:
+            if not in_fleet:
+                self._write(RESIZE)
+            return
+        if state == RESIZE:  # DOWN: wait for the drain to fully clear
+            if not in_fleet and victim not in set(draining):
+                self._ack()
+
+
+def autoscale_status(kv_get_json: Callable[[str], Optional[dict]]) \
+        -> Optional[dict]:
+    """The current decision record + its age — what ``hvd-top
+    --autoscale`` renders in its banner. ``kv_get_json`` is any
+    ``key -> dict|None`` getter."""
+    try:
+        rec = kv_get_json(kv_keys.autoscale_decision())
+    except Exception:  # noqa: BLE001 — KV outage: banner shows nothing
+        return None
+    if not isinstance(rec, dict):
+        return None
+    out = dict(rec)
+    ts = rec.get("ts")
+    out["age_seconds"] = round(time.time() - float(ts), 1) \
+        if ts is not None else None
+    return out

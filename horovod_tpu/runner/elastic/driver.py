@@ -35,8 +35,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.common import kv_keys
-from horovod_tpu.common.env_registry import (env_float, env_int, env_is_set,
-                                             env_str)
+from horovod_tpu.common.env_registry import (env_bool, env_float, env_int,
+                                             env_is_set, env_str)
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.metrics import snapshot_value, step_stats
 from horovod_tpu.metrics.registry import get_registry
@@ -69,6 +69,22 @@ FAILURES_TO_BLACKLIST = 3
 # worker that dies pre-READY cannot wedge the whole generation (its exit is
 # separately detected and triggers the next rebalance).
 GO_BARRIER_TIMEOUT_SECS = 60.0
+
+
+class _DriverFleetOps:
+    """The Autoscaler's actuation surface over a live ElasticDriver:
+    scale-up moves the target fleet size, scale-down drains the victim
+    through the preemption machinery (runner/elastic/preempt.py)."""
+
+    def __init__(self, driver: "ElasticDriver"):
+        self._driver = driver
+
+    def scale_up(self):
+        self._driver.request_scale_up()
+
+    def start_drain(self, victim_key: str):
+        host, _, slot = victim_key.rpartition("/")
+        self._driver.administrative_drain((host, int(slot)))
 
 
 class ElasticDriver:
@@ -160,6 +176,22 @@ class ElasticDriver:
         # shard handoff lands before the host dies.
         self._draining: set = set()
         self.drain_events: List[dict] = []
+        # autoscale scale-down drains: a subset of _draining whose hosts
+        # stay eligible (the machine is healthy; only the slot is shed) —
+        # cleared at reap so a later scale-up can respawn there
+        self._admin_drains: set = set()
+        # live fleet-size target the autoscaler moves within
+        # [min_np, max_np]; autoscaled jobs start at the floor and earn
+        # capacity from traffic, plain jobs keep the historical
+        # spawn-everything behavior
+        self._autoscale = env_bool("HOROVOD_AUTOSCALE")
+        self._target_np = min_np if self._autoscale else max_np
+        self._autoscaler = None
+        if self._autoscale:
+            from horovod_tpu.runner.elastic.autoscaler import Autoscaler
+            self._autoscaler = Autoscaler(
+                _DriverFleetOps(self), kv=self._kv, epoch=self._epoch,
+                registry=get_registry())
         self._lock = threading.Lock()
         self._rebalance_needed = threading.Event()
         self._shutdown = threading.Event()
@@ -345,7 +377,47 @@ class ElasticDriver:
             self._log(f"recovery found {len(slots) - len(adopted)} dead "
                       f"slot(s); scheduling rebalance")
             self._rebalance_needed.set()
+        if self._autoscaler is not None:
+            # adopt fleet-size reality (the WAL's slot count outranks the
+            # cold-start floor), then resume any half-finished scaling
+            # decision instead of re-deciding it
+            with self._lock:
+                self._target_np = max(self._min_np,
+                                      min(self._max_np, len(slots)))
+            try:
+                rec = self._autoscaler.recover()
+            except Exception as e:  # noqa: BLE001 — a broken record must
+                self._log(f"autoscale recovery failed: {e!r}")  # not
+                # block driver recovery
+                rec = None
+            if rec and rec.get("action") == "down" and rec.get("victim"):
+                self._resume_admin_drain(rec["victim"])
         return bool(adopted)
+
+    def _resume_admin_drain(self, victim: str):
+        """Re-apply an interrupted scale-down's driver-side accounting:
+        the adopted target still counts the victim's slot (the crash beat
+        the rebalance), so without this the recovered driver would
+        respawn the shed slot and misread the victim's drain announce as
+        a spot eviction (holding its whole healthy host out)."""
+        host, _, slot = victim.rpartition("/")
+        try:
+            key = (host, int(slot))
+        except ValueError:
+            return
+        with self._lock:
+            if key not in self._expected_slots:
+                # the pre-crash rebalance already removed the slot: the
+                # adopted target excludes it, nothing to re-apply
+                return
+            self._target_np = max(self._target_np - 1, self._min_np)
+            self._draining.add(key)
+            self._admin_drains.add(key)
+            self._prev_host_order = [h for h in self._prev_host_order
+                                     if h != host] + [host]
+        self._log(f"autoscale recovery: resumed drain of {key} "
+                  f"(target fleet {self._target_np})")
+        self._rebalance_needed.set()
 
     def _scan_heartbeats(self):
         """Refresh adopted workers' liveness from their KV heartbeats
@@ -474,6 +546,14 @@ class ElasticDriver:
             # committed state (reference: driver.py:232-274 keeps at least
             # one previously-used host ordered first for state sync).
             current = dict(self._hosts.current)
+            # autoscale scale-down: subtract the draining slots so the
+            # new topology drops exactly the victim (its host keeps its
+            # other slots and stays eligible for future scale-ups)
+            for host, _lr in self._admin_drains:
+                if host in current:
+                    current[host] -= 1
+                    if current[host] <= 0:
+                        del current[host]
             ordered = [h for h in self._prev_host_order if h in current]
             ordered += [h for h in sorted(current) if h not in ordered]
             self._prev_host_order = ordered
@@ -481,7 +561,7 @@ class ElasticDriver:
             slots = hosts_lib.get_host_assignments(
                 host_list, min_np=min(self._min_np,
                                       sum(h.slots for h in host_list)),
-                max_np=self._max_np)
+                max_np=min(self._max_np, self._target_np))
             controller_host = slots[0].hostname
             controller_addr = "127.0.0.1" \
                 if controller_host == "localhost" else controller_host
@@ -567,6 +647,86 @@ class ElasticDriver:
                     self._workers[key] = self._spawn_worker(
                         s.hostname, s.rank, self._command, env)
 
+    # -- autoscaling actuation (runner/elastic/autoscaler.py drives these) ---
+
+    @property
+    def target_np(self) -> int:
+        """The live fleet-size target the autoscaler moves."""
+        return self._target_np
+
+    def request_scale_up(self):
+        """Raise the fleet target one worker (clamped to max_np) and
+        schedule the rebalance that spawns it."""
+        with self._lock:
+            self._target_np = min(self._target_np + 1, self._max_np)
+            target = self._target_np
+        self._log(f"autoscale: scale-up, target fleet -> {target}")
+        self._rebalance_needed.set()
+
+    def administrative_drain(self, key) -> bool:
+        """Scale-down by drain, never a kill: lower the target, mark the
+        slot draining (its exit is clean, the serve_targets entry flags
+        ``draining`` so routers stop placing immediately), and deliver
+        the preemption notice (SIGTERM) — the worker announces, finishes
+        what it accepted / hands off its shard, and exits 0. Unlike a
+        spot-eviction drain the HOST stays eligible: only the slot is
+        shed, and a later scale-up may respawn it."""
+        key = (key[0], int(key[1]))
+        from horovod_tpu.runner.elastic.preempt import drain_key
+        with self._lock:
+            w = self._workers.get(key)
+            if w is None or w.poll() is not None:
+                return False
+            already = key in self._admin_drains
+            if key in self._draining and not already:
+                # the victim is already spot-draining: a SECOND notice
+                # force-exits it immediately (preempt.py), dropping its
+                # acked requests — the exact hazard the spec's
+                # victim_draining mutant pins
+                return False
+        announced = False
+        if not already:
+            # last-chance KV check (the reap path's pattern): the spot
+            # announce may have landed after this heartbeat's drain scan
+            # — the next scan will register it; we must not pile a
+            # second notice on top
+            try:
+                announced = self._kv.get_json(drain_key(*key)) is not None
+            except Exception:  # noqa: BLE001 — fall through to drain
+                pass
+            if announced:
+                self._log(f"autoscale: {key} already announced its own "
+                          f"drain; skipping the scale-down notice")
+                return False
+        elif self._kv.get_json(drain_key(*key)) is not None:
+            # recovery re-issue, but the first notice demonstrably
+            # landed (the worker announced): do not signal again
+            self._rebalance_needed.set()
+            return True
+        with self._lock:
+            if key not in self._admin_drains:
+                # idempotent: a recovery-resumed decision re-issues the
+                # drain after _resume_admin_drain already accounted it —
+                # the notice below is re-delivered, the target is not
+                # re-decremented
+                self._target_np = max(self._target_np - 1, self._min_np)
+                self._draining.add(key)
+                self._admin_drains.add(key)
+                # demote the victim's host to the back of the placement
+                # order: once the drain is reaped, a shrunken assignment
+                # must keep the still-running workers, not respawn on the
+                # freshly shed host while dropping a healthy one
+                self._prev_host_order = [h for h in self._prev_host_order
+                                         if h != key[0]] + [key[0]]
+            target = self._target_np
+        self._log(f"autoscale: draining {key} (target fleet {target})")
+        try:
+            w.terminate()  # the preemption notice, not a kill
+        except Exception as e:  # noqa: BLE001 — the rebalance still
+            self._log(f"drain signal failed: {e!r}")  # removes the slot
+        self._rebalance_needed.set()
+        return True
+
     def _check_drains(self):
         """One heartbeat's drain scan: a worker that received a preemption
         notice announces it under ``drain/<host>/<slot>`` (preempt.py).
@@ -642,6 +802,20 @@ class ElasticDriver:
                     self._log(f"drained worker {key} exited (code {code})")
                     del self._workers[key]
                     self._removed_slots.discard(key)
+                    if key in self._admin_drains:
+                        # autoscale drain complete: clear the records so
+                        # the host's slot is assignable again at the next
+                        # scale-up (a spot drain keeps its hold — that
+                        # machine is expected to die)
+                        self._admin_drains.discard(key)
+                        self._draining.discard(key)
+                        from horovod_tpu.runner.elastic.preempt import \
+                            drain_key
+                        try:
+                            self._kv.delete(drain_key(*key),
+                                            epoch=self._epoch)
+                        except Exception:  # noqa: BLE001 — best effort
+                            pass
                     continue
                 if code == 0:
                     if key in self._removed_slots:
@@ -788,6 +962,7 @@ class ElasticDriver:
         times: Dict[int, float] = {}
         targets: List[dict] = []
         serve_targets: List[dict] = []
+        serve_slos: List = []
         anomalies: List[Tuple[Tuple[str, int], dict, float]] = []
         for host, local_rank in slots:
             # serving plane: aggregate worker-published serve endpoints
@@ -796,11 +971,16 @@ class ElasticDriver:
             sinfo = self._kv.get_json(kv_keys.serve_addr(host, local_rank))
             if isinstance(sinfo, dict) and sinfo.get("addr") \
                     and sinfo.get("port"):
-                serve_targets.append(
-                    {"id": sinfo.get("id") or f"{host}/{local_rank}",
-                     "addr": sinfo["addr"], "port": sinfo["port"],
-                     "rank": sinfo.get("rank"),
-                     "generation": sinfo.get("generation")})
+                entry = {"id": sinfo.get("id") or f"{host}/{local_rank}",
+                         "addr": sinfo["addr"], "port": sinfo["port"],
+                         "rank": sinfo.get("rank"),
+                         "generation": sinfo.get("generation")}
+                if (host, local_rank) in self._draining:
+                    # scale-down announce: routers stop placing NEW
+                    # requests on this worker the moment they see the
+                    # table, not once the worker finally leaves it
+                    entry["draining"] = True
+                serve_targets.append(entry)
             info = self._kv.get_json(kv_keys.metrics_addr(host, local_rank))
             # a malformed/partial KV entry skips THIS worker only — it must
             # not abort the whole scrape pass for the healthy ones
@@ -820,6 +1000,12 @@ class ElasticDriver:
             except Exception:  # noqa: BLE001 — worker mid-restart
                 continue
             key = (host, local_rank)
+            if self._autoscaler is not None:
+                from horovod_tpu.runner.elastic.autoscaler import \
+                    worker_slo_from_snapshot
+                slo = worker_slo_from_snapshot(f"{host}/{local_rank}", snap)
+                if slo is not None:
+                    serve_slos.append(slo)
             count = snapshot_value(snap, "hvd_step_anomaly_total")
             if count is not None:
                 # first sight of a slot is a baseline, not an event — a
@@ -861,6 +1047,13 @@ class ElasticDriver:
             self._ingest_anomaly(key, info, delta)
         if times:
             self._ingest_step_times(times)
+        if self._autoscaler is not None and serve_slos:
+            draining_keys = [f"{h}/{lr}" for h, lr in self._draining]
+            try:
+                self._autoscaler.tick(serve_slos, draining_keys)
+            except Exception as e:  # noqa: BLE001 — policy errors must
+                self._log(f"autoscale tick error: {e!r}")  # not kill the
+                # heartbeat
 
     def _ingest_anomaly(self, key: Tuple[str, int], info: dict,
                         delta: float):

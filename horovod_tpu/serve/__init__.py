@@ -24,7 +24,15 @@ same engine, elastic runner, and metrics stack:
   on membership changes;
 - :mod:`~horovod_tpu.serve.loadgen` — open-loop load generation behind the
   BENCH ``serving`` block (p50/p99 vs offered load) and the small-tensor
-  latency microbench.
+  latency microbench;
+- :mod:`~horovod_tpu.serve.admission` — SLO-aware admission in front of
+  the batcher: priority classes shed lowest-first under queue pressure,
+  per-tenant token-bucket quotas 429 with Retry-After — how the fleet
+  degrades gracefully while an autoscale resize is in flight;
+- :mod:`~horovod_tpu.serve.autoscale_smoke` — the closed loop from
+  offered load to fleet size (BENCH ``autoscale`` block,
+  ``make autoscale-smoke``): an in-process fleet behind the real router
+  driven by the real :mod:`~horovod_tpu.runner.elastic.autoscaler`.
 
 The engine side is ``HOROVOD_SERVING_MODE``: sub-threshold collectives skip
 the fusion buffer (they are latency- not bandwidth-bound — the regime the
@@ -33,6 +41,11 @@ gradient exchange) and the cycle wait is clamped to
 ``HOROVOD_SERVING_CYCLE_TIME``.
 """
 
+from horovod_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    TokenBucket,
+    parse_priority_classes,
+)
 from horovod_tpu.serve.batcher import (  # noqa: F401
     AdmissionRejected,
     ContinuousBatcher,

@@ -36,6 +36,7 @@ from typing import Optional
 from horovod_tpu.metrics import histogram_quantile, snapshot_histogram, \
     snapshot_value
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+from horovod_tpu.serve.admission import AdmissionController
 from horovod_tpu.serve.batcher import AdmissionRejected, ContinuousBatcher
 from horovod_tpu.serve.router import (NoWorkersError, RequestRouter,
                                       post_json)
@@ -90,12 +91,16 @@ class ServeFrontend:
                  router: Optional[RequestRouter] = None,
                  port: int = 0, addr: str = "0.0.0.0",
                  registry: Optional[MetricsRegistry] = None,
-                 dispatch_timeout: float = 60.0):
+                 dispatch_timeout: float = 60.0,
+                 admission: Optional[AdmissionController] = None):
         if (batcher is None) == (router is None):
             raise ValueError("pass exactly one of batcher= (local worker "
                              "mode) or router= (cluster ingress mode)")
         self.batcher = batcher
         self.router = router
+        # SLO-aware admission (serve/admission.py): class shedding bites
+        # in local mode (the queue lives here); quotas bite in both modes.
+        self.admission = admission
         self.registry = registry if registry is not None else get_registry()
         self._dispatch_timeout = dispatch_timeout
         self._draining = threading.Event()
@@ -110,6 +115,11 @@ class ServeFrontend:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                retry_after = payload.get("retry_after_seconds")
+                if code == 429 and retry_after:
+                    # integer ceiling: Retry-After is whole seconds
+                    self.send_header("Retry-After",
+                                     str(max(1, int(retry_after + 0.999))))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -122,6 +132,8 @@ class ServeFrontend:
                         self._reply(200, {"status": "ok"})
                 elif path == "/stats":
                     stats = serving_stats(frontend.registry.snapshot())
+                    if frontend.admission is not None:
+                        stats["admission"] = frontend.admission.counters()
                     if frontend.router is not None:
                         # ingress mode: surface discovery health so load
                         # balancers/operators can see the router is
@@ -184,9 +196,24 @@ class ServeFrontend:
             return self._handle_local(body)
         return self._handle_routed(body)
 
+    def _admission_check(self, body: dict, queue_fill: float):
+        """None when admitted, else the 429 (code, payload) pair."""
+        if self.admission is None:
+            return None
+        verdict = self.admission.admit(body, queue_fill)
+        if verdict.ok:
+            return None
+        return 429, {"error": verdict.reason, "status": "rejected",
+                     "priority_class": verdict.cls,
+                     "retry_after_seconds": verdict.retry_after_seconds}
+
     def _handle_local(self, body: dict):
         if self.draining:
             return 503, {"error": "worker draining", "status": "rejected"}
+        shed = self._admission_check(
+            body, self.batcher.pending() / max(self.batcher.queue_depth, 1))
+        if shed is not None:
+            return shed
         try:
             req = self.batcher.submit(
                 tokenize(body),
@@ -210,6 +237,11 @@ class ServeFrontend:
     def _handle_routed(self, body: dict):
         rid = str(body.get("id") or id(body))
         body = dict(body, id=rid)
+        # ingress mode: the queue lives on the workers, so only quotas
+        # bite here (fill 0.0); class shedding happens where the queue is
+        shed = self._admission_check(body, 0.0)
+        if shed is not None:
+            return shed
         try:
             resp = self.router.submit(
                 rid, body,

@@ -121,9 +121,14 @@ class RequestRouter:
 
     def update_workers(self, entries: List[dict], generation: int):
         """Install the worker set of ``generation``. Entries:
-        ``{"id", "addr", "port", "rank"?}``. Workers absent from the new
-        set begin draining (their in-flight requests finish or get
-        re-routed by their own dispatch threads); dead ones stay dead."""
+        ``{"id", "addr", "port", "rank"?, "draining"?}``. Workers absent
+        from the new set begin draining (their in-flight requests finish
+        or get re-routed by their own dispatch threads); dead ones stay
+        dead. An entry flagged ``draining`` (the driver's scale-down
+        announce) stops taking NEW placements *immediately* — before,
+        placement only reacted once the worker left the table, so every
+        refresh-to-removal window placed fresh requests onto a worker
+        already told to die."""
         with self._lock:
             seen = set()
             for e in entries:
@@ -142,8 +147,10 @@ class RequestRouter:
                 else:
                     w.addr, w.port = e["addr"], int(e["port"])
                     w.rank = e.get("rank", w.rank)
-                    if w.state == DRAINING:
-                        # re-registered in the new generation: it stayed
+                    if w.state == DRAINING and not e.get("draining"):
+                        # re-registered without the flag: it stayed (a
+                        # still-flagged entry keeps draining without a
+                        # churny DRAINING->UP->DRAINING flip per refresh)
                         w.state = UP
                     elif w.state == DEAD and entry_gen > w.generation:
                         # a respawned slot reuses its id: only a STRICTLY
@@ -153,6 +160,12 @@ class RequestRouter:
                         w.state = UP
                         w.inflight.clear()
                     w.generation = max(w.generation, entry_gen)
+                if e.get("draining") and w.state == UP:
+                    w.state = DRAINING
+                    self._log.info(
+                        "worker %s announced draining (scale-down): no "
+                        "new placements (%d in flight)", wid,
+                        len(w.inflight))
             for wid_, w_ in list(self._workers.items()):
                 if wid_ not in seen:
                     if w_.state == UP:

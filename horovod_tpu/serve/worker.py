@@ -105,11 +105,19 @@ class ServeWorker:
     """Local serving stack + KV registration for one process."""
 
     def __init__(self, step_fn=None, port: Optional[int] = None,
-                 batcher: Optional[ContinuousBatcher] = None):
+                 batcher: Optional[ContinuousBatcher] = None,
+                 admission=None):
+        from horovod_tpu.serve.admission import controller_from_env
         self.batcher = batcher or ContinuousBatcher()
         self.loop = ServingLoop(step_fn or make_toy_step(), self.batcher)
+        # SLO-aware admission: priority-class shedding + tenant quotas
+        # (env-configured; the defaults are backwards-compatible — an
+        # unprioritized request is only ever shed by the full queue)
+        self.admission = admission if admission is not None \
+            else controller_from_env()
         self.frontend = ServeFrontend(
             batcher=self.batcher,
+            admission=self.admission,
             port=port if port is not None
             else (env_int("HOROVOD_SERVE_PORT") or 0))
         self._log = get_logger("serve.worker")
@@ -181,7 +189,14 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     log = get_logger("serve.worker")
+    from horovod_tpu.runner.elastic import preempt
     from horovod_tpu.runner.elastic import worker as elastic_worker
+
+    # Preemption notices (SIGTERM by default) drain a serve worker the
+    # same way they drain a training worker: announce on the KV, finish
+    # everything accepted, record DRAINED, exit 0 — this is also how the
+    # autoscaler's scale-down reaches us (drain, never a kill).
+    preempt.install_preempt_handler()
 
     elastic = elastic_worker.is_elastic_worker()
     generation = 0
@@ -210,6 +225,19 @@ def main(argv=None) -> int:
         while True:
             time.sleep(POLL_INTERVAL_SEC)
             now = time.monotonic()
+            if preempt.preempt_requested():
+                # the handler already announced the drain on the KV; we
+                # finish what we accepted, then depart cleanly
+                log.info("preemption notice: draining and exiting")
+                worker.drain(timeout=30.0)
+                worker.deregister()
+                if elastic:
+                    try:
+                        elastic_worker.record_state(
+                            generation, elastic_worker.DRAINED, kv)
+                    except Exception:  # noqa: BLE001 — exit 0 still says
+                        pass  # clean
+                return 0
             kv_due = kv is not None and \
                 now - last_kv_poll >= KV_POLL_INTERVAL_SEC
             if kv_due:

@@ -670,6 +670,287 @@ class TuneSpec(Spec):
 
 
 # ===========================================================================
+# Traffic-driven autoscaler: decide -> drain -> resize -> ack
+# ===========================================================================
+
+class AutoState(NamedTuple):
+    fleet: int            # accepting serving workers
+    spot: str             # spot-preemption drain: none|draining|done
+    auto: str             # autoscale (scale-down) drain: none|draining|done
+    pressure: bool        # offered load above the SLO bound
+    hot: int              # consecutive breached windows (capped)
+    idle: int             # consecutive idle windows (capped)
+    since: int            # windows since the last acted decision (capped)
+    last_dir: int         # +1 up / -1 down / 0 none yet
+    rec: Optional[tuple]  # (action, state, epoch, victim_draining) in KV
+    epoch: int            # acting driver's control epoch
+    kv_epoch: int         # authoritative durable epoch
+    crashed: bool         # driver dead, supervisor respawn pending
+    old_alive: bool       # pre-crash driver lingers with work left
+    old_rec: Optional[tuple]  # the lingering driver's replica of its record
+    crashes_left: int
+    kills_left: int
+    spikes_left: int
+    recedes_left: int
+    preempts_left: int
+    flap: bool            # opposite decisions within one hysteresis window
+    lost_acked: bool      # a second preemption notice force-killed a drain
+    stale_applied: bool   # a fenced-out driver's decision mutated the fleet
+    unclamped: bool       # a resize left [MIN, MAX]
+
+
+class AutoscaleSpec(Spec):
+    """One autoscaled serving fleet, one driver (+ a supervisor respawn),
+    binary offered load. The policy needs HYST consecutive breached/idle
+    windows before deciding (hysteresis, the real default from
+    ``env_registry``); every decision is a durable KV record advancing
+    ``decide -> drain -> resize -> ack`` that a recovered driver RESUMES.
+    Faults: a flash crowd arriving/receding, a spot-preemption drain, a
+    worker SIGKILL, a driver crash + respawn with a lingering stale-epoch
+    predecessor. Mutations re-introduce the three seeded hazards:
+    ``no_hysteresis`` (single-window decisions flap), ``victim_draining``
+    (scale-down picks the already-draining worker — the repeated
+    preemption notice force-exits it, preempt.py:86-92, dropping its
+    acked requests), ``no_epoch_fence`` (the fenced-out pre-crash
+    driver's decision write lands after recovery)."""
+
+    MIN, MAX = 1, 2
+
+    def __init__(self, no_hysteresis: bool = False,
+                 victim_draining: bool = False,
+                 no_epoch_fence: bool = False):
+        super().__init__(name="autoscale", mutations=tuple(
+            m for m, on in [("no_hysteresis", no_hysteresis),
+                            ("victim_draining", victim_draining),
+                            ("no_epoch_fence", no_epoch_fence)] if on))
+        self.no_hysteresis = no_hysteresis
+        self.victim_draining = victim_draining
+        self.no_epoch_fence = no_epoch_fence
+        # the real hysteresis default — the spec checks the shipped
+        # configuration, not an invented one
+        from horovod_tpu.common.env_registry import REGISTRY
+        self.hyst = 1 if no_hysteresis \
+            else int(REGISTRY["HOROVOD_AUTOSCALE_UP_WINDOWS"].default)
+        self.window = int(REGISTRY["HOROVOD_AUTOSCALE_UP_WINDOWS"].default)
+
+    def initial(self) -> AutoState:
+        return AutoState(
+            fleet=2, spot="none", auto="none", pressure=False,
+            hot=0, idle=0, since=self.window, last_dir=0, rec=None,
+            epoch=1, kv_epoch=1, crashed=False, old_alive=False,
+            old_rec=None, crashes_left=1, kills_left=1, spikes_left=1,
+            recedes_left=1, preempts_left=1, flap=False, lost_acked=False,
+            stale_applied=False, unclamped=False)
+
+    # -- decision machinery ---------------------------------------------------
+
+    def _tick(self, s: AutoState):
+        hot = min(s.hot + 1, self.hyst) if s.pressure else 0
+        idle = min(s.idle + 1, self.hyst) if not s.pressure else 0
+        since = min(s.since + 1, self.window)
+        ns = s._replace(hot=hot, idle=idle, since=since)
+        in_flight = s.rec is not None and s.rec[1] != "ack"
+        if not in_flight and hot >= self.hyst and s.fleet < self.MAX:
+            flap = s.flap or (s.last_dir == -1 and since < self.window)
+            return (f"autoscaler tick: {self.hyst} breached window(s) -> "
+                    f"decide scale-UP (`{kv_keys.autoscale_decision()}` "
+                    f"state=decide, epoch {s.epoch})",
+                    ns._replace(rec=("up", "decide", s.epoch, False),
+                                hot=0, idle=0, since=0, last_dir=1,
+                                flap=flap))
+        if not in_flight and idle >= self.hyst and s.fleet > self.MIN:
+            victim_draining = self.victim_draining and \
+                s.spot == "draining"
+            flap = s.flap or (s.last_dir == 1 and since < self.window)
+            label = (f"autoscaler tick: {self.hyst} idle window(s) -> "
+                     f"decide scale-DOWN"
+                     + (" (MUTATION: victim is the already-draining "
+                        "worker)" if victim_draining else
+                        " (victim: least-loaded accepting worker)"))
+            return (label,
+                    ns._replace(rec=("down", "decide", s.epoch,
+                                     victim_draining),
+                                hot=0, idle=0, since=0, last_dir=-1,
+                                flap=flap))
+        return "autoscaler tick: observe (no decision)", ns
+
+    def actions(self, s: AutoState):
+        out = []
+        # -- load / environment ---------------------------------------------
+        if s.spikes_left > 0 and not s.pressure:
+            out.append(("flash crowd arrives (queue depth / p99 breach "
+                        "the SLO bound)",
+                        s._replace(pressure=True,
+                                   spikes_left=s.spikes_left - 1)))
+        if s.recedes_left > 0 and s.pressure:
+            out.append(("load recedes (queues empty, fleet idle)",
+                        s._replace(pressure=False,
+                                   recedes_left=s.recedes_left - 1)))
+        # -- worker-side faults ----------------------------------------------
+        if s.preempts_left > 0 and s.spot == "none" and s.fleet > 1:
+            out.append((
+                f"fault: spot preemption notice — a worker announces "
+                f"`{kv_keys.drain('host', 0)}` and stops accepting",
+                s._replace(spot="draining", fleet=s.fleet - 1,
+                           preempts_left=s.preempts_left - 1)))
+        if s.spot == "draining":
+            out.append(("spot-drained worker finishes its accepted "
+                        "requests and exits 0",
+                        s._replace(spot="done")))
+            if s.kills_left > 0:
+                out.append((
+                    "fault: host dies mid-drain (draining worker "
+                    "SIGKILLed; router re-routes its in-flight)",
+                    s._replace(spot="done",
+                               kills_left=s.kills_left - 1)))
+        if s.spot == "done":
+            out.append(("driver reaps the spot drain (clean departure)",
+                        s._replace(spot="none")))
+        if s.kills_left > 0 and s.fleet > 0:
+            out.append((
+                "fault: accepting worker SIGKILLed (no notice)",
+                s._replace(fleet=s.fleet - 1,
+                           kills_left=s.kills_left - 1)))
+        # -- autoscaler + driver protocol (only while the driver lives) ------
+        if not s.crashed:
+            out.append(self._tick(s))
+            out.extend(self._protocol(s))
+        # -- driver crash / recovery -----------------------------------------
+        if s.crashes_left > 0 and not s.crashed:
+            lingering = s.rec is not None and s.rec[1] != "ack"
+            out.append((
+                "fault: driver crashes (supervisor presumes it dead; the "
+                "process lingers)" if lingering else
+                "fault: driver crashes",
+                s._replace(crashed=True, old_alive=lingering,
+                           old_rec=s.rec if lingering else None,
+                           crashes_left=s.crashes_left - 1)))
+        if s.crashed:
+            new_epoch = s.kv_epoch + 1
+            rec = s.rec
+            label = (f"supervisor respawns the driver (epoch "
+                     f"{s.kv_epoch} -> {new_epoch})")
+            if rec is not None and rec[1] != "ack":
+                rec = (rec[0], rec[1], new_epoch, rec[3])
+                label += (f"; recovery RESUMES the {rec[0]} decision at "
+                          f"state {rec[1]} instead of re-deciding")
+            out.append((label, s._replace(
+                crashed=False, epoch=new_epoch, kv_epoch=new_epoch,
+                rec=rec)))
+        # the fenced-out predecessor tries to finish its old decision
+        if s.old_alive and s.old_rec is not None and \
+                s.old_rec[2] < s.kv_epoch:
+            out.append(self._stale_write(s))
+        return out
+
+    def _protocol(self, s: AutoState):
+        """The driver advancing the in-flight decision record."""
+        out = []
+        if s.rec is None:
+            return out
+        action, state, epoch, victim_draining = s.rec
+        if state == "decide" and action == "up":
+            out.append((
+                "driver acts on the decision: spawn a worker "
+                "(record -> resize)",
+                s._replace(rec=(action, "resize", epoch,
+                                victim_draining))))
+        if state == "decide" and action == "down":
+            if victim_draining:
+                # MUTATION path: the victim already received a spot
+                # notice; a REPEATED notice force-exits immediately
+                # (preempt.py), dropping everything it had accepted
+                out.append((
+                    "driver delivers a SECOND preemption notice to the "
+                    "already-draining victim: it force-exits, acked "
+                    "requests lost (record -> drain)",
+                    s._replace(rec=(action, "drain", epoch, True),
+                               spot="done", lost_acked=True)))
+            elif s.fleet > 0:
+                out.append((
+                    "driver delivers the preemption notice: victim "
+                    "stops accepting and drains (record -> drain)",
+                    s._replace(rec=(action, "drain", epoch, False),
+                               auto="draining", fleet=s.fleet - 1)))
+        if state == "drain":
+            if s.auto == "draining":
+                out.append(("scale-down victim finishes its accepted "
+                            "requests and exits 0",
+                            s._replace(auto="done")))
+            if s.auto == "done" or (victim_draining and s.spot == "done"):
+                out.append((
+                    "driver resize removes the drained slot "
+                    "(record -> resize)",
+                    s._replace(rec=(action, "resize", epoch,
+                                    victim_draining),
+                               auto="none")))
+        if state == "resize":
+            if action == "up":
+                fleet = s.fleet + 1
+                out.append((
+                    "spawned worker joins the fleet; decision acked "
+                    f"(`{kv_keys.autoscale_event(1)}` audit record)",
+                    s._replace(fleet=fleet,
+                               rec=(action, "ack", epoch,
+                                    victim_draining),
+                               unclamped=s.unclamped or
+                               fleet > self.MAX)))
+            else:
+                out.append((
+                    "scale-down resize complete; decision acked "
+                    f"(`{kv_keys.autoscale_event(1)}` audit record)",
+                    s._replace(rec=(action, "ack", epoch,
+                                    victim_draining))))
+        return out
+
+    def _stale_write(self, s: AutoState):
+        action, state, old_epoch, _ = s.old_rec
+        outcome, _new = rules.admit_epoch(s.kv_epoch, old_epoch)
+        if outcome == rules.FENCED and not self.no_epoch_fence:
+            return (
+                f"kv 409s the lingering driver's "
+                f"`{kv_keys.autoscale_decision()}` write (offered epoch "
+                f"{old_epoch} < current {s.kv_epoch}); it stands down",
+                s._replace(old_alive=False))
+        fleet = s.fleet + 1 if action == "up" else max(0, s.fleet - 1)
+        return (
+            f"lingering driver applies its stale {action} decision "
+            f"(MUTATION: epoch fence skipped) — the fleet resizes twice "
+            f"for one decision",
+            s._replace(old_alive=False, fleet=fleet, stale_applied=True,
+                       unclamped=s.unclamped or fleet > self.MAX or
+                       fleet < 0))
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        return [
+            Invariant(
+                "no_flap",
+                "no opposite-direction decisions inside one hysteresis "
+                "window (a one-window spike or dip never reverses the "
+                "fleet — the loop cannot oscillate)",
+                lambda s: not s.flap),
+            Invariant(
+                "no_acked_request_loss",
+                "scale-down never selects an already-draining worker "
+                "(the repeated preemption notice would force-exit it and "
+                "drop the requests it had accepted)",
+                lambda s: not s.lost_acked),
+            Invariant(
+                "stale_epoch_decision_fenced",
+                "a fenced-out (pre-crash) driver's scaling decision "
+                "never mutates the fleet after recovery — the recovered "
+                "driver resumes the record; the old one is 409'd",
+                lambda s: not s.stale_applied),
+            Invariant(
+                "fleet_within_clamps",
+                "no resize the autoscaler performs leaves the "
+                "[min_workers, max_workers] interval",
+                lambda s: not s.unclamped),
+        ]
+
+
+# ===========================================================================
 # Registries
 # ===========================================================================
 
@@ -678,6 +959,7 @@ SPECS: Dict[str, type] = {
     "epoch": EpochSpec,
     "drain": DrainSpec,
     "tune": TuneSpec,
+    "autoscale": AutoscaleSpec,
 }
 
 # mutant name -> (spec name, constructor kwarg, description). Each is a
@@ -731,6 +1013,21 @@ MUTANTS: Dict[str, Tuple[str, str, str]] = {
         "from its own HOROVOD_RING_THRESHOLD_BYTES instead of the "
         "cycle-fenced TunedParams broadcast — two ranks route the same "
         "collective through different algorithms and deadlock"),
+    "autoscale_no_hysteresis": (
+        "autoscale", "no_hysteresis",
+        "hysteresis windows removed: the policy decides on a single "
+        "breached/idle observation, so a spike-then-dip flips the fleet "
+        "in opposite directions inside one window (flapping)"),
+    "autoscale_victim_draining": (
+        "autoscale", "victim_draining",
+        "scale-down victim selection stops excluding draining workers: "
+        "the repeated preemption notice force-exits the already-draining "
+        "victim (preempt.py) and its acked requests are lost"),
+    "autoscale_stale_epoch_decision": (
+        "autoscale", "no_epoch_fence",
+        "KV epoch fence removed from autoscale decision writes: after "
+        "driver recovery the lingering pre-crash driver applies its "
+        "stale decision and the fleet resizes twice for one decision"),
 }
 
 

@@ -139,7 +139,7 @@ def _interleave_soak(world: int, events: int, seed: int,
     rng = np.random.RandomState(seed)
     bound = env_float("HOROVOD_ELASTIC_RECOVERY_BOUND_SECONDS")
     recoveries = []
-    kinds = ["kill", "drain", "partition", "rejoin"]
+    kinds = ["kill", "drain", "partition", "rejoin", "drain_kill"]
     if control_plane is not None:
         kinds.append("driver_kill")
     with chaos.SimCluster(world, n_params=world * 100,
@@ -153,6 +153,14 @@ def _interleave_soak(world: int, events: int, seed: int,
                 c.kill(int(rng.randint(n)))
             elif kind == "drain" and n > max(2, world // 2):
                 c.drain(int(rng.randint(n)))
+            elif kind == "drain_kill" and n > max(3, world // 2 + 1):
+                # ISSUE 15 chaos satellite: a hard kill landing while a
+                # DIFFERENT worker is already draining for scale-down —
+                # one resize must compose the drain handoff with the
+                # kill's buddy recovery, no double-resize, no loss of
+                # the drained (acked) shard
+                c.drain(int(rng.randint(n)))
+                c.kill(int(rng.randint(len(c.members))))
             elif kind == "rejoin" and n < world:
                 c.rejoin(min(world - n, int(rng.randint(1, 3))))
             elif kind == "driver_kill":

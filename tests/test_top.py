@@ -346,3 +346,109 @@ def test_cli_serving_and_tune_exclusive():
     rc = top.main(["--serving", "--tune", "--once",
                    "--targets", "127.0.0.1:1"])
     assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# autoscale view (--autoscale): SLO headroom + admission counters + the KV
+# decision banner
+
+
+def _autoscale_registry(qd=4, lat_s=0.05, admitted=12, shed=3):
+    from horovod_tpu.serve.batcher import LATENCY_BUCKETS
+    reg = MetricsRegistry()
+    reg.gauge("hvd_serve_queue_depth").set(qd)
+    reg.gauge("hvd_serve_inflight").set(2)
+    lat = reg.histogram("hvd_serve_request_latency_seconds",
+                        buckets=LATENCY_BUCKETS)
+    for _ in range(20):
+        lat.observe(lat_s)
+    reg.counter("hvd_serve_admit_total", **{"class": "batch"}).inc(admitted)
+    reg.counter("hvd_serve_admit_total", **{"class": "premium"}).inc(5)
+    reg.counter("hvd_serve_shed_total", **{"class": "batch"}).inc(shed)
+    reg.counter("hvd_serve_quota_shed_total").inc(1)
+    return reg
+
+
+@pytest.fixture
+def autoscale_cluster():
+    regs = [_autoscale_registry(qd=4), _autoscale_registry(qd=0)]
+    exporters = [MetricsExporter(regs[r], port=0,
+                                 labels={"rank": str(r)}).start()
+                 for r in range(2)]
+    yield regs, exporters
+    for e in exporters:
+        e.stop()
+
+
+def test_autoscale_row_extraction(autoscale_cluster):
+    regs, exporters = autoscale_cluster
+    target = {"addr": "127.0.0.1", "port": exporters[0].port}
+    snap = top.scrape_target(target)
+    assert snap is not None
+    row = top.autoscale_row_from_snapshot(target, snap)
+    assert row["rank"] == "0"
+    assert row["queue_depth"] == 4 and row["inflight"] == 2
+    assert 25.0 <= row["p99_ms"] <= 50.0
+    # headroom: queue 4/8 -> 0.5, p99 ~50ms / 500ms -> ~0.9; min wins
+    assert row["headroom"] == pytest.approx(0.5, abs=0.01)
+    assert row["admitted"] == 17 and row["shed"] == 3
+    assert row["quota_shed"] == 1
+    assert row["classes"]["batch"] == {"admitted": 12.0, "shed": 3.0}
+
+
+def test_autoscale_render_columns_and_class_footer(autoscale_cluster):
+    regs, exporters = autoscale_cluster
+    state = top.TopState([{"addr": "127.0.0.1", "port": e.port}
+                          for e in exporters], autoscale=True)
+    rows, unreachable = state.refresh(window=False)
+    assert unreachable == 0 and len(rows) == 2
+    text = state.render(rows, unreachable, "autoscale-title")
+    lines = text.splitlines()
+    assert "autoscale-title" in lines[0]
+    assert lines[1].startswith("fleet=")  # banner (no KV: unknown)
+    for col in top.AUTOSCALE_COLUMNS:
+        assert col in lines[2]
+    assert any(ln.startswith("classes (admit/shed):") for ln in lines)
+    assert "batch 24/6" in text  # aggregated across both ranks
+
+
+def test_autoscale_banner_reads_kv_decision_record():
+    from horovod_tpu.common import kv_keys
+    from horovod_tpu.runner.http_kv import KVServer
+    import time as _time
+    kv = KVServer(port=0).start()
+    try:
+        kv.put_json(kv_keys.autoscale_decision(),
+                    {"seq": 4, "action": "up", "state": "ack",
+                     "reason": "SLO breached", "fleet": 2,
+                     "ts": _time.time() - 5})
+        state = top.TopState([], autoscale=True,
+                             kv=("127.0.0.1", kv.port))
+        st = state.autoscale_status()
+        assert st["action"] == "up" and st["fleet"] == 2
+        assert 4 <= st["age_seconds"] <= 60
+        text = top.render_autoscale([], status=st)
+        assert "last=up[ack]" in text and "fleet=2" in text
+    finally:
+        kv.stop()
+
+
+def test_cli_autoscale_once_smoke(autoscale_cluster):
+    """`hvd-top --autoscale --once` end to end in a clean interpreter —
+    the autoscale-view CI surface."""
+    regs, exporters = autoscale_cluster
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.obs.top", "--autoscale",
+         "--once", "--targets", _targets_arg(exporters)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "HEADRM" in proc.stdout and "SHED" in proc.stdout
+    assert "classes (admit/shed):" in proc.stdout
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert any(ln.split()[0] == "0" for ln in lines[3:])
+
+
+def test_cli_autoscale_exclusive_with_serving_and_tune():
+    for combo in (["--autoscale", "--serving"], ["--autoscale", "--tune"]):
+        rc = top.main(combo + ["--once", "--targets", "127.0.0.1:1"])
+        assert rc == 2
