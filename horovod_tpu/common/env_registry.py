@@ -177,6 +177,25 @@ _decl("HOROVOD_SERVE_TENANT_QPS", "float", 0.0,
       "off); exhausted tenants get 429 + Retry-After")
 _decl("HOROVOD_SERVE_TENANT_BURST", "float", 10.0,
       "per-tenant token-bucket capacity (burst size)")
+_decl("HOROVOD_SERVE_KV_BLOCK_TOKENS", "int", 16,
+      "token positions covered by one KV-cache block (the paging "
+      "granularity of serve/kv_cache.py; also the shareable-prefix "
+      "quantum — only full blocks are content-hashed and reused)")
+_decl("HOROVOD_SERVE_KV_POOL_BLOCKS", "int", 512,
+      "bounded KV-cache block pool per serving worker; admission "
+      "charges worst-case blocks here and a request that cannot get "
+      "them is rejected 429-shaped instead of OOMing mid-decode")
+_decl("HOROVOD_SERVE_PREFIX_REUSE", "bool", True,
+      "hash-based prefix reuse: full prompt blocks are content-hashed "
+      "and shared copy-on-write across requests with refcounts, so "
+      "identical system prompts pay prefill once")
+_decl("HOROVOD_SERVE_SPEC_DECODE", "bool", False,
+      "speculative decoding: a draft model proposes "
+      "HOROVOD_SERVE_SPEC_DRAFT_K tokens per step and the target "
+      "verifies them in one batched step (greedy output stays "
+      "token-identical to the non-speculative path)")
+_decl("HOROVOD_SERVE_SPEC_DRAFT_K", "int", 4,
+      "draft tokens proposed per speculative decode step")
 
 # -- traffic-driven autoscaler (driver policy loop) --
 _decl("HOROVOD_AUTOSCALE", "bool", False,

@@ -72,10 +72,14 @@ _FMT = "{:>5} {:>9} {:>6} {:>7} {:>7} {:>6} {:>5} {:>7} {:>5}"
 # from the same /metrics.json endpoints — QPS is the ok-request rate over
 # the refresh window (lifetime totals on --once show as OK), OCC the mean
 # batch occupancy, p50/p99 from the request-latency histogram, REJ/EXP the
-# backpressure and deadline counters.
+# backpressure and deadline counters. The fast-path trio reads the
+# hvd_serve_cache_* families: HIT% the shared-prefix lookup hit rate,
+# BLOCKS the used/pool block ratio of the paged KV cache, REUSE the
+# shared-block incref count (requests that skipped prefill compute).
 SERVING_COLUMNS = ("RANK", "QPS", "QD", "INFL", "OCC", "p50ms", "p99ms",
-                   "OK", "REJ", "EXP")
-_SERVING_FMT = "{:>5} {:>7} {:>4} {:>5} {:>5} {:>8} {:>8} {:>7} {:>6} {:>6}"
+                   "OK", "REJ", "EXP", "HIT%", "BLOCKS", "REUSE")
+_SERVING_FMT = ("{:>5} {:>7} {:>4} {:>5} {:>5} {:>8} {:>8} {:>7} {:>6} "
+                "{:>6} {:>6} {:>9} {:>7}")
 
 # Tune view (--tune): the frontend autotuner's live state per rank, from
 # the hvd_tune_* gauges (horovod_tpu/tune). BUCKET/FUSE/CYC/LANE are the
@@ -227,6 +231,10 @@ def serving_row_from_snapshot(target: dict, snap: dict,
     occ = snapshot_histogram(snap, "hvd_serve_batch_occupancy")
     p50 = histogram_quantile(lat, 0.5) if lat else None
     p99 = histogram_quantile(lat, 0.99) if lat else None
+    lookups = snapshot_value(snap, "hvd_serve_cache_lookups_total")
+    hits = snapshot_value(snap, "hvd_serve_cache_hits_total")
+    used = snapshot_value(snap, "hvd_serve_cache_blocks_used")
+    pool = snapshot_value(snap, "hvd_serve_cache_pool_blocks")
     return {
         "rank": _rank_of(target, snap),
         "qps": qps,
@@ -240,6 +248,10 @@ def serving_row_from_snapshot(target: dict, snap: dict,
                                    status="rejected") or 0.0,
         "expired": snapshot_value(snap, "hvd_serve_requests_total",
                                   status="expired") or 0.0,
+        "hit_pct": (100.0 * (hits or 0.0) / lookups if lookups else None),
+        "blocks": (f"{int(used)}/{int(pool)}"
+                   if used is not None and pool is not None else None),
+        "reuse": snapshot_value(snap, "hvd_serve_cache_reuse_total"),
         "qps_raw": (now, ok),
     }
 
@@ -418,7 +430,10 @@ def render_serving(rows: List[dict], unreachable: int = 0,
             _fmt(r["occupancy"], "{:.1f}"),
             _fmt(r["p50_ms"], "{:.2f}"), _fmt(r["p99_ms"], "{:.2f}"),
             _fmt(r["ok"], "{:.0f}"), _fmt(r["rejected"], "{:.0f}"),
-            _fmt(r["expired"], "{:.0f}")))
+            _fmt(r["expired"], "{:.0f}"),
+            _fmt(r["hit_pct"], "{:.1f}"),
+            r["blocks"] or "-",
+            _fmt(r["reuse"], "{:.0f}")))
     if unreachable:
         lines.append(f"({unreachable} target(s) unreachable)")
     return "\n".join(lines)

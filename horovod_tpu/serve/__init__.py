@@ -55,10 +55,21 @@ from horovod_tpu.serve.batcher import (  # noqa: F401
     default_buckets,
 )
 from horovod_tpu.serve.executor import (  # noqa: F401
+    CachedStep,
     ServingLoop,
     activation_wire_report,
+    make_rnn_lm_step,
+    make_toy_cached_step,
+    make_toy_draft_step,
     make_toy_step,
     make_tp_lm_step,
+)
+from horovod_tpu.serve.kv_cache import (  # noqa: F401
+    CacheExhausted,
+    CacheLease,
+    PagedKVCache,
+    blocks_for,
+    prefix_hash,
 )
 from horovod_tpu.serve.frontend import ServeFrontend  # noqa: F401
 from horovod_tpu.serve.router import (  # noqa: F401

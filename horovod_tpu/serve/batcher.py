@@ -107,7 +107,7 @@ class InferenceRequest:
 
     __slots__ = ("id", "tokens", "max_new_tokens", "deadline", "arrival",
                  "bucket", "generated", "status", "error", "finished_at",
-                 "_done")
+                 "lease", "_done")
 
     def __init__(self, tokens: Sequence[int], max_new_tokens: int,
                  deadline: float, bucket: int,
@@ -119,6 +119,7 @@ class InferenceRequest:
         self.arrival = time.monotonic()
         self.bucket = int(bucket)
         self.generated: List[int] = []
+        self.lease = None  # CacheLease when the batcher owns a KV cache
         self.status = "queued"
         self.error = ""
         self.finished_at: Optional[float] = None
@@ -168,7 +169,8 @@ class ContinuousBatcher:
                  max_len: int = 2048,
                  buckets: Optional[Sequence[int]] = None,
                  max_new_tokens_cap: Optional[int] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 cache=None):
         self.max_batch = max_batch if max_batch is not None \
             else env_int("HOROVOD_SERVE_MAX_BATCH")
         self.queue_depth = queue_depth if queue_depth is not None \
@@ -181,6 +183,10 @@ class ContinuousBatcher:
             else env_int("HOROVOD_SERVE_MAX_NEW_TOKENS")
         self.buckets = tuple(buckets) if buckets is not None \
             else default_buckets(max_len)
+        # optional block-paged KV cache (serve/kv_cache.py): when set,
+        # admission charges blocks against its bounded pool and the
+        # expiry split below (release vs free) keeps it balanced
+        self.cache = cache
         self._queue: deque = deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -229,6 +235,17 @@ class ContinuousBatcher:
                 req.finish("rejected", "admission queue full (backpressure)")
                 raise AdmissionRejected(
                     f"admission queue full ({self.queue_depth} waiting)")
+            if self.cache is not None:
+                from horovod_tpu.serve.kv_cache import CacheExhausted
+                try:
+                    # charge the block pool NOW: a request that cannot
+                    # get cache blocks is a 429 at admission, never an
+                    # OOM mid-decode
+                    req.lease = self.cache.admit(req.tokens, budget)
+                except CacheExhausted as e:
+                    self._requests["rejected"].inc()
+                    req.finish("rejected", str(e))
+                    raise AdmissionRejected(str(e)) from None
             self._queue.append(req)
             self._depth.set(len(self._queue))
             self._admitted.inc()
@@ -299,7 +316,17 @@ class ContinuousBatcher:
     def _finish(self, req: InferenceRequest, status: str, error: str = ""):
         if req.done:
             return
+        was_queued = req.status == "queued"
         req.finish(status, error)
+        if req.lease is not None and self.cache is not None:
+            # the expiry split: a request that never left the queue only
+            # ever held charged capacity (release — it provably never
+            # bound a block); one that ran frees exactly what it charged
+            # at the step boundary where its (partial) output returns
+            if was_queued:
+                self.cache.release(req.lease)
+            else:
+                self.cache.free(req.lease)
         self._requests[status].inc()
         if status == "ok":
             self._tokens_out.inc(len(req.generated))

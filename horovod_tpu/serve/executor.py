@@ -26,10 +26,37 @@ Two built-in step functions:
   *activation* path the ROADMAP calls out: PR 1 built the quantized
   collectives for gradients; serving is where they meet activations.
 
-Decode here is prefill-style recompute (the full forward re-runs per
-token over the padded bucket). That keeps shapes static and the executor
-tiny; a KV-cache is an orthogonal follow-up and does not change any
-interface above ``step_fn``.
+Decode on the plain ``step_fn`` path is prefill-style recompute (the full
+forward re-runs per token over the padded bucket): shapes stay static and
+the executor stays tiny.
+
+**The serving fast path** (``docs/DESIGN.md`` "Serving fast path") layers
+two optimizations on top, both behind the :class:`CachedStep` contract::
+
+    cached.advance(tokens [B, L] int32, upto [B] int32,
+                   state [B, H] f32, state_len [B] int32)
+        -> (preds [B, A] int32, states [B, A, H] f32)
+
+which consumes positions ``state_len..upto-1`` per row and returns the
+greedy prediction + model-state checkpoint after each consumed position.
+With it the loop:
+
+- **pages model state through the block-paged KV cache**
+  (:mod:`horovod_tpu.serve.kv_cache`): per-step cost drops from O(L) to
+  O(new tokens), prefill resumes from shared-prefix block checkpoints
+  (hash hits pay zero prefill), and each request's block table is bound /
+  freed at step boundaries so the pool accounting the
+  ``PagedCacheSpec`` model-checks holds live;
+- **speculative decoding** (``HOROVOD_SERVE_SPEC_DECODE``): a small draft
+  model proposes ``HOROVOD_SERVE_SPEC_DRAFT_K`` tokens per row, the
+  target verifies all of them in ONE batched ``advance`` call, and the
+  longest agreeing prefix plus the target's bonus token is emitted —
+  greedy output is token-identical to the non-speculative path by
+  construction (pinned by test). The per-step accept counts are a
+  ``4*B``-byte payload published through the ``spec_sync`` hook — far
+  under ``HOROVOD_LOW_LATENCY_THRESHOLD``, so when the worker wires the
+  hook to its engine heartbeat the accept/reject exchange rides the
+  serving-mode express lane, never the fusion buffer.
 """
 
 from __future__ import annotations
@@ -56,12 +83,34 @@ class ServingLoop:
     def __init__(self, step_fn: StepFn, batcher: ContinuousBatcher,
                  eos_token: Optional[int] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 idle_wait: float = 0.02):
+                 idle_wait: float = 0.02,
+                 cached_step: Optional["CachedStep"] = None,
+                 draft_step: Optional["CachedStep"] = None,
+                 spec_k: Optional[int] = None,
+                 spec_sync: Optional[Callable[[np.ndarray],
+                                              np.ndarray]] = None):
         self._step_fn = step_fn
         self._batcher = batcher
         self._eos = eos_token
         self._idle_wait = idle_wait
+        # serving fast path: incremental decode over paged model state.
+        # The block tables live in the batcher's cache, so the fast path
+        # requires one (state has to be owned by the pool the admission
+        # charge was made against — otherwise expiry could leak it).
+        self._cached = cached_step
+        if cached_step is not None and batcher.cache is None:
+            raise ValueError("cached_step requires a batcher with a "
+                             "PagedKVCache (state pages live in its pool)")
+        self._draft = draft_step
+        if draft_step is not None and cached_step is None:
+            raise ValueError("speculative decoding requires cached_step")
+        from horovod_tpu.common.env_registry import env_int
+        self._spec_k = spec_k if spec_k is not None \
+            else env_int("HOROVOD_SERVE_SPEC_DRAFT_K")
+        self.spec_sync = spec_sync
         reg = registry if registry is not None else get_registry()
+        self._spec_proposed = reg.counter("hvd_serve_spec_proposed_total")
+        self._spec_accepted = reg.counter("hvd_serve_spec_accepted_total")
         self._inflight = reg.gauge("hvd_serve_inflight")
         self._steps = reg.counter("hvd_serve_decode_steps_total")
         self._step_seconds = reg.histogram("hvd_serve_step_seconds",
@@ -114,17 +163,23 @@ class ServingLoop:
             self._idle.clear()
             self._inflight.set(len(running))
             self._batcher.observe_step(len(running))
-            bucket = running[0].bucket
-            batch = self._batcher.max_batch
-            tokens = np.zeros((batch, bucket), np.int32)
-            lengths = np.ones(batch, np.int32)  # padded rows: 1 dummy token
-            for i, r in enumerate(running):
-                seq = r.tokens + r.generated
-                tokens[i, :len(seq)] = seq
-                lengths[i] = len(seq)
             t0 = time.perf_counter()
             try:
-                next_ids = np.asarray(self._step_fn(tokens, lengths))
+                if self._cached is not None:
+                    emitted = self._step_cached(running)
+                else:
+                    bucket = running[0].bucket
+                    batch = self._batcher.max_batch
+                    tokens = np.zeros((batch, bucket), np.int32)
+                    # padded rows: 1 dummy token
+                    lengths = np.ones(batch, np.int32)
+                    for i, r in enumerate(running):
+                        seq = r.tokens + r.generated
+                        tokens[i, :len(seq)] = seq
+                        lengths[i] = len(seq)
+                    next_ids = np.asarray(self._step_fn(tokens, lengths))
+                    emitted = [[int(next_ids[i])]
+                               for i in range(len(running))]
             except Exception as e:  # noqa: BLE001 — a broken executor must
                 # fail the requests it carried, loudly, not hang them
                 self._failures.inc()
@@ -138,13 +193,19 @@ class ServingLoop:
             now = time.monotonic()
             still: List[InferenceRequest] = []
             for i, r in enumerate(running):
-                r.generated.append(int(next_ids[i]))
-                if (self._eos is not None and
-                        r.generated[-1] == self._eos) or \
-                        len(r.generated) >= r.max_new_tokens or \
-                        r.length >= r.bucket:
-                    self._batcher.complete(r, "ok")
-                elif r.deadline <= now:
+                finished = False
+                for tok in emitted[i]:
+                    r.generated.append(int(tok))
+                    if (self._eos is not None and
+                            r.generated[-1] == self._eos) or \
+                            len(r.generated) >= r.max_new_tokens or \
+                            r.length >= r.bucket:
+                        self._batcher.complete(r, "ok")
+                        finished = True
+                        break
+                if finished:
+                    continue
+                if r.deadline <= now:
                     self._batcher.complete(r, "expired",
                                            "deadline passed mid-generation")
                 else:
@@ -152,6 +213,285 @@ class ServingLoop:
             running = still
         self._inflight.set(0)
         self._idle.set()
+
+    # -- the serving fast path (cached decode + speculative verify) ----------
+
+    def _step_cached(self, running: List[InferenceRequest]):
+        """One fast-path step: per-row cost is O(new tokens), not O(L).
+
+        Prefill rows resume from their shared-prefix checkpoint and
+        consume their remaining prompt tail in this step; steady rows
+        consume exactly one position — or, with a draft model, propose
+        ``spec_k`` tokens and have the target verify all of them in ONE
+        batched ``advance`` call, emitting the longest agreeing prefix
+        plus the target's bonus token (greedy-identical by
+        construction). Returns the emitted token list per row."""
+        cache = self._batcher.cache
+        n = len(running)
+        seqs = [r.tokens + r.generated for r in running]
+        for i, r in enumerate(running):
+            le = r.lease
+            if le.state is None:
+                if le.prefix_state is not None:
+                    # hash hit: resume from the shared block checkpoint
+                    # — this is the prefill compute the reuse pays once
+                    le.state = np.asarray(le.prefix_state,
+                                          np.float32).copy()
+                    le.state_len = le.prefix_covered
+                else:
+                    le.state = self._cached.init_state(1)[0]
+                    le.state_len = 0
+
+        # -- draft proposals (k cheap micro-steps) ---------------------------
+        k = self._spec_k if self._draft is not None else 0
+        ext = [list(s) for s in seqs]
+        props: List[List[int]] = [[] for _ in range(n)]
+        traj: List[List[np.ndarray]] = [[] for _ in range(n)]
+        if k > 0:
+            steady = {i for i, r in enumerate(running)
+                      if r.lease.state_len == len(seqs[i]) - 1}
+            for i, r in enumerate(running):
+                if r.lease.draft_state is None:
+                    r.lease.draft_state = self._draft.init_state(1)[0]
+                    r.lease.draft_len = 0
+            for _ in range(k):
+                width = max(len(e) for e in ext)
+                tok = np.zeros((n, width), np.int32)
+                for i, e in enumerate(ext):
+                    tok[i, :len(e)] = e
+                upto = np.array([len(e) for e in ext], np.int64)
+                dstate = np.stack([r.lease.draft_state for r in running])
+                dlen = np.array([r.lease.draft_len for r in running],
+                                np.int64)
+                preds, states = self._draft.advance(tok, upto, dstate,
+                                                    dlen)
+                for i, r in enumerate(running):
+                    c = int(upto[i] - dlen[i])
+                    if c > 0:
+                        r.lease.draft_state = states[i, c - 1].copy()
+                        r.lease.draft_len = int(upto[i])
+                    if i in steady:
+                        traj[i].append(r.lease.draft_state)
+                        p = int(preds[i, c - 1])
+                        ext[i].append(p)
+                        props[i].append(p)
+
+        # -- target verify: ONE batched advance over every row ---------------
+        width = max(len(e) for e in ext)
+        tok = np.zeros((n, width), np.int32)
+        for i, e in enumerate(ext):
+            tok[i, :len(e)] = e
+        upto = np.array([len(e) for e in ext], np.int64)
+        tstate = np.stack([r.lease.state for r in running])
+        tlen = np.array([r.lease.state_len for r in running], np.int64)
+        preds, states = self._cached.advance(tok, upto, tstate, tlen)
+
+        emitted: List[List[int]] = []
+        accepts = np.zeros(n, np.int32)
+        for i, r in enumerate(running):
+            le = r.lease
+            c = int(upto[i] - tlen[i])
+            npp = len(props[i])
+            base = c - npp - 1  # pred index right after the last REAL token
+            a = 0
+            while a < npp and props[i][a] == int(preds[i, base + a]):
+                a += 1
+            accepts[i] = a
+            emitted.append(props[i][:a] + [int(preds[i, base + a])])
+            le.state = states[i, base + a].copy()
+            prev_len = le.state_len
+            le.state_len = int(tlen[i]) + base + a + 1
+            if npp and a < npp:
+                # reject: roll the draft back to the last accepted
+                # checkpoint (traj[j] covers seq + j proposals)
+                le.draft_state = traj[i][a].copy() if a < len(traj[i]) \
+                    else le.draft_state
+                le.draft_len = len(seqs[i]) + a
+            # publish the prompt's full-block boundary checkpoints as
+            # shared CoW blocks on the prefill step (first crossing of
+            # the prompt end)
+            prompt_len = len(r.tokens)
+            if cache is not None and prev_len < prompt_len:
+                bt = cache.block_tokens
+                bs = {}
+                for end in range(bt, prompt_len + 1, bt):
+                    j = end - int(tlen[i]) - 1
+                    if prev_len < end and 0 <= j < c:
+                        bs[end] = states[i, j]
+                if bs:
+                    cache.publish(le, r.tokens, bs)
+            if cache is not None:
+                # the emitted burst may overshoot the budget/bucket (the
+                # run loop truncates at append time and completes the
+                # request) — never bind past what admission charged for
+                covered = min(r.length + len(emitted[i]),
+                              len(r.tokens) + r.max_new_tokens, r.bucket)
+                cache.bind(le, covered, le.state)
+        if k > 0:
+            self._spec_proposed.inc(int(sum(len(p) for p in props)))
+            self._spec_accepted.inc(int(accepts.sum()))
+            if self.spec_sync is not None and any(props):
+                # tiny accept/reject exchange: 4*B bytes, deep under the
+                # express-lane threshold
+                self.spec_sync(accepts)
+        return emitted
+
+
+# ---------------------------------------------------------------------------
+# cached-step contract (the serving fast path's execution interface)
+
+
+class CachedStep:
+    """Incremental greedy decode over an explicit, checkpointable model
+    state.
+
+    ``state_dim`` is the per-row state width H. :meth:`advance` consumes
+    token positions ``state_len[b]..upto[b]-1`` of row ``b`` and returns,
+    for each consumed position, the greedy next-token prediction and the
+    state checkpoint *after* consuming it. The state after ``p`` tokens
+    is a pure function of those ``p`` tokens — which is exactly what
+    makes block-boundary checkpoints shareable across requests
+    (hash-based prefix reuse) and eviction loss-free (re-derivable).
+
+    Rows may consume different counts; ``A = max(upto - state_len)`` and
+    short rows are right-padded (their padded preds/states are garbage —
+    callers index by each row's own consumed count).
+    """
+
+    state_dim: int = 1
+
+    def init_state(self, batch: int) -> np.ndarray:
+        return np.zeros((batch, self.state_dim), np.float32)
+
+    def advance(self, tokens: np.ndarray, upto: np.ndarray,
+                state: np.ndarray, state_len: np.ndarray):
+        raise NotImplementedError
+
+
+class _ToyCachedStep(CachedStep):
+    """Cached twin of :func:`make_toy_step`: the model state is the
+    running token sum, so ``pred after p tokens = (sum + p) % vocab`` —
+    bit-identical to the recompute path, with O(1) per-token cost."""
+
+    state_dim = 1
+
+    def __init__(self, vocab: int = 256):
+        self.vocab = vocab
+
+    def advance(self, tokens, upto, state, state_len):
+        b, L = tokens.shape
+        a = int(max(1, (upto - state_len).max()))
+        preds = np.zeros((b, a), np.int32)
+        states = np.zeros((b, a, 1), np.float32)
+        s = state[:, 0].astype(np.int64).copy()
+        pos = state_len.astype(np.int64).copy()
+        for j in range(a):
+            live = pos < upto
+            tok = tokens[np.arange(b), np.minimum(pos, L - 1)]
+            s = np.where(live, s + tok, s)
+            pos = np.where(live, pos + 1, pos)
+            preds[:, j] = (s + pos) % self.vocab
+            states[:, j, 0] = s
+        return preds, states
+
+
+def make_toy_cached_step(vocab: int = 256) -> CachedStep:
+    return _ToyCachedStep(vocab)
+
+
+def make_toy_draft_step(vocab: int = 256, wrong_every: int = 0) -> CachedStep:
+    """Draft twin of the toy model for speculative-decode tests: agrees
+    with the target except (deterministically) every ``wrong_every``-th
+    consumed position, so acceptance AND rejection paths both exercise.
+    ``wrong_every=0`` is a perfect draft (always accepts)."""
+    base = _ToyCachedStep(vocab)
+    if not wrong_every:
+        return base
+
+    class _Wrong(CachedStep):
+        state_dim = 1
+
+        def advance(self, tokens, upto, state, state_len):
+            preds, states = base.advance(tokens, upto, state, state_len)
+            # perturb predictions at positions where (consumed count)
+            # hits the wrong_every stride — a function of state_len so
+            # it is deterministic and replayable
+            b, a = preds.shape
+            for j in range(a):
+                at = state_len + j + 1
+                bad = (at % wrong_every) == 0
+                preds[:, j] = np.where(bad, (preds[:, j] + 1) % vocab,
+                                       preds[:, j])
+            return preds, states
+
+    return _Wrong()
+
+
+class _RnnCachedStep(CachedStep):
+    """Recurrent LM with explicit state: ``h' = tanh(h W + E[tok])``,
+    ``logits = h' E^T``. Same float-op order on the cached and recompute
+    paths, so greedy tokens are bit-identical between them."""
+
+    def __init__(self, embed: np.ndarray, w: np.ndarray):
+        self.embed = embed.astype(np.float32)
+        self.w = w.astype(np.float32)
+        self.state_dim = w.shape[0]
+
+    def advance(self, tokens, upto, state, state_len):
+        b, L = tokens.shape
+        a = int(max(1, (upto - state_len).max()))
+        preds = np.zeros((b, a), np.int32)
+        states = np.zeros((b, a, self.state_dim), np.float32)
+        h = state.astype(np.float32).copy()
+        pos = state_len.astype(np.int64).copy()
+        for j in range(a):
+            live = pos < upto
+            tok = tokens[np.arange(b), np.minimum(pos, L - 1)]
+            h_new = np.tanh(h @ self.w + self.embed[tok])
+            h = np.where(live[:, None], h_new, h)
+            pos = np.where(live, pos + 1, pos)
+            preds[:, j] = np.argmax(h @ self.embed.T, axis=-1)
+            states[:, j] = h
+        return preds, states
+
+
+def make_rnn_lm_step(hidden: int = 64, vocab: int = 256, seed: int = 0,
+                     draft_levels: int = 24):
+    """Build the fast-path reference LM: ``(step_fn, cached, draft,
+    info)``.
+
+    ``step_fn`` is the classic recompute :data:`StepFn` (derived from the
+    same weights by advancing from the zero state every call — the
+    "today's batcher" baseline the BENCH ``serving_fastpath`` block
+    measures against). ``cached`` is the incremental :class:`CachedStep`.
+    ``draft`` is the weight-quantized target (``draft_levels`` uniform
+    levels per tensor — the int8-style cheap twin): its argmax mostly
+    agrees with the target, which is what gives speculation a usable
+    accept rate without a trained model."""
+    rng = np.random.RandomState(seed)
+    embed = (rng.randn(vocab, hidden) * 0.5).astype(np.float32)
+    w = (rng.randn(hidden, hidden) * (0.9 / np.sqrt(hidden))) \
+        .astype(np.float32)
+    cached = _RnnCachedStep(embed, w)
+
+    def quant(x):
+        s = np.abs(x).max() / draft_levels
+        return (np.round(x / s) * s).astype(np.float32)
+
+    draft = _RnnCachedStep(quant(embed), quant(w))
+
+    def step_fn(tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        b = tokens.shape[0]
+        preds, _ = cached.advance(
+            tokens, lengths.astype(np.int64),
+            cached.init_state(b), np.zeros(b, np.int64))
+        idx = np.maximum(lengths - 1, 0)
+        return preds[np.arange(b), np.minimum(idx, preds.shape[1] - 1)] \
+            .astype(np.int32)
+
+    info = {"hidden": hidden, "vocab": vocab, "seed": seed,
+            "draft": f"uniform-quantized target ({draft_levels} levels)"}
+    return step_fn, cached, draft, info
 
 
 # ---------------------------------------------------------------------------

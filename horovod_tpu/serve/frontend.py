@@ -68,6 +68,30 @@ def serving_stats(snapshot: dict) -> dict:
         "decode_steps": snapshot_value(snapshot,
                                        "hvd_serve_decode_steps_total") or 0,
     }
+    # serving fast path: block-paged KV cache + speculative decode health
+    lookups = snapshot_value(snapshot, "hvd_serve_cache_lookups_total") or 0
+    hits = snapshot_value(snapshot, "hvd_serve_cache_hits_total") or 0
+    proposed = snapshot_value(snapshot,
+                              "hvd_serve_spec_proposed_total") or 0
+    accepted = snapshot_value(snapshot,
+                              "hvd_serve_spec_accepted_total") or 0
+    out["cache"] = {
+        "pool_blocks": snapshot_value(snapshot,
+                                      "hvd_serve_cache_pool_blocks"),
+        "blocks_used": snapshot_value(snapshot,
+                                      "hvd_serve_cache_blocks_used"),
+        "shared_blocks": snapshot_value(snapshot,
+                                        "hvd_serve_cache_shared_blocks"),
+        "hit_pct": round(100.0 * hits / lookups, 1) if lookups else None,
+        "reuse": snapshot_value(snapshot,
+                                "hvd_serve_cache_reuse_total") or 0,
+        "evictions": snapshot_value(snapshot,
+                                    "hvd_serve_cache_evictions_total") or 0,
+        "prefill_tokens_saved": snapshot_value(
+            snapshot, "hvd_serve_cache_prefill_tokens_saved_total") or 0,
+        "spec_accept_pct": round(100.0 * accepted / proposed, 1)
+        if proposed else None,
+    }
     out["batch_occupancy_mean"] = round(occ["sum"] / occ["count"], 3) \
         if occ else None
     for q, key in ((0.5, "latency_p50_ms"), (0.99, "latency_p99_ms")):
@@ -132,6 +156,13 @@ class ServeFrontend:
                         self._reply(200, {"status": "ok"})
                 elif path == "/stats":
                     stats = serving_stats(frontend.registry.snapshot())
+                    if frontend.batcher is not None and \
+                            frontend.batcher.cache is not None:
+                        # live conservation check (pool == free + charged
+                        # + resident shared) — what the chaos drill
+                        # asserts on the survivor after a peer kill
+                        stats["cache"]["pool_balanced"] = \
+                            frontend.batcher.cache.balanced()
                     if frontend.admission is not None:
                         stats["admission"] = frontend.admission.counters()
                     if frontend.router is not None:

@@ -111,6 +111,49 @@ def run_load(submit: Callable[[dict], dict], offered_qps: float,
     }
 
 
+def shared_prefix_trace(seed: int = 0, requests: int = 256,
+                        tenants: int = 4, prefix_len: int = 96,
+                        tail_len: int = 16, max_new_tokens: int = 16,
+                        vocab: int = 256,
+                        tenant_mix: Optional[Sequence[float]] = None
+                        ) -> List[dict]:
+    """Seeded, replayable shared-prefix request trace — the first brick
+    of the ROADMAP trace-driven loadgen item, shared by the BENCH
+    ``serving_fastpath`` block, the smoke, and the tests.
+
+    Each tenant has one fixed ``prefix_len``-token system prompt; every
+    request is that prefix plus a fresh ``tail_len``-token user turn.
+    ``tenant_mix`` weights the tenant draw (default is zipf-ish: tenant 0
+    dominates — the million-users-one-system-prompt shape where prefix
+    reuse pays). Identical ``(seed, knobs)`` always reproduce the exact
+    same token streams, so a bench regression is re-runnable bit-for-bit.
+    """
+    rng = np.random.RandomState(seed)
+    prefixes = [rng.randint(0, vocab, prefix_len).tolist()
+                for _ in range(tenants)]
+    mix = np.asarray(tenant_mix if tenant_mix is not None
+                     else [1.0 / (i + 1) for i in range(tenants)], float)
+    mix = mix / mix.sum()
+    out: List[dict] = []
+    for _ in range(requests):
+        t = int(rng.choice(tenants, p=mix))
+        tail = rng.randint(0, vocab, tail_len).tolist()
+        out.append({"tenant": f"tenant{t}",
+                    "tokens": prefixes[t] + tail,
+                    "max_new_tokens": int(max_new_tokens)})
+    return out
+
+
+def trace_payload_fn(trace: Sequence[dict]) -> Callable[[int], dict]:
+    """Adapter: a replayable trace as the ``make_payload`` argument of
+    :func:`run_load` (wraps around when offered load outruns the trace)."""
+
+    def make_payload(i: int) -> dict:
+        return dict(trace[i % len(trace)])
+
+    return make_payload
+
+
 def run_points(submit: Callable[[dict], dict],
                make_payload: Callable[[int], dict],
                points_qps: Sequence[float],

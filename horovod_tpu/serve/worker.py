@@ -39,7 +39,8 @@ from typing import Optional
 import numpy as np
 
 from horovod_tpu.common import kv_keys
-from horovod_tpu.common.env_registry import (env_int, env_is_set, env_str)
+from horovod_tpu.common.env_registry import (env_bool, env_int, env_is_set,
+                                             env_str)
 from horovod_tpu.common.exceptions import HorovodInternalError
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.serve.batcher import ContinuousBatcher
@@ -108,8 +109,22 @@ class ServeWorker:
                  batcher: Optional[ContinuousBatcher] = None,
                  admission=None):
         from horovod_tpu.serve.admission import controller_from_env
-        self.batcher = batcher or ContinuousBatcher()
-        self.loop = ServingLoop(step_fn or make_toy_step(), self.batcher)
+        if batcher is None:
+            # the serving fast path: a block-paged KV cache owned by the
+            # batcher (admission charges its bounded pool) and, when
+            # HOROVOD_SERVE_SPEC_DECODE is on, draft-model speculative
+            # decoding over the cached toy model
+            from horovod_tpu.serve.kv_cache import PagedKVCache
+            batcher = ContinuousBatcher(cache=PagedKVCache())
+        self.batcher = batcher
+        cached = draft = None
+        if step_fn is None and self.batcher.cache is not None:
+            from horovod_tpu.serve.executor import make_toy_cached_step
+            cached = make_toy_cached_step()
+            if env_bool("HOROVOD_SERVE_SPEC_DECODE"):
+                draft = make_toy_cached_step()
+        self.loop = ServingLoop(step_fn or make_toy_step(), self.batcher,
+                                cached_step=cached, draft_step=draft)
         # SLO-aware admission: priority-class shedding + tenant quotas
         # (env-configured; the defaults are backwards-compatible — an
         # unprioritized request is only ever shed by the full queue)
@@ -178,7 +193,9 @@ def _build_step(model: str, compression: Optional[str]):
             if compression is not None
             else env_str("HOROVOD_SERVE_ACT_COMPRESSION"))
         return step_fn
-    return make_toy_step()
+    # None -> ServeWorker's default stack: the cached toy model behind
+    # the block-paged KV cache (+ speculative decode when enabled)
+    return None
 
 
 def main(argv=None) -> int:

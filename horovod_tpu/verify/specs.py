@@ -954,12 +954,218 @@ class AutoscaleSpec(Spec):
 # Registries
 # ===========================================================================
 
+# ===========================================================================
+# Serving fast path: block-paged KV cache ownership
+# ===========================================================================
+
+class PagedState(NamedTuple):
+    free: int        # unowned pool blocks
+    resident: bool   # the shared prefix block is resident in the pool
+    published: bool  # it was published at least once (sticky — the hash
+    #                  table entry the stale-reuse mutation consults)
+    slots: tuple     # per request slot: (phase, charged, bound, shref)
+    #                  phase: 0 none, 1 queued, 2 running
+    qexp_left: int   # queued-expiry fault budget
+    rexp_left: int   # running-expiry fault budget
+    kills_left: int  # chaos-kill fault budget
+
+
+_PG_NONE, _PG_QUEUED, _PG_RUNNING = 0, 1, 2
+
+
+class PagedCacheSpec(Spec):
+    """Block ownership in ``serve/kv_cache.py``: two request slots over a
+    minimal pool (3 blocks) with one shareable prefix block.
+
+    Every request needs 2 blocks (1 prefix + 1 private tail); admission
+    *charges* the pool (or increfs the resident shared prefix and
+    charges 1), the decode loop *binds* lazily, prefill *publishes* the
+    prefix block as shared CoW (the publisher's private charge converts
+    — conservation holds exactly), and teardown frees at a step
+    boundary. Faults: queued expiry (must release, never having bound),
+    running expiry (frees at the boundary where the partial output
+    returns), a chaos kill mid-decode (the re-route teardown path), an
+    LRU eviction of the zero-ref shared block, and drain. Mutations
+    re-introduce the two seeded hazards: ``double_free_running_expiry``
+    (the boundary teardown frees the charge twice — once at expiry, once
+    again at finish) and ``stale_prefix_reuse`` (admission consults the
+    prefix hash table without checking residency, increfing a block the
+    LRU already evicted — use-after-free)."""
+
+    POOL = 3
+    SLOTS = 2
+
+    def __init__(self, double_free_running_expiry: bool = False,
+                 stale_prefix_reuse: bool = False):
+        super().__init__(name="paged_cache", mutations=tuple(
+            m for m, on in [("double_free_running_expiry",
+                             double_free_running_expiry),
+                            ("stale_prefix_reuse",
+                             stale_prefix_reuse)] if on))
+        self.double_free = double_free_running_expiry
+        self.stale_reuse = stale_prefix_reuse
+        # the model is the minimal pool exhibiting every hazard; the
+        # shipped pool is configured by these registry knobs (defaults
+        # asserted real so the spec can't drift from the code)
+        from horovod_tpu.common.env_registry import REGISTRY
+        assert int(REGISTRY["HOROVOD_SERVE_KV_POOL_BLOCKS"].default) > 0
+        assert int(REGISTRY["HOROVOD_SERVE_KV_BLOCK_TOKENS"].default) > 0
+
+    def initial(self) -> PagedState:
+        return PagedState(
+            free=self.POOL, resident=False, published=False,
+            slots=((_PG_NONE, 0, 0, 0),) * self.SLOTS,
+            qexp_left=1, rexp_left=1, kills_left=1)
+
+    # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _refs(s: PagedState) -> int:
+        return sum(sl[3] for sl in s.slots)
+
+    def _teardown(self, s: PagedState, i: int, label: str,
+                  double: bool = False) -> Tuple[str, PagedState]:
+        ch = s.slots[i][1]
+        freed = ch * (2 if double else 1)
+        return (label, s._replace(
+            free=s.free + freed,
+            slots=_rep(s.slots, i, (_PG_NONE, 0, 0, 0))))
+
+    # -- transitions ----------------------------------------------------------
+
+    def actions(self, s: PagedState):
+        out = []
+        for i, (ph, ch, bd, sh) in enumerate(s.slots):
+            if ph == _PG_NONE:
+                # admission: charge 2 private blocks, or incref the
+                # resident shared prefix and charge 1. The stale-reuse
+                # mutation consults the hash table WITHOUT the residency
+                # check — the entry may point at an evicted block.
+                hit = s.published if self.stale_reuse else s.resident
+                need = 1 if hit else 2
+                if s.free >= need:
+                    tag = " (MUTATION: stale hash entry, block evicted)" \
+                        if hit and not s.resident else \
+                        (" (shared-prefix hit, incref)" if hit else "")
+                    out.append((
+                        f"slot {i}: admit charges {need} block(s)"
+                        f"{tag}",
+                        s._replace(free=s.free - need,
+                                   slots=_rep(s.slots, i,
+                                              (_PG_QUEUED, need, 0,
+                                               1 if hit else 0)))))
+            elif ph == _PG_QUEUED:
+                out.append((
+                    f"slot {i}: scheduled into the batch",
+                    s._replace(slots=_rep(s.slots, i,
+                                          (_PG_RUNNING, ch, bd, sh)))))
+                if s.qexp_left > 0:
+                    out.append(self._teardown(
+                        s._replace(qexp_left=s.qexp_left - 1), i,
+                        f"slot {i}: deadline passes while QUEUED — "
+                        f"release the charge (never bound a block)"))
+            elif ph == _PG_RUNNING:
+                if bd < ch:
+                    # decode step: bind the charged blocks; prefill
+                    # publishes the prefix block as shared CoW (the
+                    # publisher's private charge converts to the shared
+                    # population — pool conservation is exact)
+                    if sh == 0 and not s.resident:
+                        out.append((
+                            f"slot {i}: prefill step binds + PUBLISHES "
+                            f"the prefix block (private -> shared CoW)",
+                            s._replace(
+                                resident=True, published=True,
+                                slots=_rep(s.slots, i,
+                                           (_PG_RUNNING, ch - 1, ch - 1,
+                                            1)))))
+                    else:
+                        out.append((
+                            f"slot {i}: decode step binds {ch} block(s)",
+                            s._replace(slots=_rep(s.slots, i,
+                                                  (_PG_RUNNING, ch, ch,
+                                                   sh)))))
+                else:
+                    out.append(self._teardown(
+                        s, i,
+                        f"slot {i}: completes — frees {ch} charged "
+                        f"block(s) at the step boundary, decref shared"))
+                    if s.rexp_left > 0:
+                        lbl = (f"slot {i}: deadline passes mid-decode — "
+                               f"partial output returned, {ch} block(s) "
+                               f"freed at the step boundary")
+                        if self.double_free:
+                            lbl += (" (MUTATION: freed again at finish "
+                                    "— double free)")
+                        out.append(self._teardown(
+                            s._replace(rexp_left=s.rexp_left - 1), i,
+                            lbl, double=self.double_free))
+                    if s.kills_left > 0:
+                        out.append(self._teardown(
+                            s._replace(kills_left=s.kills_left - 1), i,
+                            f"slot {i}: CHAOS KILL mid-decode — router "
+                            f"re-routes, teardown frees {ch} block(s)"))
+        if s.resident and self._refs(s) == 0:
+            out.append((
+                "LRU evicts the zero-ref shared prefix block",
+                s._replace(resident=False, free=s.free + 1)))
+        active = [i for i, sl in enumerate(s.slots)
+                  if sl[0] != _PG_NONE]
+        if active:
+            ns = s
+            for i in active:
+                _lbl, ns = self._teardown(
+                    ns, i, "")
+            out.append((
+                f"drain: slot(s) {active} finish and free their charges",
+                ns))
+        return out
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        pool = self.POOL
+
+        def conserved(s: PagedState) -> bool:
+            return s.free + sum(sl[1] for sl in s.slots) + \
+                (1 if s.resident else 0) == pool
+
+        return [
+            Invariant(
+                "charge_free_balance",
+                "pool conservation: free + charged(private) + "
+                "resident(shared) == pool at every step boundary — a "
+                "double free (or a leak) breaks the ledger",
+                conserved),
+            Invariant(
+                "no_use_after_free",
+                "no live request holds a reference to an evicted shared "
+                "block (admission must re-check residency, not just the "
+                "hash table)",
+                lambda s: self._refs(s) == 0 or s.resident),
+            Invariant(
+                "queued_never_binds",
+                "a queued request owns charged capacity only — it never "
+                "binds a physical block (the expiry split: queued "
+                "expiry releases, it has nothing to free)",
+                lambda s: all(sl[2] == 0 for sl in s.slots
+                              if sl[0] == _PG_QUEUED)),
+            Invariant(
+                "no_aliasing",
+                "a block has one owner: bound never exceeds charged and "
+                "the free count never goes negative (aliasing between "
+                "live requests shows up as either)",
+                lambda s: s.free >= 0 and
+                all(sl[2] <= sl[1] for sl in s.slots)),
+        ]
+
+
 SPECS: Dict[str, type] = {
     "cycle": CycleSpec,
     "epoch": EpochSpec,
     "drain": DrainSpec,
     "tune": TuneSpec,
     "autoscale": AutoscaleSpec,
+    "paged_cache": PagedCacheSpec,
 }
 
 # mutant name -> (spec name, constructor kwarg, description). Each is a
@@ -1028,6 +1234,16 @@ MUTANTS: Dict[str, Tuple[str, str, str]] = {
         "KV epoch fence removed from autoscale decision writes: after "
         "driver recovery the lingering pre-crash driver applies its "
         "stale decision and the fleet resizes twice for one decision"),
+    "paged_double_free_running_expiry": (
+        "paged_cache", "double_free_running_expiry",
+        "running-expiry teardown frees the request's charged blocks at "
+        "the step boundary AND again at finish: the pool ledger "
+        "over-credits and a later admission aliases live blocks"),
+    "paged_stale_prefix_reuse": (
+        "paged_cache", "stale_prefix_reuse",
+        "admission consults the prefix hash table without re-checking "
+        "residency: it increfs a shared block the LRU already evicted "
+        "and the request decodes from a freed page (use-after-free)"),
 }
 
 

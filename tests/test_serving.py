@@ -760,6 +760,387 @@ def test_kill_rank_mid_load_drains_and_reroutes(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# serving fast path: paged KV cache + prefix reuse + speculative decode
+
+
+def _fast_stack(draft=None, spec_k=None, spec_sync=None, pool_blocks=64,
+                block_tokens=8, **kw):
+    """Fresh toy stack behind a block-paged cache, isolated registry."""
+    from horovod_tpu.serve.executor import make_toy_cached_step
+    from horovod_tpu.serve.kv_cache import PagedKVCache
+    reg = MetricsRegistry()
+    cache = PagedKVCache(block_tokens=block_tokens,
+                         pool_blocks=pool_blocks, registry=reg)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("default_deadline_ms", 2000.0)
+    kw.setdefault("max_len", 128)
+    batcher = ContinuousBatcher(registry=reg, cache=cache, **kw)
+    loop = ServingLoop(make_toy_step(), batcher, registry=reg,
+                       cached_step=make_toy_cached_step(),
+                       draft_step=draft, spec_k=spec_k,
+                       spec_sync=spec_sync)
+    return reg, batcher, loop
+
+
+def test_cached_decode_matches_toy_reference():
+    """The fast path changes the cost model (O(1)/token vs O(L)), never
+    the tokens."""
+    _, batcher, loop = _fast_stack()
+    loop.start()
+    try:
+        reqs = [batcher.submit([i, i + 1, i + 2], max_new_tokens=5)
+                for i in range(3)]
+        for i, r in enumerate(reqs):
+            assert r.wait(10.0) and r.status == "ok"
+            assert r.generated == _toy_reference([i, i + 1, i + 2], 5)
+    finally:
+        loop.stop()
+    assert batcher.cache.balanced()
+
+
+def test_queued_expired_never_allocates_cache_blocks():
+    """Expiry-split regression, queued half: a request that dies in the
+    queue charged capacity but provably never bound a physical block."""
+    from horovod_tpu.metrics import snapshot_value
+    reg, batcher, _ = _fast_stack()  # loop not started: stays queued
+    req = batcher.submit([1, 2, 3], max_new_tokens=4, deadline_ms=10.0)
+    assert req.lease.charged > 0 and req.lease.bound == 0
+    time.sleep(0.05)
+    assert batcher.fill([]) == []  # expired at scheduling time
+    assert req.status == "expired" and req.generated == []
+    assert req.lease.bound == 0  # the invariant release() enforces
+    st = batcher.cache.stats()
+    assert st["free"] == st["pool_blocks"] and batcher.cache.balanced()
+    assert snapshot_value(reg.snapshot(),
+                          "hvd_serve_cache_blocks_used") == 0
+
+
+def test_running_expired_frees_exactly_the_charge():
+    """Expiry-split regression, running half: a mid-generation expiry
+    returns partial output AND its full block charge at that same step
+    boundary."""
+    from horovod_tpu.metrics import snapshot_value
+    from horovod_tpu.serve.executor import CachedStep, make_toy_cached_step
+    base = make_toy_cached_step()
+
+    class Slow(CachedStep):
+        state_dim = base.state_dim
+
+        def advance(self, *a):
+            time.sleep(0.03)
+            return base.advance(*a)
+
+    from horovod_tpu.serve.kv_cache import PagedKVCache
+    reg = MetricsRegistry()
+    cache = PagedKVCache(block_tokens=8, pool_blocks=64, registry=reg)
+    batcher = ContinuousBatcher(max_batch=4, queue_depth=8, max_len=128,
+                                default_deadline_ms=2000.0, registry=reg,
+                                cache=cache)
+    loop = ServingLoop(make_toy_step(), batcher, registry=reg,
+                       cached_step=Slow()).start()
+    try:
+        req = batcher.submit([5, 6], max_new_tokens=32, deadline_ms=120.0)
+        charged = req.lease.charged
+        assert charged == 5  # ceil((2 + 32) / 8): the worst case upfront
+        assert req.wait(10.0) and req.status == "expired"
+        assert 0 < len(req.generated) < 32  # partial output returned
+    finally:
+        loop.stop()
+    assert req.lease.closed and req.lease.charged == 0
+    st = cache.stats()
+    assert st["free"] == st["pool_blocks"], st  # the charge came back
+    assert cache.balanced()
+    assert snapshot_value(reg.snapshot(),
+                          "hvd_serve_cache_blocks_used") == 0
+
+
+def test_cache_churn_1k_requests_no_leak():
+    """1k requests of mixed fate — ok, queued-expired, running-expired,
+    rejected — leave the pool exactly conserved: every non-shared block
+    back in the free list, used gauge == resident shared blocks."""
+    from horovod_tpu.metrics import snapshot_value
+    reg, batcher, loop = _fast_stack(queue_depth=64, pool_blocks=96,
+                                     default_deadline_ms=500.0)
+    loop.start()
+    prefixes = [[t] * 24 for t in (3, 5, 7)]  # 3 shared tenant prompts
+    outcomes = {"submitted": 0, "rejected": 0}
+    reqs = []
+    try:
+        for i in range(1000):
+            tokens = prefixes[i % 3] + [i % 251]
+            ddl = 0.5 if i % 7 == 0 else 500.0  # ~14% expire somewhere
+            try:
+                reqs.append(batcher.submit(tokens, max_new_tokens=4,
+                                           deadline_ms=ddl))
+                outcomes["submitted"] += 1
+            except AdmissionRejected:
+                outcomes["rejected"] += 1
+            if i % 50 == 49:  # let the loop breathe; keeps some bursts
+                for r in reqs[-20:]:
+                    r.wait(5.0)
+        for r in reqs:
+            assert r.wait(10.0), r.status
+    finally:
+        loop.drain(timeout=10.0)
+        loop.stop()
+    assert outcomes["submitted"] >= 900  # the churn actually churned
+    assert all(r.status in ("ok", "expired") for r in reqs)
+    assert any(r.status == "expired" for r in reqs)
+    cache = batcher.cache
+    assert cache.balanced(), cache.stats()
+    st = cache.stats()
+    # nothing private leaked: all non-resident-shared capacity is free
+    assert st["charged"] == 0
+    assert st["free"] + st["shared_resident"] == st["pool_blocks"]
+    snap = reg.snapshot()
+    assert snapshot_value(snap, "hvd_serve_cache_blocks_used") == \
+        st["shared_resident"]
+    # the shared tenant prompts actually got reused
+    assert (snapshot_value(snap, "hvd_serve_cache_reuse_total") or 0) > 0
+
+
+def test_cache_exhaustion_is_admission_backpressure():
+    """A pool too small for the request is a 429 at submit, before the
+    queue — never an OOM later."""
+    from horovod_tpu.metrics import snapshot_value
+    reg, batcher, _ = _fast_stack(pool_blocks=2, block_tokens=8)
+    with pytest.raises(AdmissionRejected, match="exhausted"):
+        batcher.submit(list(range(20)), max_new_tokens=20)  # needs 5
+    snap = reg.snapshot()
+    assert snapshot_value(snap, "hvd_serve_requests_total",
+                          status="rejected") == 1
+    assert snapshot_value(snap, "hvd_serve_cache_exhausted_total") == 1
+    assert batcher.cache.balanced()
+
+
+def test_prefix_reuse_skips_prefill_compute():
+    """Second request with the same prompt resumes from the published
+    checkpoint: hits > 0, prefill tokens saved, and the tokens still
+    match the reference exactly."""
+    from horovod_tpu.metrics import snapshot_value
+    reg, batcher, loop = _fast_stack(block_tokens=8)
+    prompt = [9] * 20  # 2 full blocks + partial
+    loop.start()
+    try:
+        first = batcher.submit(prompt, max_new_tokens=4)
+        assert first.wait(10.0) and first.status == "ok"
+        second = batcher.submit(prompt, max_new_tokens=4)
+        assert second.wait(10.0) and second.status == "ok"
+    finally:
+        loop.stop()
+    assert first.generated == second.generated == \
+        _toy_reference(prompt, 4)
+    snap = reg.snapshot()
+    assert (snapshot_value(snap, "hvd_serve_cache_hits_total") or 0) > 0
+    assert (snapshot_value(
+        snap, "hvd_serve_cache_prefill_tokens_saved_total") or 0) >= 16
+    assert batcher.cache.balanced()
+
+
+def test_spec_decode_token_identical_toy_with_rejects():
+    """Speculative decoding with a deliberately-wrong draft: the reject
+    path engages (accepted < proposed) and the output is still
+    token-identical to the non-speculative greedy reference."""
+    from horovod_tpu.metrics import snapshot_value
+    from horovod_tpu.serve.executor import make_toy_draft_step
+    reg, batcher, loop = _fast_stack(
+        draft=make_toy_draft_step(wrong_every=3), spec_k=4)
+    loop.start()
+    try:
+        reqs = [batcher.submit([i + 1, 2 * i], max_new_tokens=12)
+                for i in range(4)]
+        for i, r in enumerate(reqs):
+            assert r.wait(10.0) and r.status == "ok"
+            assert r.generated == _toy_reference([i + 1, 2 * i], 12)
+    finally:
+        loop.stop()
+    snap = reg.snapshot()
+    proposed = snapshot_value(snap, "hvd_serve_spec_proposed_total")
+    accepted = snapshot_value(snap, "hvd_serve_spec_accepted_total")
+    assert proposed and accepted  # speculation ran and accepted some
+    assert accepted < proposed    # ... and the reject path was exercised
+    assert batcher.cache.balanced()
+
+
+def test_spec_decode_token_identical_rnn_vs_plain_step():
+    """The acceptance pin on a real recurrent LM: cached + speculative
+    greedy decode emits exactly the plain recompute StepFn's tokens."""
+    from horovod_tpu.serve.executor import make_rnn_lm_step
+    from horovod_tpu.serve.kv_cache import PagedKVCache
+    step_fn, cached, draft, _ = make_rnn_lm_step(hidden=32, vocab=64,
+                                                 seed=1)
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8, 9, 7, 9]]
+
+    def decode(fast):
+        reg = MetricsRegistry()
+        cache = PagedKVCache(block_tokens=8, pool_blocks=64,
+                             registry=reg) if fast else None
+        batcher = ContinuousBatcher(max_batch=4, queue_depth=8,
+                                    max_len=64, registry=reg, cache=cache,
+                                    default_deadline_ms=5000.0)
+        loop = ServingLoop(step_fn, batcher, registry=reg,
+                           cached_step=cached if fast else None,
+                           draft_step=draft if fast else None,
+                           spec_k=4).start()
+        try:
+            reqs = [batcher.submit(p, max_new_tokens=10) for p in prompts]
+            for r in reqs:
+                assert r.wait(20.0) and r.status == "ok"
+            return [r.generated for r in reqs]
+        finally:
+            loop.stop()
+
+    assert decode(True) == decode(False)
+
+
+def test_spec_accept_sync_rides_express_lane(monkeypatch):
+    """The accept/reject exchange is 4 bytes per slot — deep under the
+    low-latency threshold — so with serving mode on it takes the express
+    lane on a REAL engine session, never the fusion buffer."""
+    from horovod_tpu.common.reduce_ops import Sum
+    from horovod_tpu.engine.bindings import OP_ALLREDUCE
+    from horovod_tpu.serve.executor import make_toy_draft_step
+    sessions, execs = _eager_group(2, True, monkeypatch)
+    seq = {"n": 0}
+
+    def spec_sync(accepts):
+        buf = np.asarray(accepts, np.float32)
+        assert buf.nbytes <= 4096  # express-lane eligible by size
+        name = f"spec.accept.{seq['n']}"
+        seq["n"] += 1
+        hs = [ex.submit(name, OP_ALLREDUCE, buf.copy(), reduce_op=Sum)
+              for ex in execs]
+        for s, h in zip(sessions, hs):
+            s.wait(h, timeout=30.0)
+        for ex in execs:
+            ex.take_result(name)
+        return accepts
+
+    try:
+        _, batcher, loop = _fast_stack(
+            draft=make_toy_draft_step(wrong_every=3), spec_k=4,
+            spec_sync=spec_sync)
+        loop.start()
+        try:
+            reqs = [batcher.submit([i, i + 2], max_new_tokens=8)
+                    for i in range(3)]
+            for i, r in enumerate(reqs):
+                assert r.wait(20.0) and r.status == "ok"
+                assert r.generated == _toy_reference([i, i + 2], 8)
+        finally:
+            loop.stop()
+        counters = sessions[0].metrics()["counters"]
+    finally:
+        _destroy(sessions)
+    assert seq["n"] > 0  # syncs actually happened
+    assert counters["low_latency_responses"] >= seq["n"]
+    assert counters.get("fused_responses", 0) == 0
+
+
+def test_kill_worker_mid_decode_with_shared_prefixes(tmp_path):
+    """The fast-path incident drill (ISSUE 16 satellite): chaos-kill one
+    of two serve workers mid-decode while shared-prefix requests are in
+    flight. The router re-routes with zero accepted-request loss and the
+    survivor's cache pool accounting still balances."""
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.runner.exec_utils import WorkerProcess
+    from horovod_tpu.serve.loadgen import shared_prefix_trace
+
+    trace = shared_prefix_trace(seed=3, requests=48, tenants=2,
+                                prefix_len=48, tail_len=8,
+                                max_new_tokens=4, vocab=128)
+    injected = {"done": False}
+
+    def spawn(hostname, rank, command, env):
+        env = dict(env)
+        env["PYTHONPATH"] = REPO
+        if rank == 1 and not injected["done"]:
+            injected["done"] = True
+            env["HOROVOD_FAULT_SPEC"] = "control.send:die@frame=800"
+        return WorkerProcess(hostname, rank, command, env)
+
+    driver = ElasticDriver(
+        FixedHostDiscovery({"localhost": 2}), min_np=2, max_np=2,
+        command=[sys.executable, "-m", "horovod_tpu.serve.worker"],
+        extra_env={"HOROVOD_SERVE_PORT": "0", "HOROVOD_CYCLE_TIME": "5",
+                   "JAX_PLATFORMS": "cpu"},
+        spawn_worker=spawn)
+    result = {}
+    runner = threading.Thread(
+        target=lambda: result.update(rc=driver.run(start_timeout=60)),
+        daemon=True)
+    runner.start()
+
+    reg = MetricsRegistry()
+    router = RequestRouter(retry_limit=3, registry=reg)
+    outcomes = {"ok": 0, "other": 0}
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            router.refresh_from_kv(driver._kv.get_json)
+            if len([w for w in router.workers()
+                    if w["state"] == "up"]) >= 2:
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("serve workers never registered")
+
+        def send(worker, payload):
+            return post_json(worker.addr, worker.port, "/v1/generate",
+                             payload, timeout=15.0)
+
+        for i, item in enumerate(trace):
+            router.refresh_from_kv(driver._kv.get_json)
+            payload = {"tokens": item["tokens"],
+                       "max_new_tokens": item["max_new_tokens"],
+                       "deadline_ms": 5000, "id": f"sp{i}"}
+            try:
+                out = router.submit(f"sp{i}", payload, send)
+                outcomes["ok" if out.get("status") == "ok"
+                         else "other"] += 1
+            except NoWorkersError:
+                outcomes["other"] += 1
+            time.sleep(0.15)  # staggered: reuse hits after first publish
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if driver.generation >= 1:
+                router.refresh_from_kv(driver._kv.get_json)
+                up = [w for w in router.workers() if w["state"] == "up"]
+                if len(up) >= 2 and router.generation >= 1:
+                    break
+            time.sleep(0.25)
+        else:
+            pytest.fail(f"no recovery: generation={driver.generation}, "
+                        f"workers={router.workers()}")
+
+        from horovod_tpu.metrics import snapshot_value
+        assert (snapshot_value(reg.snapshot(),
+                               "hvd_serve_lost_total") or 0) == 0
+        assert outcomes["other"] <= 5, outcomes
+        assert outcomes["ok"] >= len(trace) - 5, outcomes
+
+        # the survivors' cache accounting balances, and at least one of
+        # them actually shared prefixes across the in-flight requests
+        stats = []
+        for w in (w for w in router.workers() if w["state"] == "up"):
+            code, st = _http(f"http://{w['addr']}:{w['port']}/stats")
+            assert code == 200
+            stats.append(st["cache"])
+        assert stats and all(s["pool_balanced"] for s in stats), stats
+        assert any(s["reuse"] > 0 for s in stats), stats
+    finally:
+        driver._kv.put_json("serve_stop", {"ts": time.time()})
+        runner.join(timeout=90)
+        if runner.is_alive():
+            driver._shutdown.set()
+            runner.join(timeout=30)
+    assert result.get("rc") == 0, result
+
+
+# ---------------------------------------------------------------------------
 # sustained-load soak (slow)
 
 

@@ -184,6 +184,13 @@ def _serving_registry(ok=20.0, lat_s=0.05, occupancy=4):
         lat.observe(lat_s)
     reg.histogram("hvd_serve_batch_occupancy",
                   buckets=OCCUPANCY_BUCKETS).observe(occupancy)
+    # the fast-path cache family (serve/kv_cache.py) behind the
+    # HIT%/BLOCKS/REUSE columns
+    reg.gauge("hvd_serve_cache_pool_blocks").set(512)
+    reg.gauge("hvd_serve_cache_blocks_used").set(42)
+    reg.counter("hvd_serve_cache_lookups_total").inc(8)
+    reg.counter("hvd_serve_cache_hits_total").inc(6)
+    reg.counter("hvd_serve_cache_reuse_total").inc(14)
     return reg
 
 
@@ -213,6 +220,10 @@ def test_serving_row_extraction(serving_cluster):
     assert 25.0 <= row["p50_ms"] <= 50.0
     assert 25.0 <= row["p99_ms"] <= 50.0
     assert row["qps"] is None  # no previous window (--once)
+    # the cache trio comes straight off the hvd_serve_cache_* family
+    assert row["hit_pct"] == pytest.approx(75.0)  # 6 hits / 8 lookups
+    assert row["blocks"] == "42/512"
+    assert row["reuse"] == 14.0
     # window QPS: 10 more ok requests between refreshes
     prev = row["qps_raw"]
     regs[0].counter("hvd_serve_requests_total", status="ok").inc(10)
@@ -245,8 +256,28 @@ def test_cli_serving_once_smoke(serving_cluster):
         capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, proc.stderr
     assert "QPS" in proc.stdout and "p99ms" in proc.stdout
+    assert "HIT%" in proc.stdout and "BLOCKS" in proc.stdout
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert any(ln.split()[0] == "0" for ln in lines[2:])
+
+
+def test_serving_row_without_cache_metrics_blanks_cache_columns():
+    """A pre-fast-path worker (no PagedKVCache) exports no
+    hvd_serve_cache_* family — the view shows '-' rather than crashing
+    or inventing zeros."""
+    reg = MetricsRegistry()
+    reg.counter("hvd_serve_requests_total", status="ok").inc(5)
+    exporter = MetricsExporter(reg, port=0, labels={"rank": "0"}).start()
+    try:
+        target = {"addr": "127.0.0.1", "port": exporter.port}
+        snap = top.scrape_target(target)
+        row = top.serving_row_from_snapshot(target, snap, None)
+        assert row["hit_pct"] is None
+        assert row["blocks"] is None and row["reuse"] is None
+        line = top.render_serving([row]).splitlines()[-1]
+        assert line.split()[-3:] == ["-", "-", "-"]
+    finally:
+        exporter.stop()
 
 
 def test_cli_subprocess_once_smoke(cluster):
