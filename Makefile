@@ -94,14 +94,16 @@ test:
 # The slow-marked elastic chaos soak (64 simulated ranks: kills,
 # preemption drains, partitions, rejoins — now with driver kills mixed
 # into the event schedule; plus the subprocess drain and driver-recovery
-# acceptances) under a hard wall-clock budget. SOAK_BUDGET is seconds.
+# acceptances, and the 1024-rank tiered-scrape soak whose KV WAL `make
+# conformance` replays) under a hard wall-clock budget. SOAK_BUDGET is
+# seconds.
 SOAK_BUDGET ?= 900
 soak:
 	timeout -k 10 $(SOAK_BUDGET) env JAX_PLATFORMS=cpu \
 	    HOROVOD_SOAK_ARTIFACT_DIR=$(SOAK_ARTIFACTS) \
 	    $(PYTHON) -m pytest \
 	    tests/test_chaos_soak.py tests/test_elastic_recovery.py \
-	    tests/test_control_plane.py \
+	    tests/test_control_plane.py tests/test_telemetry_tier.py \
 	    -q -m slow
 
 clean:

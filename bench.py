@@ -1058,6 +1058,258 @@ def _control_plane_bench():
     return out
 
 
+def _telemetry_bench():
+    """The BENCH ``telemetry`` block (ISSUE 18): the measured win of the
+    tiered scrape plane at 1024 ranks / 32 hosts, and the cost of
+    end-to-end request tracing at three sample rates.
+
+    Method, scrape leg: 1024 live ``MetricsExporter`` endpoints (32
+    fake-worker ranks per host, distinct counters/histograms/gauges per
+    rank) behind 32 real ``HostAggregator`` instances, all announced to
+    a real rendezvous KV exactly the way workers announce themselves.
+    Both paths run the production ``TieredScrape.heartbeat`` — the
+    direct leg with a KV view that hides ``agg_addr`` records (forcing
+    the per-rank fallback, 1024 HTTP GETs), the tiered leg with the
+    full KV (32 ``/agg.json`` GETs). Wall time is the best of 3 beats
+    after a baseline-establishing warm beat. Counter-total fidelity is
+    asserted byte-identical: every counter family summed over all 1024
+    direct ``/metrics.json`` scrapes vs summed over the 32 host
+    aggregates, compared as sorted JSON (the fleet is static, and the
+    fake counters are integer-valued, so float addition order cannot
+    leak in).
+
+    Method, tracing leg: the local continuous-batching stack (real
+    batcher + ServingLoop on the TP LM step) driven closed-loop at
+    sample rates 0 / 0.01 / 1.0 — the ingress mint (``maybe_trace``)
+    plus every downstream span site is on the measured path, exactly
+    as in production. Reported overhead is the p50 delta vs the
+    sample=0 baseline.
+    """
+    import statistics
+    import threading
+    from horovod_tpu.common import kv_keys
+    from horovod_tpu.metrics import MetricsExporter, record_step
+    from horovod_tpu.metrics.aggregator import (HostAggregator,
+                                                TieredScrape,
+                                                counter_totals,
+                                                merge_snapshots)
+    from horovod_tpu.metrics.registry import MetricsRegistry
+    from horovod_tpu.runner.http_kv import KVServer
+
+    try:  # 1024 listening sockets: make sure the FD ceiling clears them
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < 4096:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(4096, hard), hard))
+    except Exception:  # noqa: BLE001 — best effort; default is usually fine
+        pass
+
+    n_hosts, per_host = 32, 32
+    n_ranks = n_hosts * per_host
+    kv = KVServer(port=0).start()
+    exporters, aggregators = [], []
+    slots = []
+    out = {"fleet": {"hosts": n_hosts, "ranks_per_host": per_host,
+                     "ranks": n_ranks}}
+    try:
+        for h in range(n_hosts):
+            host = f"host{h:02d}"
+            targets = []
+            for lr in range(per_host):
+                rank = h * per_host + lr
+                reg = MetricsRegistry()
+                record_step("jax", 0.05 + 0.001 * (rank % 16),
+                            registry=reg)
+                # integer-valued counters: the byte-identity check must
+                # not hinge on float addition order
+                reg.counter("hvd_step_anomaly_total").inc(rank % 3)
+                reg.counter("hvd_engine_responses_total").inc(10 + rank)
+                reg.gauge("hvd_engine_queue_depth").set(lr % 4)
+                e = MetricsExporter(reg, port=0,
+                                    labels={"rank": str(rank)}).start()
+                exporters.append(e)
+                # hvd-lint: disable=HVL008 — worker-shaped announce
+                kv.put_json(kv_keys.metrics_addr(host, lr),
+                            {"addr": "127.0.0.1", "port": e.port,
+                             "rank": rank})
+                targets.append({"rank": rank, "local_rank": lr,
+                                "addr": "127.0.0.1", "port": e.port})
+                slots.append((host, lr))
+            agg = HostAggregator(targets, host=host)
+            agg.refresh()  # synchronous pass: deterministic, no thread
+            aggregators.append(agg)
+            # production hosting: local_rank 0's exporter serves /agg.json
+            exporters[h * per_host].aggregator = agg
+            # hvd-lint: disable=HVL008 — worker-shaped announce
+            kv.put_json(kv_keys.agg_addr(host),
+                        {"addr": "127.0.0.1",
+                         "port": exporters[h * per_host].port,
+                         "host": host, "local_size": per_host})
+
+        def hide_agg(key):
+            m = kv_keys.match(key)
+            if m is not None and m[0] == "agg_addr":
+                return None  # aggregator tier invisible: direct fallback
+            return kv.get_json(key)
+
+        def beat_wall(scrape, reps=3):
+            prev_m, prev_a = {}, {}
+            scrape.heartbeat(slots, prev_m, prev_a)  # establish baselines
+            best, result = float("inf"), None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                result = scrape.heartbeat(slots, prev_m, prev_a)
+                best = min(best, time.perf_counter() - t0)
+            return best, result
+
+        direct_wall, direct_res = beat_wall(TieredScrape(hide_agg))
+        # fleet setup takes longer than HOROVOD_AGG_STALE_SECONDS; in
+        # production the background loop refreshes every second — one
+        # synchronous pass stands in for it right before the tiered leg
+        for agg in aggregators:
+            agg.refresh()
+        tiered_wall, tiered_res = beat_wall(TieredScrape(kv.get_json))
+        assert len(direct_res.fallback_hosts) == n_hosts
+        assert len(tiered_res.agg_hosts) == n_hosts
+
+        # counter-total fidelity on the static fleet: all-rank direct
+        # merge vs merge of the 32 host aggregates, byte-compared
+        import urllib.request
+        direct_snaps = []
+        for e in exporters:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{e.port}/metrics.json",
+                    timeout=2.0) as resp:
+                snap = json.loads(resp.read())
+            direct_snaps.append((int(snap["labels"]["rank"]), snap))
+        totals_direct = counter_totals(merge_snapshots(direct_snaps))
+        totals_tiered = counter_totals(merge_snapshots(
+            [(h, aggregators[h].payload()["merged"])
+             for h in range(n_hosts)]))
+        bytes_direct = json.dumps(totals_direct, sort_keys=True)
+        bytes_tiered = json.dumps(totals_tiered, sort_keys=True)
+        assert bytes_direct == bytes_tiered, \
+            "tiered counter totals diverged from the direct scrape"
+
+        ratio = tiered_wall / direct_wall if direct_wall > 0 else None
+        out["scrape"] = {
+            "direct_wall_seconds": round(direct_wall, 4),
+            "tiered_wall_seconds": round(tiered_wall, 4),
+            "tiered_vs_direct_ratio": round(ratio, 4),
+            "ratio_bound": 0.25,
+            "ratio_pass": bool(ratio is not None and ratio <= 0.25),
+            "http_gets_direct": n_ranks,
+            "http_gets_tiered": n_hosts,
+            "counter_totals_byte_identical": True,
+            "counter_families": len(totals_direct),
+        }
+    finally:
+        kv.stop()
+        for agg in aggregators:
+            agg.stop()
+        stoppers = [threading.Thread(target=e.stop) for e in exporters]
+        for t in stoppers:
+            t.start()
+        for t in stoppers:
+            t.join(timeout=10)
+
+    # -- tracing overhead leg ------------------------------------------------
+    from horovod_tpu.metrics.registry import MetricsRegistry as _Reg
+    from horovod_tpu.obs import tracing
+    from horovod_tpu.serve import (ContinuousBatcher, ServingLoop,
+                                   make_tp_lm_step)
+
+    step_fn, info = make_tp_lm_step(compression="none", vocab=256,
+                                    hidden=64, mlp_dim=256, layers=2)
+    reg = _Reg()
+    batcher = ContinuousBatcher(max_batch=8, queue_depth=32,
+                                default_deadline_ms=5000.0, max_len=128,
+                                registry=reg)
+    loop = ServingLoop(step_fn, batcher, registry=reg).start()
+    tokens = [(7 * j) % 251 for j in range(16)]
+
+    tracer_off = tracing.Tracer(sample=0.0)
+
+    def run_one(tracer):
+        tid = tracer.maybe_trace()  # the ingress mint, on-path
+        t0 = time.perf_counter()
+        req = batcher.submit(list(tokens), max_new_tokens=4, trace=tid)
+        req.wait(10.0)
+        req.result()
+        return time.perf_counter() - t0
+
+    def run_paired(n_pairs, tracer_on):
+        # Alternate baseline/sampled requests within ONE steady-state
+        # stream: both classes see the identical process conditions, so
+        # the median difference isolates the tracing cost rather than
+        # cross-block drift (which dwarfs a ~1% signal on a shared box).
+        base, on = [], []
+        for i in range(n_pairs * 2):
+            if i % 2:
+                on.append(run_one(tracer_on))
+            else:
+                base.append(run_one(tracer_off))
+        return base, on
+
+    def p50_p99(lats):
+        return (statistics.median(lats) * 1e3,
+                sorted(lats)[int(0.99 * len(lats))] * 1e3)
+
+    rates = {}
+    try:
+        tracing.configure(sample=0.0)
+        for _ in range(40):  # warm compiles + steady-state batcher
+            run_one(tracer_off)
+        base_lats = [run_one(tracer_off) for _ in range(300)]
+        p50, p99 = p50_p99(base_lats)
+        rates["0.0"] = {"p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+                        "spans_recorded": 0}
+        for rate in (0.01, 1.0):
+            tracer = tracing.configure(sample=rate,
+                                       buffer_spans=1 << 15)
+            paired_base, lats = run_paired(300, tracer)
+            p50, p99 = p50_p99(lats)
+            base_p50, _ = p50_p99(paired_base)
+            spans = tracer.spans()
+            entry = {
+                "p50_ms": round(p50, 3),
+                "p99_ms": round(p99, 3),
+                "spans_recorded": len(spans),
+                "p50_overhead_pct": round(
+                    100.0 * (p50 - base_p50) / base_p50, 2),
+            }
+            if rate == 1.0:
+                entry["span_kinds"] = sorted({s["name"] for s in spans})
+            rates[str(rate)] = entry
+    finally:
+        loop.drain(timeout=10.0)
+        loop.stop()
+        tracing.configure()  # back to env-configured defaults
+
+    out["tracing"] = {
+        "requests_per_rate": 300,
+        "rates": rates,
+        "overhead_bound_pct_at_1pct": 1.0,
+        "overhead_pass": bool(
+            rates["0.01"]["p50_overhead_pct"] < 1.0),
+    }
+    out["method"] = (
+        "scrape: 1024 live exporter endpoints (32 ranks x 32 hosts, "
+        "integer-valued fake counters) behind 32 real HostAggregators, "
+        "announced to a real rendezvous KV; both legs run the production "
+        "TieredScrape.heartbeat — direct with agg_addr records hidden "
+        "(1024 /metrics.json GETs), tiered with the full KV (32 "
+        "/agg.json GETs); best of 3 beats after a warm beat; counter "
+        "totals byte-compared as sorted JSON over all families. "
+        "tracing: closed-loop requests through the real batcher + "
+        "ServingLoop with the ingress sampling mint on-path; per rate, "
+        "300 sampled requests interleaved 1:1 with 300 sample=0 "
+        "baseline requests in one stream (paired medians cancel "
+        "cross-block drift); p50 delta vs the in-stream baseline")
+    return out
+
+
 def _autoscale_bench():
     """The BENCH ``autoscale`` block: the full closed loop from offered
     load to fleet size (serve/autoscale_smoke.py — real Autoscaler, real
@@ -1664,5 +1916,11 @@ if __name__ == "__main__":
         # no TPU needed.
         print(json.dumps({"metric": "autoscale",
                           "autoscale": _autoscale_bench()}))
+    elif "--telemetry-only" in sys.argv:
+        # Refresh just the telemetry block (tiered scrape at 1024
+        # ranks / 32 hosts + request-tracing overhead sweep); one JSON
+        # line, no TPU needed.
+        print(json.dumps({"metric": "telemetry",
+                          "telemetry": _telemetry_bench()}))
     else:
         main()

@@ -286,6 +286,15 @@ _decl("HOROVOD_STRAGGLER_STDDEVS", "float", 3.0,
       "leave-one-out skew threshold k for straggler flagging")
 _decl("HOROVOD_STRAGGLER_WINDOWS", "int", 3,
       "consecutive skewed windows before a rank is flagged")
+_decl("HOROVOD_METRICS_AGG", "bool", True,
+      "per-host telemetry aggregation: local_rank 0's exporter scrapes "
+      "co-located ranks and serves /agg.json so the driver and hvd-top "
+      "scale O(hosts), not O(ranks) (0 = per-rank scrapes only)")
+_decl("HOROVOD_AGG_INTERVAL_SECONDS", "float", 1.0,
+      "refresh cadence of the per-host aggregator's co-located scrape")
+_decl("HOROVOD_AGG_STALE_SECONDS", "float", 10.0,
+      "max /agg.json age before the driver falls back to direct per-rank "
+      "scrape for that host (also the hvd-top STALE marker bound)")
 
 # -- step-time attribution / hvd-top --
 _decl("HOROVOD_STEP_ATTRIBUTION", "bool", True,
@@ -301,6 +310,22 @@ _decl("HOROVOD_ATTRIBUTION_EVERY", "int", 10,
       "decomposition gauge export cadence; 0 = frontend timing only)")
 _decl("HOROVOD_TOP_INTERVAL", "float", 2.0,
       "hvd-top live-view refresh interval in seconds")
+_decl("HOROVOD_TOP_ROLLUP_RANKS", "int", 64,
+      "fleet size above which hvd-top defaults to host-rollup rows "
+      "(per-host p99/EXP%/STALL% aggregates; --rank <r> drills down, "
+      "--no-rollup forces per-rank rows)")
+
+# -- distributed request tracing (serving plane) --
+_decl("HOROVOD_TRACE_SAMPLE", "float", 0.0,
+      "fraction of served requests traced end to end (0 = off, 1 = every "
+      "request); a sampled request's trace id is echoed in the HTTP "
+      "response and its spans export as one Perfetto timeline")
+_decl("HOROVOD_TRACE_DIR", "str", None,
+      "directory where completed sampled request traces are written as "
+      "trace_<id>.json (unset = spans buffer in memory only)")
+_decl("HOROVOD_TRACE_BUFFER_SPANS", "int", 8192,
+      "in-memory span ring capacity per process (oldest spans drop "
+      "first; sized for hundreds of concurrent sampled requests)")
 
 # -- flight recorder / post-mortem --
 _decl("HOROVOD_FLIGHT_RECORDER_SIZE", "int", 2048,

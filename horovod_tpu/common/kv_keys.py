@@ -94,6 +94,9 @@ _decl("rank_and_size", "rank_and_size/g<gen>/<host>/<local_rank>", "driver",
       True, "per-slot topology record for one generation")
 _decl("metrics_targets", "metrics_targets", "driver", True,
       "aggregated worker /metrics endpoints (hvd-top discovery)")
+_decl("agg_targets", "agg_targets", "driver", True,
+      "live per-host aggregator /agg.json endpoints (the tiered-scrape "
+      "discovery table: hvd-top host rollups and O(hosts) heartbeats)")
 _decl("serve_targets", "serve_targets", "driver", True,
       "aggregated serving endpoints (router discovery)")
 _decl("straggler", "straggler/g<gen>/<rank>", "driver", True,
@@ -114,6 +117,9 @@ _decl("reset_request", "reset_request/g<gen>", "worker", False,
       "worker request for a fresh rendezvous round past a dead generation")
 _decl("metrics_addr", "metrics_addr/<host>/<local_rank>", "worker", False,
       "worker /metrics endpoint publication (driver aggregates)")
+_decl("agg_addr", "agg_addr/<host>", "worker", False,
+      "per-host aggregator /agg.json endpoint (published by local_rank "
+      "0's exporter; the driver prefers it over per-rank scrapes)")
 
 # -- serving plane ----------------------------------------------------------
 _decl("serve_addr", "serve_addr/<host>/<local_rank>", "serve-worker", False,
@@ -235,6 +241,14 @@ def serve_stop() -> str:
 
 def metrics_addr(host, local_rank) -> str:
     return f"metrics_addr/{host}/{local_rank}"
+
+
+def agg_addr(host) -> str:
+    return f"agg_addr/{host}"
+
+
+def agg_targets() -> str:
+    return "agg_targets"
 
 
 def autoscale_decision() -> str:

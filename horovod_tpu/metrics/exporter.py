@@ -6,7 +6,11 @@ ThreadingHTTPServer, port 0 for ephemeral binding in tests. Two routes:
 - ``GET /metrics``       — Prometheus text format (scrape target);
 - ``GET /metrics.json``  — the registry's JSON snapshot (what the elastic
   driver polls on its heartbeat for straggler detection — structured,
-  so the driver doesn't re-parse the text format).
+  so the driver doesn't re-parse the text format);
+- ``GET /agg.json``      — the per-host aggregate (local_rank 0 only,
+  when ``HOROVOD_METRICS_AGG`` is on): co-located ranks' snapshots
+  merged by :mod:`horovod_tpu.metrics.aggregator`, the driver's
+  preferred O(hosts) scrape target. 404 on ranks without an aggregator.
 
 Off by default: nothing binds unless ``HOROVOD_METRICS_PORT`` is set (see
 ``start_exporter_from_env``). Multiple workers per host offset the base
@@ -32,9 +36,11 @@ class MetricsExporter:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  port: int = 0, addr: str = "0.0.0.0",
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 aggregator=None):
         self.registry = registry if registry is not None else get_registry()
         self.labels = dict(labels or {})
+        self.aggregator = aggregator
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -51,6 +57,17 @@ class MetricsExporter:
                     snap = exporter.registry.snapshot()
                     snap["labels"] = exporter.labels
                     body = json.dumps(snap).encode()
+                    ctype = "application/json"
+                elif path == "/agg.json" and exporter.aggregator is not None:
+                    payload = exporter.aggregator.payload()
+                    if payload is None:
+                        # no aggregation pass has completed yet: 503 so
+                        # the driver falls back to direct scrape instead
+                        # of consuming an empty window as "no ranks"
+                        self.send_response(503)
+                        self.end_headers()
+                        return
+                    body = json.dumps(payload).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)
@@ -73,6 +90,8 @@ class MetricsExporter:
         return self
 
     def stop(self):
+        if self.aggregator is not None:
+            self.aggregator.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
@@ -125,6 +144,8 @@ def start_exporter_from_env(registry: Optional[MetricsRegistry] = None,
         return None
     log.info("metrics endpoint on :%d/metrics", exporter.port)
     _publish_endpoint(exporter, log)
+    if local_rank == 0:
+        _start_host_aggregator(exporter, base, log)
     return exporter
 
 
@@ -147,3 +168,64 @@ def _publish_endpoint(exporter: MetricsExporter, log):
             timeout=5.0)
     except Exception as e:  # noqa: BLE001 — best-effort publication
         log.warning("could not publish metrics endpoint: %s", e)
+
+
+def _start_host_aggregator(exporter: MetricsExporter, base_port: int, log):
+    """local_rank 0 hosts the per-host aggregation tier: a background
+    scrape of every co-located rank's /metrics.json, served as /agg.json
+    on this exporter and announced under ``agg_addr/<host>`` so the
+    driver heartbeat scales O(hosts). Best-effort throughout — telemetry
+    aggregation must never take down training."""
+    from horovod_tpu.common.env_registry import env_bool
+    if not env_bool("HOROVOD_METRICS_AGG"):
+        return
+    try:
+        from horovod_tpu.common import kv_keys
+        from horovod_tpu.metrics.aggregator import HostAggregator
+        host = env_str("HOROVOD_HOSTNAME", socket.gethostname())
+        local_size = max(1, env_int("HOROVOD_LOCAL_SIZE", 1))
+        kv_addr = env_str("HOROVOD_RENDEZVOUS_ADDR")
+        kv_port = env_int("HOROVOD_RENDEZVOUS_PORT")
+
+        def discover():
+            # KV-published endpoints first (elastic jobs; survives
+            # ephemeral ports), base-port arithmetic otherwise
+            targets = []
+            if kv_addr and kv_port:
+                from horovod_tpu.runner.http_kv import KVClient
+                client = KVClient(kv_addr, kv_port)
+                for lr in range(local_size):
+                    try:
+                        info = client.get_json(
+                            kv_keys.metrics_addr(host, lr), timeout=1.0)
+                    except Exception:  # noqa: BLE001 — KV blip
+                        info = None
+                    if isinstance(info, dict) and info.get("port"):
+                        targets.append({"rank": info.get("rank", lr),
+                                        "local_rank": lr,
+                                        "addr": "127.0.0.1",
+                                        "port": info["port"],
+                                        "host": host})
+                if targets:
+                    return targets
+            if base_port > 0:
+                return [{"rank": lr, "local_rank": lr,
+                         "addr": "127.0.0.1", "port": base_port + lr,
+                         "host": host} for lr in range(local_size)]
+            return [{"rank": env_int("HOROVOD_RANK"), "local_rank": 0,
+                     "addr": "127.0.0.1", "port": exporter.port,
+                     "host": host}]
+
+        exporter.aggregator = HostAggregator(discover, host=host).start()
+        log.info("per-host aggregator serving /agg.json on :%d",
+                 exporter.port)
+        if kv_addr and kv_port:
+            from horovod_tpu.runner.http_kv import KVClient
+            scrape_addr = "127.0.0.1" if host == "localhost" else host
+            KVClient(kv_addr, kv_port).put_json(
+                kv_keys.agg_addr(host),
+                {"addr": scrape_addr, "port": exporter.port, "host": host,
+                 "local_size": local_size},
+                timeout=5.0)
+    except Exception as e:  # noqa: BLE001 — aggregation is optional
+        log.warning("could not start host aggregator: %s", e)

@@ -44,10 +44,26 @@ rendezvous env points at the KV), then per-rank queue depth, in-flight,
 p99, SLO headroom (the policy's own :func:`slo_headroom` formula) and
 the admission plane's per-class admit/shed counters.
 
+**Host rollup (the 1024-rank view, ISSUE 18):** when the fleet exceeds
+``HOROVOD_TOP_ROLLUP_RANKS`` ranks and the KV publishes ``agg_targets``
+(the per-host aggregator endpoints of the tiered telemetry plane), the
+default view scrapes H ``/agg.json`` endpoints instead of N
+``/metrics.json`` ones and renders one row per host: rank count, window
+step mean AND p99 (from the host-merged step histogram), mean EXP% /
+STALL% over the per-rank gauge vectors, summed queue depth and anomaly
+total, the aggregator's own scrape-error count, and the payload age —
+age-marked ``!`` plus a ``STALE DATA`` banner past
+``HOROVOD_AGG_STALE_SECONDS`` (the same bound the driver's fallback
+uses). ``--rollup`` forces the host view below the threshold,
+``--no-rollup`` forces per-rank rows above it, and ``--rank <r>``
+drills down to the per-rank view of one rank, resolved through the
+aggregator tier's per-rank vectors (no O(N) scrape).
+
 CLI::
 
     hvd-top --targets 127.0.0.1:9090,127.0.0.1:9091
     hvd-top --serving --kv 127.0.0.1:8888
+    hvd-top --kv 127.0.0.1:8888 --rank 371
     python -m horovod_tpu.obs.top --once --targets 127.0.0.1:9090
 """
 
@@ -106,6 +122,19 @@ AUTOSCALE_COLUMNS = ("RANK", "QD", "INFL", "p99ms", "HEADRM", "ADM",
                      "SHED", "QUOTA")
 _AUTOSCALE_FMT = "{:>5} {:>5} {:>5} {:>8} {:>7} {:>8} {:>7} {:>6}"
 
+# Host-rollup view: one row per host from its aggregator's /agg.json —
+# the O(hosts) rendering the tiered telemetry plane exists for. STEP ms
+# is the window mean of the host-merged step histogram, p99 its
+# interpolated quantile (the merge is bucket-wise, so the host p99 is a
+# real cross-rank quantile, not a mean of means); EXP%/STALL% average
+# the per-rank gauge vectors; QD/ANOM sum; ERR is the aggregator's own
+# scrape-error count for the window; AGE the payload age, "!"-marked
+# past the staleness bound.
+ROLLUP_COLUMNS = ("HOST", "RANKS", "STEP ms", "p99 ms", "EXP%", "STALL%",
+                  "QD", "ANOM", "ERR", "AGE s")
+_ROLLUP_FMT = ("{:>12} {:>5} {:>9} {:>9} {:>6} {:>7} {:>5} {:>5} {:>4} "
+               "{:>7}")
+
 
 def _parse_hostports(arg: str) -> List[dict]:
     out = []
@@ -158,6 +187,64 @@ def discover_targets(args) -> List[dict]:
             return [{"addr": "127.0.0.1", "port": base + lr}
                     for lr in range(max(1, env_int("HOROVOD_LOCAL_SIZE")))]
     return []
+
+
+def discover_agg_targets(args) -> List[dict]:
+    """Per-host aggregator endpoints ``[{host, addr, port, ...}]`` from
+    the KV's ``agg_targets`` record (published by the elastic driver
+    every heartbeat for hosts consumed via the tier). Empty when no KV
+    is reachable or the tier is off — callers fall back to per-rank
+    targets."""
+    kv = _kv_coords(args)
+    if kv is None:
+        return []
+    from horovod_tpu.common import kv_keys
+    from horovod_tpu.runner.http_kv import KVClient
+    record = KVClient(*kv).get_json(kv_keys.agg_targets(), timeout=3.0)
+    if not isinstance(record, dict):
+        return []
+    return [h for h in record.get("hosts", []) if isinstance(h, dict)
+            and h.get("addr") and h.get("port")]
+
+
+def scrape_agg(target: dict, timeout: float = 2.0) -> Optional[dict]:
+    """One host aggregator's /agg.json payload, or None (a dead
+    aggregator must not take down the rollup — its host just shows as
+    unreachable while the driver's fallback covers its ranks)."""
+    from urllib import error as urlerror
+    from urllib import request as urlrequest
+    url = f"http://{target['addr']}:{target['port']}/agg.json"
+    try:
+        with urlrequest.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urlerror.URLError, ConnectionError, OSError, ValueError):
+        return None
+
+
+def resolve_rank_target(agg_targets: List[dict], targets: List[dict],
+                        rank: int) -> Optional[dict]:
+    """--rank drill-down: one rank's direct /metrics.json endpoint,
+    resolved from the aggregator tier's per-rank vectors (each carries
+    the rank's addr/port) — O(hosts), not O(ranks) — falling back to a
+    rank-labelled entry in the per-rank target list."""
+    for agg in agg_targets:
+        payload = scrape_agg(agg)
+        if payload is None:
+            continue
+        for vec in payload.get("ranks", {}).values():
+            if not isinstance(vec, dict) or vec.get("rank") != rank:
+                continue
+            addr = vec.get("addr")
+            if addr in (None, "", "127.0.0.1", "localhost"):
+                # the aggregator scraped loopback; reach the rank
+                # through its host's externally visible address
+                addr = agg["addr"]
+            if vec.get("port"):
+                return {"addr": addr, "port": vec["port"], "rank": rank}
+    for t in targets:
+        if t.get("rank") == rank:
+            return t
+    return None
 
 
 def scrape_target(target: dict, timeout: float = 1.0) -> Optional[dict]:
@@ -443,6 +530,86 @@ def _fmt(v, pattern="{:.1f}") -> str:
     return pattern.format(v) if v is not None else "-"
 
 
+def _gauge_mean(merged: dict, name: str) -> Optional[float]:
+    """Mean over a merged snapshot's per-rank gauge vector (the
+    aggregator keeps gauges as one sample per rank — a mean is the only
+    host-level reading that makes sense for ratios)."""
+    values = []
+    for m in merged.get("metrics", []):
+        if m.get("name") != name:
+            continue
+        values.extend(float(s["value"]) for s in m.get("samples", [])
+                      if "value" in s)
+    return sum(values) / len(values) if values else None
+
+
+def host_row_from_agg(target: dict, payload: dict,
+                      prev_steps: Optional[Tuple[int, float]],
+                      stale_after: float) -> dict:
+    """One host-rollup row from an /agg.json payload. ``prev_steps`` is
+    the host-merged step histogram's (count, sum) at the previous
+    refresh; None (--once) shows the lifetime mean."""
+    from horovod_tpu.metrics import histogram_quantile, snapshot_histogram
+    merged = payload.get("merged", {})
+    stats = step_stats(merged)
+    step_ms = None
+    if stats is not None:
+        count, total = stats
+        if prev_steps is not None and count > prev_steps[0]:
+            step_ms = 1e3 * (total - prev_steps[1]) / (count - prev_steps[0])
+        elif prev_steps is None and count:
+            step_ms = 1e3 * total / count
+    hist = snapshot_histogram(merged, STEP_SECONDS)
+    p99 = histogram_quantile(hist, 0.99) if hist else None
+    exp = _gauge_mean(merged, "hvd_step_exposed_comm_ratio")
+    stall = _gauge_mean(merged, "hvd_step_stall_seconds")
+    step_last = _gauge_mean(merged, "hvd_step_seconds_last")
+    qd = snapshot_value(merged, "hvd_engine_queue_depth")
+    age = payload.get("age_seconds")
+    return {
+        "host": payload.get("host") or target.get("host") or target["addr"],
+        "ranks": len(payload.get("ranks", {})),
+        "step_ms": step_ms,
+        "p99_ms": p99 * 1e3 if p99 is not None else None,
+        "exposed_pct": 100.0 * exp if exp is not None else None,
+        "stall_pct": (100.0 * stall / step_last
+                      if stall is not None and step_last else None),
+        "queue_depth": qd,
+        "anomalies": snapshot_value(merged, "hvd_step_anomaly_total") or 0.0,
+        "scrape_errors": payload.get("scrape_errors"),
+        "age_seconds": age,
+        "stale": age is not None and float(age) > stale_after,
+        "steps_raw": stats,
+    }
+
+
+def render_rollup(rows: List[dict], unreachable: int = 0,
+                  title: str = "", stale_after: float = 0.0) -> str:
+    lines = []
+    if title:
+        lines.append(title)
+    lines.append(_ROLLUP_FMT.format(*ROLLUP_COLUMNS))
+    stale = 0
+    for r in rows:
+        stale += 1 if r["stale"] else 0
+        age = _fmt(r["age_seconds"], "{:.1f}")
+        lines.append(_ROLLUP_FMT.format(
+            r["host"][:12], r["ranks"],
+            _fmt(r["step_ms"], "{:.2f}"), _fmt(r["p99_ms"], "{:.2f}"),
+            _fmt(r["exposed_pct"]), _fmt(r["stall_pct"]),
+            _fmt(r["queue_depth"], "{:.0f}"),
+            _fmt(r["anomalies"], "{:.0f}"),
+            _fmt(r["scrape_errors"], "{:.0f}"),
+            age + ("!" if r["stale"] else "")))
+    if stale:
+        lines.append(f"*** STALE DATA: {stale} aggregator(s) older than "
+                     f"{stale_after:.0f}s (rows marked '!') — the driver "
+                     f"is direct-scraping those hosts ***")
+    if unreachable:
+        lines.append(f"({unreachable} aggregator(s) unreachable)")
+    return "\n".join(lines)
+
+
 def render(rows: List[dict], unreachable: int = 0,
            title: str = "") -> str:
     """The table, straggler scores filled in from the rows' window step
@@ -480,18 +647,51 @@ class TopState:
 
     def __init__(self, targets: List[dict], serving: bool = False,
                  tune: bool = False, autoscale: bool = False,
-                 kv: Optional[Tuple[str, int]] = None):
+                 kv: Optional[Tuple[str, int]] = None,
+                 rollup: bool = False):
         self.targets = targets
         self.serving = serving
         self.tune = tune
         self.autoscale = autoscale
+        self.rollup = rollup
+        self.stale_after = env_float("HOROVOD_AGG_STALE_SECONDS")
         self._kv = kv
         self._prev: Dict[int, Tuple] = {}
         self._last_rows: List[dict] = []
         self._last_scrape: Optional[float] = None  # monotonic
         self.stale_age_seconds: Optional[float] = None
 
+    def _refresh_rollup(self, window: bool) -> Tuple[List[dict], int]:
+        """Host-rollup pass: H /agg.json scrapes instead of N
+        /metrics.json ones (``self.targets`` holds aggregator
+        endpoints)."""
+        rows, unreachable = [], 0
+        for i, t in enumerate(self.targets):
+            payload = scrape_agg(t)
+            if payload is None:
+                unreachable += 1
+                continue
+            row = host_row_from_agg(
+                t, payload, self._prev.get(i) if window else None,
+                self.stale_after)
+            if row["steps_raw"] is not None:
+                self._prev[i] = row["steps_raw"]
+            rows.append(row)
+        rows.sort(key=lambda r: r["host"])
+        return rows, unreachable
+
     def refresh(self, window: bool = True) -> Tuple[List[dict], int]:
+        if self.rollup:
+            rows, unreachable = self._refresh_rollup(window)
+            if rows:
+                self._last_rows = rows
+                self._last_scrape = time.monotonic()
+                self.stale_age_seconds = None
+            elif self._last_scrape is not None:
+                self.stale_age_seconds = \
+                    time.monotonic() - self._last_scrape
+                return list(self._last_rows), unreachable
+            return rows, unreachable
         rows, unreachable = [], 0
         for i, t in enumerate(self.targets):
             snap = scrape_target(t)
@@ -539,7 +739,10 @@ class TopState:
 
     def render(self, rows: List[dict], unreachable: int,
                title: str) -> str:
-        if self.autoscale:
+        if self.rollup:
+            text = render_rollup(rows, unreachable, title,
+                                 stale_after=self.stale_after)
+        elif self.autoscale:
             text = render_autoscale(rows, unreachable, title,
                                     status=self.autoscale_status())
         elif self.tune:
@@ -556,9 +759,14 @@ class TopState:
         return text
 
 
-def _title(n_rows: int, n_targets: int) -> str:
+def _title(n_rows: int, n_targets: int, unit: str = "ranks") -> str:
     return (f"hvd-top  {time.strftime('%H:%M:%S')}  "
-            f"{n_rows}/{n_targets} ranks reporting  (q to quit)")
+            f"{n_rows}/{n_targets} {unit} reporting  (q to quit)")
+
+
+def _state_title(state: TopState, n_rows: int) -> str:
+    return _title(n_rows, len(state.targets),
+                  "hosts" if state.rollup else "ranks")
 
 
 def _loop_plain(state: TopState, interval: float):
@@ -566,7 +774,7 @@ def _loop_plain(state: TopState, interval: float):
         rows, unreachable = state.refresh()
         sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty() else "")
         print(state.render(rows, unreachable,
-                           _title(len(rows), len(state.targets))))
+                           _state_title(state, len(rows))))
         sys.stdout.flush()
         time.sleep(interval)
 
@@ -579,7 +787,7 @@ def _loop_curses(scr, state: TopState, interval: float):
         rows, unreachable = state.refresh()
         scr.erase()
         text = state.render(rows, unreachable,
-                            _title(len(rows), len(state.targets)))
+                            _state_title(state, len(rows)))
         maxy, maxx = scr.getmaxyx()
         for y, line in enumerate(text.splitlines()[:maxy - 1]):
             scr.addnstr(y, 0, line, maxx - 1)
@@ -619,10 +827,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(KV autoscale/decision record), per-rank "
                              "SLO headroom, per-class admit/shed "
                              "counters")
+    parser.add_argument("--rollup", action="store_true",
+                        help="force the per-host aggregator rollup view "
+                             "even below HOROVOD_TOP_ROLLUP_RANKS")
+    parser.add_argument("--no-rollup", action="store_true",
+                        help="force per-rank rows even above "
+                             "HOROVOD_TOP_ROLLUP_RANKS")
+    parser.add_argument("--rank", type=int, default=None,
+                        help="drill down to one rank's per-rank row, "
+                             "resolved through the aggregator tier")
     args = parser.parse_args(argv)
     if sum((args.serving, args.tune, args.autoscale)) > 1:
         print("hvd-top: --serving, --tune and --autoscale are mutually "
               "exclusive", file=sys.stderr)
+        return 2
+    if args.rollup and args.no_rollup:
+        print("hvd-top: --rollup and --no-rollup are mutually exclusive",
+              file=sys.stderr)
         return 2
 
     try:
@@ -631,13 +852,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"hvd-top: {e}", file=sys.stderr)
         return 2
+
+    # the tiered plane: per-host aggregator endpoints, when the driver
+    # publishes them (rollup + --rank drill-down both ride on these)
+    agg_targets: List[dict] = []
+    if not (args.serving or args.tune or args.autoscale or
+            args.no_rollup or args.targets):
+        try:
+            agg_targets = discover_agg_targets(args)
+        except Exception:  # noqa: BLE001 — KV outage: per-rank fallback
+            agg_targets = []
+    if args.rank is not None:
+        t = resolve_rank_target(agg_targets, targets, args.rank)
+        if t is None:
+            print(f"hvd-top: rank {args.rank} not found via the "
+                  f"aggregator tier or the per-rank target list",
+                  file=sys.stderr)
+            return 2
+        targets = [t]
+    use_rollup = (args.rank is None and bool(agg_targets) and
+                  (args.rollup or not targets or
+                   len(targets) > env_int("HOROVOD_TOP_ROLLUP_RANKS")))
+    if use_rollup:
+        targets = agg_targets
+
     if not targets:
         print("hvd-top: no targets (pass --targets host:port, point --kv "
               "at the rendezvous KV, or set HOROVOD_METRICS_PORT)",
               file=sys.stderr)
         return 2
     state = TopState(targets, serving=args.serving, tune=args.tune,
-                     autoscale=args.autoscale, kv=kv)
+                     autoscale=args.autoscale, kv=kv, rollup=use_rollup)
 
     if args.once:
         rows, unreachable = state.refresh(window=False)
@@ -647,8 +892,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"metrics_targets is unreachable)",
                   file=sys.stderr)
             return 1
-        print(state.render(rows, unreachable,
-                           _title(len(rows), len(targets))))
+        print(state.render(rows, unreachable, _state_title(state,
+                                                           len(rows))))
         return 0
 
     interval = args.interval if args.interval is not None \

@@ -38,7 +38,7 @@ from horovod_tpu.common import kv_keys
 from horovod_tpu.common.env_registry import (env_bool, env_float, env_int,
                                              env_is_set, env_str)
 from horovod_tpu.common.hvd_logging import get_logger
-from horovod_tpu.metrics import snapshot_value, step_stats
+from horovod_tpu.metrics.aggregator import TieredScrape
 from horovod_tpu.metrics.registry import get_registry
 from horovod_tpu.metrics.straggler import StragglerDetector
 
@@ -51,7 +51,7 @@ from horovod_tpu.runner.elastic.registration import (
     WorkerStateRegistry,
 )
 from horovod_tpu.runner.exec_utils import AdoptedWorker, WorkerProcess
-from horovod_tpu.runner.http_kv import KVServer, http_get_with_retry
+from horovod_tpu.runner.http_kv import KVServer
 from horovod_tpu.runner.launch import (
     free_ports,
     launcher_addr,
@@ -537,6 +537,10 @@ class ElasticDriver:
             self._straggler.reset()
             self._metrics_prev.clear()
             self._anomaly_prev.clear()
+            if getattr(self, "_tiered", None) is not None:
+                # consume-window floors are per-topology too: they exist
+                # to protect the baselines cleared above
+                self._tiered.reset()
             if self._reset_limit is not None and gen > self._reset_limit:
                 self._log(f"reset limit {self._reset_limit} exceeded")
                 self._result = 1
@@ -945,25 +949,30 @@ class ElasticDriver:
     # -- cluster health (metrics scrape + straggler detection) --------------
 
     def _scrape_worker_metrics(self):
-        """One heartbeat window: pull every expected slot's /metrics.json
-        (endpoint published by the worker's exporter under
-        ``metrics_addr/<host>/<slot>``), diff the step-time histogram, and
-        feed the per-rank window means to the straggler detector. Workers
-        without an exporter (metrics off) are simply absent.
+        """One heartbeat window over the tiered telemetry plane: for each
+        expected host, consume the per-host aggregator's ``/agg.json``
+        (endpoint published under ``agg_addr/<host>`` by local_rank 0's
+        exporter) when fresh, or fall back to the per-rank
+        ``/metrics.json`` scrape (endpoints under
+        ``metrics_addr/<host>/<slot>``) when the aggregator is dead or
+        stale — O(hosts) HTTP round-trips on the happy path instead of
+        O(ranks). Both paths diff the step-time histogram and
+        ``hvd_step_anomaly_total`` against the same baseline maps (see
+        :class:`horovod_tpu.metrics.aggregator.TieredScrape`), so counter
+        deltas stay monotonic across an aggregator death + fallback and a
+        rank is never double-counted within a heartbeat. Workers without
+        an exporter (metrics off) are simply absent.
 
         Side outputs of the same pass: the scrape-target list is published
         to the KV under ``metrics_targets`` (what ``hvd-top --kv`` reads to
-        discover the cluster), and each worker's ``hvd_step_anomaly_total``
-        counter is diffed so attributor-detected step-time spikes surface
-        as driver-level structured events."""
+        discover the cluster) and the live aggregator list under
+        ``agg_targets`` (what hvd-top's host rollup prefers), and each
+        worker's anomaly-counter delta surfaces as a driver-level
+        structured event."""
         with self._lock:
             slots = list(self._expected_slots)
             gen = self._generation
-        times: Dict[int, float] = {}
-        targets: List[dict] = []
         serve_targets: List[dict] = []
-        serve_slos: List = []
-        anomalies: List[Tuple[Tuple[str, int], dict, float]] = []
         for host, local_rank in slots:
             # serving plane: aggregate worker-published serve endpoints
             # into one key (the ingress router's discovery input — the
@@ -981,54 +990,33 @@ class ElasticDriver:
                     # table, not once the worker finally leaves it
                     entry["draining"] = True
                 serve_targets.append(entry)
-            info = self._kv.get_json(kv_keys.metrics_addr(host, local_rank))
-            # a malformed/partial KV entry skips THIS worker only — it must
-            # not abort the whole scrape pass for the healthy ones
-            if not isinstance(info, dict) or not info.get("addr") \
-                    or not info.get("port"):
-                continue
-            targets.append({"addr": info["addr"], "port": info["port"],
-                            "rank": info.get("rank")})
+        if getattr(self, "_tiered", None) is None:
+            # one instance across heartbeats: it carries the per-host
+            # consume-window floors that keep the two paths ordered
+            self._tiered = TieredScrape(self._kv.get_json)
+        result = self._tiered.heartbeat(
+            slots, self._metrics_prev, self._anomaly_prev,
+            want_slo=self._autoscaler is not None)
+        times = result.times
+        anomalies = [(key, info, delta)
+                     for key, info, delta in result.anomalies]
+        serve_slos = result.slos
+        if result.targets:
             try:
-                # short per-attempt timeout and small backoff: the scrape is
-                # periodic and failure-tolerant (the next heartbeat is the
-                # real retry), so a dead worker must not block the loop for
-                # multiple full timeouts
-                url = f"http://{info['addr']}:{info['port']}/metrics.json"
-                snap = json.loads(http_get_with_retry(
-                    url, timeout=1.0, attempts=2, backoff=0.05))
-            except Exception:  # noqa: BLE001 — worker mid-restart
-                continue
-            key = (host, local_rank)
-            if self._autoscaler is not None:
-                from horovod_tpu.runner.elastic.autoscaler import \
-                    worker_slo_from_snapshot
-                slo = worker_slo_from_snapshot(f"{host}/{local_rank}", snap)
-                if slo is not None:
-                    serve_slos.append(slo)
-            count = snapshot_value(snap, "hvd_step_anomaly_total")
-            if count is not None:
-                # first sight of a slot is a baseline, not an event — a
-                # worker surviving a rebalance keeps its lifetime counter,
-                # and re-relaying it after the generation reset would
-                # invent anomalies
-                prev_count = self._anomaly_prev.get(key)
-                self._anomaly_prev[key] = count
-                if prev_count is not None and count > prev_count:
-                    anomalies.append((key, info, count - prev_count))
-            stats = step_stats(snap)
-            if stats is None:
-                continue
-            prev = self._metrics_prev.get(key)
-            self._metrics_prev[key] = stats
-            if prev is not None and stats[0] > prev[0]:
-                times[int(info.get("rank", -1))] = \
-                    (stats[1] - prev[1]) / (stats[0] - prev[0])
-        if targets:
-            try:
-                self._publish(kv_keys.metrics_targets(), targets)
+                self._publish(kv_keys.metrics_targets(), result.targets)
             except Exception:  # noqa: BLE001 — telemetry must not kill
                 pass  # the heartbeat
+        if result.agg_targets or getattr(self, "_agg_published", False):
+            # same empty-table contract as serve_targets: once any
+            # aggregator has registered, an empty list means "all
+            # aggregators gone — scrape direct", not "no information"
+            self._agg_published = True
+            try:
+                self._publish(kv_keys.agg_targets(),
+                              {"generation": gen,
+                               "hosts": result.agg_targets})
+            except Exception:  # noqa: BLE001
+                pass
         if serve_targets or getattr(self, "_serve_published", False):
             # keep publishing once any serve worker has ever registered:
             # an EMPTY table is routing information too (all workers gone

@@ -40,6 +40,7 @@ from typing import List, Optional, Sequence, Tuple
 
 from horovod_tpu.common.env_registry import env_float, env_int
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+from horovod_tpu.obs.tracing import QUEUE_WAIT, get_tracer, now_us
 
 # Latency buckets for request-level histograms: serving targets live in the
 # 1ms..10s decade.
@@ -107,11 +108,12 @@ class InferenceRequest:
 
     __slots__ = ("id", "tokens", "max_new_tokens", "deadline", "arrival",
                  "bucket", "generated", "status", "error", "finished_at",
-                 "lease", "_done")
+                 "lease", "trace", "_done")
 
     def __init__(self, tokens: Sequence[int], max_new_tokens: int,
                  deadline: float, bucket: int,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 trace: Optional[str] = None):
         self.id = request_id or uuid.uuid4().hex[:16]
         self.tokens = [int(t) for t in tokens]
         self.max_new_tokens = int(max_new_tokens)
@@ -120,6 +122,8 @@ class InferenceRequest:
         self.bucket = int(bucket)
         self.generated: List[int] = []
         self.lease = None  # CacheLease when the batcher owns a KV cache
+        self.trace = trace  # sampled trace id (None on the untraced
+        # fast path — every per-stage span emission keys on this)
         self.status = "queued"
         self.error = ""
         self.finished_at: Optional[float] = None
@@ -209,7 +213,8 @@ class ContinuousBatcher:
     def submit(self, tokens: Sequence[int],
                max_new_tokens: Optional[int] = None,
                deadline_ms: Optional[float] = None,
-               request_id: Optional[str] = None) -> InferenceRequest:
+               request_id: Optional[str] = None,
+               trace: Optional[str] = None) -> InferenceRequest:
         """Admit a request or raise :class:`AdmissionRejected`.
 
         Rejections are counted and *immediate* — backpressure is the
@@ -228,7 +233,7 @@ class ContinuousBatcher:
             else self.default_deadline_ms
         req = InferenceRequest(tokens, budget,
                                time.monotonic() + ddl_ms / 1e3, bucket,
-                               request_id=request_id)
+                               request_id=request_id, trace=trace)
         with self._lock:
             if len(self._queue) >= self.queue_depth:
                 self._requests["rejected"].inc()
@@ -241,7 +246,8 @@ class ContinuousBatcher:
                     # charge the block pool NOW: a request that cannot
                     # get cache blocks is a 429 at admission, never an
                     # OOM mid-decode
-                    req.lease = self.cache.admit(req.tokens, budget)
+                    req.lease = self.cache.admit(req.tokens, budget,
+                                                 trace=trace)
                 except CacheExhausted as e:
                     self._requests["rejected"].inc()
                     req.finish("rejected", str(e))
@@ -298,7 +304,15 @@ class ContinuousBatcher:
                     skipped.append(req)
                     continue
                 req.status = "running"
-                self._queue_wait.observe(now - req.arrival)
+                wait = now - req.arrival
+                self._queue_wait.observe(wait)
+                if req.trace is not None:
+                    # span start back-dated to arrival: the wait is over
+                    # by the time anyone can observe it
+                    get_tracer().record(
+                        req.trace, QUEUE_WAIT, "batcher",
+                        now_us() - wait * 1e6, wait * 1e6,
+                        bucket=req.bucket)
                 out.append(req)
             for req in reversed(skipped):
                 self._queue.appendleft(req)

@@ -68,6 +68,8 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+from horovod_tpu.obs.tracing import (DECODE_STEP, DRAFT, PREFILL, VERIFY,
+                                     get_tracer, now_us)
 from horovod_tpu.serve.batcher import ContinuousBatcher, InferenceRequest
 
 StepFn = Callable[[np.ndarray, np.ndarray], np.ndarray]
@@ -164,6 +166,7 @@ class ServingLoop:
             self._inflight.set(len(running))
             self._batcher.observe_step(len(running))
             t0 = time.perf_counter()
+            w0 = now_us()
             try:
                 if self._cached is not None:
                     emitted = self._step_cached(running)
@@ -180,6 +183,15 @@ class ServingLoop:
                     next_ids = np.asarray(self._step_fn(tokens, lengths))
                     emitted = [[int(next_ids[i])]
                                for i in range(len(running))]
+                    dur = (time.perf_counter() - t0) * 1e6
+                    for r in running:
+                        if r.trace is not None:
+                            # recompute path: every step re-runs the full
+                            # forward, so each traced row gets one
+                            # decode_step span per step
+                            get_tracer().record(
+                                r.trace, DECODE_STEP, "executor", w0, dur,
+                                batch=len(running), bucket=bucket)
             except Exception as e:  # noqa: BLE001 — a broken executor must
                 # fail the requests it carried, loudly, not hang them
                 self._failures.inc()
@@ -241,6 +253,14 @@ class ServingLoop:
                 else:
                     le.state = self._cached.init_state(1)[0]
                     le.state_len = 0
+        # tracing bookkeeping (all of it keyed on traced being non-empty,
+        # so the untraced path costs one list comprehension per step): a
+        # row whose state does not yet cover its prompt tail is in
+        # prefill this step, the rest are steady decode
+        traced = [i for i, r in enumerate(running) if r.trace is not None]
+        prefill_rows = {i for i, r in enumerate(running)
+                        if r.lease.state_len < len(seqs[i]) - 1}
+        draft_win = verify_win = None
 
         # -- draft proposals (k cheap micro-steps) ---------------------------
         k = self._spec_k if self._draft is not None else 0
@@ -248,6 +268,8 @@ class ServingLoop:
         props: List[List[int]] = [[] for _ in range(n)]
         traj: List[List[np.ndarray]] = [[] for _ in range(n)]
         if k > 0:
+            if traced:
+                draft_t0 = (now_us(), time.perf_counter())
             steady = {i for i, r in enumerate(running)
                       if r.lease.state_len == len(seqs[i]) - 1}
             for i, r in enumerate(running):
@@ -276,6 +298,10 @@ class ServingLoop:
                         ext[i].append(p)
                         props[i].append(p)
 
+        if k > 0 and traced:
+            draft_win = (draft_t0[0],
+                         (time.perf_counter() - draft_t0[1]) * 1e6)
+
         # -- target verify: ONE batched advance over every row ---------------
         width = max(len(e) for e in ext)
         tok = np.zeros((n, width), np.int32)
@@ -284,7 +310,12 @@ class ServingLoop:
         upto = np.array([len(e) for e in ext], np.int64)
         tstate = np.stack([r.lease.state for r in running])
         tlen = np.array([r.lease.state_len for r in running], np.int64)
+        if traced:
+            verify_t0 = (now_us(), time.perf_counter())
         preds, states = self._cached.advance(tok, upto, tstate, tlen)
+        if traced:
+            verify_win = (verify_t0[0],
+                          (time.perf_counter() - verify_t0[1]) * 1e6)
 
         emitted: List[List[int]] = []
         accepts = np.zeros(n, np.int32)
@@ -334,6 +365,29 @@ class ServingLoop:
                 # tiny accept/reject exchange: 4*B bytes, deep under the
                 # express-lane threshold
                 self.spec_sync(accepts)
+        if traced:
+            tracer = get_tracer()
+            for i in traced:
+                r = running[i]
+                # the target advance IS the prefill compute for rows
+                # still consuming their prompt; steady rows decode (and,
+                # when speculating, get the draft/verify pair too)
+                if i in prefill_rows:
+                    tracer.record(r.trace, PREFILL, "executor",
+                                  verify_win[0], verify_win[1],
+                                  tokens=int(upto[i] - tlen[i]),
+                                  resumed_at=int(tlen[i]))
+                else:
+                    tracer.record(r.trace, DECODE_STEP, "executor",
+                                  verify_win[0], verify_win[1], batch=n)
+                    if props[i]:
+                        tracer.record(r.trace, DRAFT, "executor",
+                                      draft_win[0], draft_win[1],
+                                      proposed=len(props[i]))
+                        tracer.record(r.trace, VERIFY, "executor",
+                                      verify_win[0], verify_win[1],
+                                      proposed=len(props[i]),
+                                      accepted=int(accepts[i]))
         return emitted
 
 

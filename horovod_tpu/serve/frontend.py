@@ -36,6 +36,7 @@ from typing import Optional
 from horovod_tpu.metrics import histogram_quantile, snapshot_histogram, \
     snapshot_value
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+from horovod_tpu.obs.tracing import ADMISSION, get_tracer
 from horovod_tpu.serve.admission import AdmissionController
 from horovod_tpu.serve.batcher import AdmissionRejected, ContinuousBatcher
 from horovod_tpu.serve.router import (NoWorkersError, RequestRouter,
@@ -100,6 +101,14 @@ def serving_stats(snapshot: dict) -> dict:
     return out
 
 
+def _echo_trace(payload: dict, trace_id) -> dict:
+    """Echo the trace id in EVERY response — 200s, 429s, 5xx — so a
+    client can hand it back for correlation with the server-side spans."""
+    if trace_id is not None:
+        payload["trace_id"] = trace_id
+    return payload
+
+
 def tokenize(body: dict) -> list:
     """Token ids from a request body: ``tokens`` verbatim, else byte-level
     of ``prompt``."""
@@ -154,6 +163,11 @@ class ServeFrontend:
                         self._reply(503, {"status": "draining"})
                     else:
                         self._reply(200, {"status": "ok"})
+                elif path == "/trace.json":
+                    # this process's span buffer — what a collector (or
+                    # the routed-mode ingress) fetches to merge a
+                    # request's worker-side spans into one timeline
+                    self._reply(200, {"spans": get_tracer().spans()})
                 elif path == "/stats":
                     stats = serving_stats(frontend.registry.snapshot())
                     if frontend.batcher is not None and \
@@ -239,20 +253,30 @@ class ServeFrontend:
                      "retry_after_seconds": verdict.retry_after_seconds}
 
     def _handle_local(self, body: dict):
+        tracer = get_tracer()
+        # adopt the ingress sampling decision when routed to us; make it
+        # here when WE are the ingress (trace id minted once per request)
+        tid = tracer.adopt_or_start(body)
         if self.draining:
-            return 503, {"error": "worker draining", "status": "rejected"}
-        shed = self._admission_check(
-            body, self.batcher.pending() / max(self.batcher.queue_depth, 1))
+            return 503, _echo_trace(
+                {"error": "worker draining", "status": "rejected"}, tid)
+        with tracer.span(tid, ADMISSION, "frontend", mode="local"):
+            shed = self._admission_check(
+                body,
+                self.batcher.pending() / max(self.batcher.queue_depth, 1))
+            if shed is None:
+                try:
+                    req = self.batcher.submit(
+                        tokenize(body),
+                        max_new_tokens=body.get("max_new_tokens"),
+                        deadline_ms=body.get("deadline_ms"),
+                        request_id=body.get("id"),
+                        trace=tid)
+                except AdmissionRejected as e:
+                    shed = 429, {"error": str(e), "status": "rejected"}
         if shed is not None:
-            return shed
-        try:
-            req = self.batcher.submit(
-                tokenize(body),
-                max_new_tokens=body.get("max_new_tokens"),
-                deadline_ms=body.get("deadline_ms"),
-                request_id=body.get("id"))
-        except AdmissionRejected as e:
-            return 429, {"error": str(e), "status": "rejected"}
+            code, payload = shed
+            return code, _echo_trace(payload, tid)
         deadline_ms = body.get("deadline_ms")
         if deadline_ms is None:  # an explicit 0 means "already due",
             deadline_ms = self.batcher.default_deadline_ms  # not default
@@ -260,19 +284,25 @@ class ServeFrontend:
             # the loop should have expired it long before this fires; a
             # hung executor must still not wedge the handler thread
             self.batcher.complete(req, "failed", "serving loop unresponsive")
-            return 500, req.result()
+            return 500, _echo_trace(req.result(), tid)
         code = {"ok": 200, "expired": 504, "failed": 500,
                 "rejected": 429}.get(req.status, 500)
-        return code, req.result()
+        return code, _echo_trace(req.result(), tid)
 
     def _handle_routed(self, body: dict):
+        tracer = get_tracer()
+        tid = tracer.adopt_or_start(body)
         rid = str(body.get("id") or id(body))
-        body = dict(body, id=rid)
+        # trace propagation: the worker adopts this id instead of making
+        # its own sampling decision (one decision per request, at ingress)
+        body = tracer.inject(dict(body, id=rid), tid)
         # ingress mode: the queue lives on the workers, so only quotas
         # bite here (fill 0.0); class shedding happens where the queue is
-        shed = self._admission_check(body, 0.0)
+        with tracer.span(tid, ADMISSION, "frontend", mode="ingress"):
+            shed = self._admission_check(body, 0.0)
         if shed is not None:
-            return shed
+            code, payload = shed
+            return code, _echo_trace(payload, tid)
         try:
             resp = self.router.submit(
                 rid, body,
@@ -280,10 +310,11 @@ class ServeFrontend:
                     w.addr, w.port, "/v1/generate", payload,
                     timeout=self._dispatch_timeout))
         except NoWorkersError as e:
-            return 503, {"error": str(e), "status": "failed", "id": rid}
+            return 503, _echo_trace(
+                {"error": str(e), "status": "failed", "id": rid}, tid)
         code = {"ok": 200, "expired": 504, "failed": 500,
                 "rejected": 429}.get(resp.get("status"), 200)
-        return code, resp
+        return code, _echo_trace(resp, tid)
 
 
 def main(argv=None) -> int:

@@ -56,6 +56,7 @@ import numpy as np
 
 from horovod_tpu.common.env_registry import env_bool, env_int
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+from horovod_tpu.obs.tracing import CACHE_LOOKUP, get_tracer
 
 
 class CacheExhausted(RuntimeError):
@@ -205,13 +206,25 @@ class PagedKVCache:
             out.append((parent, chunk))
         return out
 
-    def admit(self, tokens: Sequence[int], budget: int) -> CacheLease:
+    def admit(self, tokens: Sequence[int], budget: int,
+              trace: Optional[str] = None) -> CacheLease:
         """Charge the pool for a request (prompt + ``budget`` generated
         tokens) or raise :class:`CacheExhausted`.
 
         Resident shared prefix blocks are increfed instead of charged —
         the prefix-reuse capacity win. Eviction of zero-ref LRU blocks
-        happens here, only when the free pool alone cannot cover."""
+        happens here, only when the free pool alone cannot cover.
+        ``trace`` (a sampled trace id) emits the ``cache_lookup`` span
+        covering the prefix-hash walk + pool charge."""
+        with get_tracer().span(trace, CACHE_LOOKUP, "kv_cache") as sp:
+            lease = self._admit(tokens, budget)
+            if trace is not None:
+                sp.args = dict(sp.args, charged=lease.charged,
+                               shared_hits=len(lease.shared),
+                               prefix_covered=lease.prefix_covered)
+            return lease
+
+    def _admit(self, tokens: Sequence[int], budget: int) -> CacheLease:
         total = blocks_for(len(tokens) + int(budget), self.block_tokens)
         with self._lock:
             shared: List[_Block] = []

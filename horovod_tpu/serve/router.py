@@ -40,6 +40,7 @@ from urllib import request as urlrequest
 from horovod_tpu.common.env_registry import env_int
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
+from horovod_tpu.obs.tracing import RE_ROUTE, get_tracer, now_us
 
 UP = "up"
 DRAINING = "draining"
@@ -302,6 +303,8 @@ class RequestRouter:
         which a healthy cluster pins at zero."""
         last: Optional[Exception] = None
         tried: set = set()
+        trace = payload.get("trace")
+        tid = trace.get("id") if isinstance(trace, dict) else trace or None
         for attempt in range(self.retry_limit + 1):
             try:
                 worker = self.pick(exclude=tried)
@@ -313,6 +316,7 @@ class RequestRouter:
                 except NoWorkersError:
                     break
             self.assign(worker, request_id)
+            t0 = now_us()
             try:
                 resp = send(worker, payload)
             except Exception as e:  # noqa: BLE001 — transport failure is
@@ -322,6 +326,12 @@ class RequestRouter:
                 self.fail_worker(worker.id)
                 if attempt < self.retry_limit:
                     self._rerouted.inc()
+                    # span covers the failed dispatch attempt — the time
+                    # the re-route decision cost this request
+                    get_tracer().record(
+                        tid, RE_ROUTE, "router", t0, now_us() - t0,
+                        failed_worker=worker.id, attempt=attempt,
+                        error=repr(e))
                 continue
             self.complete(worker, request_id)
             self._routed.inc()

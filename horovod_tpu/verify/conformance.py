@@ -140,8 +140,8 @@ def check_kv_wal(kv_dir) -> List[str]:
                     f"({e} after {max_claimed_epoch}) — a fenced-out "
                     "stale driver's write landed (split-brain)")
             max_claimed_epoch = max(max_claimed_epoch or e, e)
-        if family in ("generation", "notify") and isinstance(val, dict) \
-                and "generation" in val:
+        if family in ("generation", "notify", "agg_targets") \
+                and isinstance(val, dict) and "generation" in val:
             try:
                 g = int(val["generation"])
             except (TypeError, ValueError):

@@ -1159,6 +1159,167 @@ class PagedCacheSpec(Spec):
         ]
 
 
+# ===========================================================================
+# Tiered telemetry scrape (per-host aggregator + driver fallback)
+# ===========================================================================
+
+class ScrapeState(NamedTuple):
+    c: int                # the rank's live counter value (this incarnation)
+    avail: int            # increments that occurred while a driver
+    #                       baseline existed (upper bound for T)
+    a_val: Optional[int]  # aggregator's cached snapshot of c (None: none)
+    a_stale: bool         # payload older than the staleness bound (dead)
+    a_old: bool           # payload predates the driver's last direct
+    #                       consume (age-fresh but window-regressed)
+    b: Optional[int]      # driver baseline for the rank (metrics_prev)
+    b_gen: int            # generation the baseline was established in
+    T: int                # driver-visible accumulated counter delta
+    gen: int              # driver topology generation
+    stale_baseline_used: bool  # a heartbeat diffed against a baseline
+    #                            from an older generation (PR-7 class)
+    incs_left: int
+    deaths_left: int
+    gens_left: int
+
+
+class ScrapeSpec(Spec):
+    """One host, one rank, both scrape tiers (ISSUE 18).
+
+    The rank owns a monotonic counter; the per-host aggregator caches a
+    snapshot of it (``/agg.json``); the driver's heartbeat consumes the
+    host through EXACTLY one path per beat — the aggregator when its
+    payload is fresh and not window-regressed, the direct per-rank
+    scrape otherwise — diffing into one shared baseline. Faults: the
+    aggregator crashes (payload goes stale), and a generation change
+    restarts the rank (counter back to zero) while the driver clears
+    its baselines exactly once (``_rebalance``).
+
+    Invariants:
+
+    - ``no_double_count`` — the driver-visible accumulated delta never
+      exceeds the true increment count. Killed by
+      ``double_count_on_fallback`` (one heartbeat consumes the host via
+      BOTH paths against the same baseline) and by
+      ``consume_stale_window`` (an age-fresh aggregator payload that
+      predates the last direct consume regresses the baseline, and the
+      next window re-counts the difference — the hazard the
+      ``TieredScrape`` per-host window floor exists to stop);
+    - ``baseline_reset_on_generation`` — no heartbeat ever diffs against
+      a baseline established under an older generation. Killed by
+      ``skip_baseline_reset`` (the generation change keeps the baseline
+      maps — the PR-7 stale-baseline bug via the new tier).
+
+    Monotonicity of the driver-visible total is structural (deltas are
+    only ever added when positive), so it needs no separate invariant.
+    """
+
+    def __init__(self, double_count_on_fallback: bool = False,
+                 skip_baseline_reset: bool = False,
+                 consume_stale_window: bool = False,
+                 incs: int = 3, deaths: int = 1, gens: int = 1):
+        super().__init__(name="scrape", mutations=tuple(
+            m for m, on in [
+                ("double_count_on_fallback", double_count_on_fallback),
+                ("skip_baseline_reset", skip_baseline_reset),
+                ("consume_stale_window", consume_stale_window)] if on))
+        self.double_count_on_fallback = double_count_on_fallback
+        self.skip_baseline_reset = skip_baseline_reset
+        self.consume_stale_window = consume_stale_window
+        self.incs = incs
+        self.deaths = deaths
+        self.gens = gens
+
+    def initial(self) -> ScrapeState:
+        return ScrapeState(
+            c=0, avail=0, a_val=None, a_stale=False, a_old=False,
+            b=None, b_gen=0, T=0, gen=0, stale_baseline_used=False,
+            incs_left=self.incs, deaths_left=self.deaths,
+            gens_left=self.gens)
+
+    # one consume = TieredScrape._consume_rank: establish or diff the
+    # shared baseline (the same code path for both tiers).  Establishing
+    # from a snapshot v absorbs increments [0, v] forever, but anything
+    # the rank did above v is countable later — credit it to ``avail``
+    # (matters when establishing from an aggregator payload older than
+    # the rank's live counter).
+    def _consume(self, s: ScrapeState, v: int) -> ScrapeState:
+        if s.b is None:
+            return s._replace(b=v, b_gen=s.gen, avail=s.avail + (s.c - v))
+        stale = s.stale_baseline_used or s.b_gen != s.gen
+        delta = v - s.b if v > s.b else 0
+        return s._replace(b=v, T=s.T + delta, stale_baseline_used=stale)
+
+    def actions(self, s: ScrapeState):
+        out = []
+        if s.incs_left > 0:
+            out.append(("rank.inc", s._replace(
+                c=s.c + 1, avail=s.avail + (1 if s.b is not None else 0),
+                incs_left=s.incs_left - 1)))
+        # aggregator refresh: snapshot the rank NOW; a fresh window
+        # clears both the staleness and the regression marks
+        out.append(("agg.refresh", s._replace(
+            a_val=s.c, a_stale=False, a_old=False)))
+        if s.deaths_left > 0 and s.a_val is not None and not s.a_stale:
+            out.append(("fault: aggregator crashes mid-heartbeat "
+                        "(payload ages past the staleness bound)",
+                        s._replace(a_stale=True,
+                                   deaths_left=s.deaths_left - 1)))
+        # driver heartbeat — exactly one path per beat in the clean spec
+        agg_usable = s.a_val is not None and not s.a_stale and \
+            (not s.a_old or self.consume_stale_window)
+        if agg_usable:
+            out.append(("driver.heartbeat(agg)", self._consume(s, s.a_val)))
+        # direct scrape: the mandatory path when the aggregator tier is
+        # unusable, and always reachable via a transient agg-fetch
+        # failure (KV miss / connection refused) even when it is.
+        # Either way, any still-cached aggregator payload now predates
+        # this consume — window-regressed from here on (the real
+        # TieredScrape records this as the per-host window floor).
+        nxt = self._consume(s, s.c)
+        if nxt.a_val is not None:
+            nxt = nxt._replace(a_old=True)
+        out.append(("driver.heartbeat(direct fallback)", nxt))
+        if self.double_count_on_fallback and agg_usable \
+                and s.b is not None:
+            # seeded bug: the fallback leg runs after the aggregator leg
+            # in the SAME heartbeat, both diffing the baseline read at
+            # heartbeat start
+            d1 = s.a_val - s.b if s.a_val > s.b else 0
+            d2 = s.c - s.b if s.c > s.b else 0
+            stale = s.stale_baseline_used or s.b_gen != s.gen
+            out.append(("driver.heartbeat(BOTH paths)", s._replace(
+                b=s.c, T=s.T + d1 + d2, stale_baseline_used=stale)))
+        if s.gens_left > 0:
+            # elastic resize: the rank restarts (counter from zero), the
+            # aggregator's old payload dies with its worker, and the
+            # driver clears its baselines exactly once (_rebalance) —
+            # unless the seeded PR-7 mutant skips the clear
+            nxt = s._replace(c=0, a_val=None, a_stale=False, a_old=False,
+                             gen=s.gen + 1, gens_left=s.gens_left - 1)
+            if not self.skip_baseline_reset:
+                nxt = nxt._replace(b=None, b_gen=nxt.gen)
+            out.append(("driver.rebalance(generation change)", nxt))
+        return out
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        return [
+            Invariant(
+                "no_double_count",
+                "the driver-visible accumulated counter delta never "
+                "exceeds the increments that actually occurred while a "
+                "baseline existed — a rank is consumed through exactly "
+                "one scrape path per window",
+                lambda s: s.T <= s.avail),
+            Invariant(
+                "baseline_reset_on_generation",
+                "no heartbeat diffs against a baseline established "
+                "under an older generation (the generation change "
+                "clears the shared baseline maps exactly once)",
+                lambda s: not s.stale_baseline_used),
+        ]
+
+
 SPECS: Dict[str, type] = {
     "cycle": CycleSpec,
     "epoch": EpochSpec,
@@ -1166,6 +1327,7 @@ SPECS: Dict[str, type] = {
     "tune": TuneSpec,
     "autoscale": AutoscaleSpec,
     "paged_cache": PagedCacheSpec,
+    "scrape": ScrapeSpec,
 }
 
 # mutant name -> (spec name, constructor kwarg, description). Each is a
@@ -1244,6 +1406,24 @@ MUTANTS: Dict[str, Tuple[str, str, str]] = {
         "admission consults the prefix hash table without re-checking "
         "residency: it increfs a shared block the LRU already evicted "
         "and the request decodes from a freed page (use-after-free)"),
+    "scrape_double_count_on_fallback": (
+        "scrape", "double_count_on_fallback",
+        "the heartbeat's direct-fallback leg runs AFTER the aggregator "
+        "leg in the same beat, both diffing the baseline read at "
+        "heartbeat start: every relayed increment lands twice in the "
+        "driver's totals"),
+    "scrape_baseline_reset_skipped": (
+        "scrape", "skip_baseline_reset",
+        "PR-7 stale-baseline bug resurfacing through the aggregator "
+        "tier: the generation change keeps metrics_prev, so the first "
+        "post-rebalance heartbeat diffs a restarted rank against a "
+        "dead incarnation's counters"),
+    "scrape_consume_stale_window": (
+        "scrape", "consume_stale_window",
+        "the per-host window floor removed: an age-fresh /agg.json "
+        "payload sampled BEFORE the driver's last direct consume "
+        "regresses the shared baseline, and the next window re-counts "
+        "the difference"),
 }
 
 

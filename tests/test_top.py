@@ -483,3 +483,199 @@ def test_cli_autoscale_exclusive_with_serving_and_tune():
     for combo in (["--autoscale", "--serving"], ["--autoscale", "--tune"]):
         rc = top.main(combo + ["--once", "--targets", "127.0.0.1:1"])
         assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# host rollup (ISSUE 18): the O(hosts) view over the aggregator tier's
+# /agg.json endpoints, plus the --rank drill-down through it
+
+
+class _FrozenAggregator:
+    """Duck-typed stand-in for HostAggregator: serves one precomputed
+    payload (age stamped at serve time like the real one)."""
+
+    def __init__(self, payload, age_seconds=0.1):
+        self._payload = payload
+        self.age_seconds = age_seconds
+
+    def payload(self):
+        out = dict(self._payload)
+        out["age_seconds"] = self.age_seconds
+        return out
+
+    def stop(self):
+        pass
+
+
+def _host_payload(host, n_ranks, first_rank, step_s=0.1, rank_port=None):
+    """A synthetic /agg.json payload built with the REAL merge over
+    n_ranks per-rank registry snapshots."""
+    from horovod_tpu.metrics.aggregator import merge_snapshots
+    snaps, ranks = [], {}
+    for lr in range(n_ranks):
+        rank = first_rank + lr
+        reg = _populated_registry(rank, step_s=step_s)
+        snap = reg.snapshot()
+        snaps.append((rank, snap))
+        ranks[str(lr)] = {"rank": rank, "local_rank": lr,
+                          "addr": "127.0.0.1",
+                          "port": rank_port(rank) if rank_port else None,
+                          "step": [1, step_s], "anomalies": 1.0,
+                          "slo": None}
+    return {"host": host, "ranks": ranks,
+            "merged": merge_snapshots(snaps), "scrape_errors": 0}
+
+
+@pytest.fixture
+def agg_fleet():
+    """A simulated 32-host fleet behind the tiered plane: 32 live
+    /agg.json endpoints (4 ranks merged per host = 128 ranks, above the
+    rollup threshold) and a rendezvous KV publishing agg_targets +
+    metrics_targets the way the elastic driver does."""
+    from horovod_tpu.common import kv_keys
+    from horovod_tpu.runner.http_kv import KVServer
+    n_hosts, per_host = 32, 4
+    exporters = []
+    for h in range(n_hosts):
+        payload = _host_payload(f"host{h:02d}", per_host, h * per_host,
+                                step_s=0.1 + 0.01 * h)
+        e = MetricsExporter(MetricsRegistry(), port=0,
+                            aggregator=_FrozenAggregator(payload)).start()
+        exporters.append(e)
+    kv = KVServer(port=0).start()
+    kv.put_json(kv_keys.agg_targets(), {
+        "generation": 1,
+        "hosts": [{"host": f"host{h:02d}", "addr": "127.0.0.1",
+                   "port": exporters[h].port}
+                  for h in range(n_hosts)]}, epoch=1)
+    kv.put_json(kv_keys.metrics_targets(),
+                [{"addr": "127.0.0.1", "port": 1, "rank": r}
+                 for r in range(n_hosts * per_host)], epoch=1)
+    yield exporters, kv, n_hosts, per_host
+    kv.stop()
+    # 32 sequential stops (~0.3s of shutdown+join each) would dominate
+    # the suite; tear the fleet down concurrently
+    import threading
+    stoppers = [threading.Thread(target=e.stop) for e in exporters]
+    for t in stoppers:
+        t.start()
+    for t in stoppers:
+        t.join(timeout=10)
+
+
+def test_host_row_extraction_from_live_agg(agg_fleet):
+    exporters, kv, n_hosts, per_host = agg_fleet
+    target = {"host": "host00", "addr": "127.0.0.1",
+              "port": exporters[0].port}
+    payload = top.scrape_agg(target)
+    assert payload is not None and payload["host"] == "host00"
+    row = top.host_row_from_agg(target, payload, None, stale_after=10.0)
+    assert row["ranks"] == per_host
+    assert row["step_ms"] == pytest.approx(100.0)
+    # the merged histogram is bucket-wise, so the host p99 is a real
+    # cross-rank quantile estimate, not a mean of means
+    assert row["p99_ms"] is not None and row["p99_ms"] > 0
+    assert row["exposed_pct"] == pytest.approx(25.0)
+    assert row["stall_pct"] == pytest.approx(10.0)
+    assert row["anomalies"] == per_host  # counters sum across ranks
+    assert row["queue_depth"] == 2 * per_host  # summed gauge vector
+    assert row["scrape_errors"] == 0
+    assert row["stale"] is False
+
+
+def test_rollup_render_marks_stale_aggregators(agg_fleet):
+    exporters, kv, n_hosts, per_host = agg_fleet
+    target = {"host": "host00", "addr": "127.0.0.1",
+              "port": exporters[0].port}
+    payload = top.scrape_agg(target)
+    payload["age_seconds"] = 99.0  # older than the staleness bound
+    row = top.host_row_from_agg(target, payload, None, stale_after=10.0)
+    assert row["stale"] is True
+    text = top.render_rollup([row], stale_after=10.0)
+    assert "99.0!" in text
+    assert "STALE DATA" in text and "direct-scraping" in text
+
+
+def test_rollup_window_step_mean(agg_fleet):
+    """The rollup STEP ms diffs the host-merged histogram between
+    refreshes, same as the per-rank view."""
+    exporters, kv, n_hosts, per_host = agg_fleet
+    state = top.TopState(
+        [{"host": f"host{h:02d}", "addr": "127.0.0.1",
+          "port": exporters[h].port} for h in range(2)], rollup=True)
+    rows, unreachable = state.refresh()
+    assert unreachable == 0 and len(rows) == 2
+    assert rows[0]["host"] == "host00"
+    assert rows[0]["step_ms"] == pytest.approx(100.0)  # lifetime mean
+    # no new steps between refreshes: the window mean goes blank
+    rows, _ = state.refresh()
+    assert rows[0]["step_ms"] is None
+
+
+def test_rank_drilldown_resolves_through_agg_tier(agg_fleet, capsys):
+    exporters, kv, n_hosts, per_host = agg_fleet
+    agg_targets = [{"host": f"host{h:02d}", "addr": "127.0.0.1",
+                    "port": exporters[h].port} for h in range(n_hosts)]
+    # rank 17 lives on host04 (17 // 4), local_rank 1; its vector's port
+    # is None in the fixture, so resolution falls through to the
+    # rank-labelled target list — patch one vector with a live port to
+    # exercise the aggregator path end to end
+    live = MetricsExporter(_populated_registry(17), port=0,
+                           labels={"rank": "17"}).start()
+    try:
+        exporters[4].aggregator._payload["ranks"]["1"]["port"] = live.port
+        t = top.resolve_rank_target(agg_targets, [], 17)
+        assert t == {"addr": "127.0.0.1", "port": live.port, "rank": 17}
+        rc = top.main(["--once", "--kv", f"127.0.0.1:{kv.port}",
+                       "--rank", "17"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "RANK" in out  # per-rank view, not the host rollup
+        assert any(ln.split()[0] == "17"
+                   for ln in out.splitlines()[2:] if ln.strip())
+    finally:
+        live.stop()
+    assert top.resolve_rank_target(agg_targets, [], 9999) is None
+
+
+def test_rollup_triggers_above_threshold_via_kv(agg_fleet, capsys,
+                                                monkeypatch):
+    """128 published ranks > HOROVOD_TOP_ROLLUP_RANKS: the default view
+    flips to one row per host; --no-rollup forces per-rank rows."""
+    exporters, kv, n_hosts, per_host = agg_fleet
+    monkeypatch.delenv("HOROVOD_METRICS_PORT", raising=False)
+    rc = top.main(["--once", "--kv", f"127.0.0.1:{kv.port}"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    assert f"{n_hosts}/{n_hosts} hosts reporting" in lines[0]
+    for col in ("HOST", "RANKS", "p99 ms"):
+        assert col in lines[1]
+    hosts = [ln.split()[0] for ln in lines[2:] if ln.strip()]
+    assert hosts == sorted(f"host{h:02d}" for h in range(n_hosts))
+    # --no-rollup scrapes the per-rank metrics_targets instead (all dead
+    # ports in this fixture -> exit 1, and no host rows)
+    rc = top.main(["--once", "--kv", f"127.0.0.1:{kv.port}",
+                   "--no-rollup"])
+    assert rc == 1
+
+
+def test_rollup_and_no_rollup_exclusive():
+    assert top.main(["--once", "--rollup", "--no-rollup",
+                     "--targets", "127.0.0.1:1"]) == 2
+
+
+def test_cli_rollup_once_smoke_32_hosts(agg_fleet):
+    """`hvd-top --once` against the simulated 32-host fleet in a clean
+    interpreter: the 1024-rank-class CI surface — O(hosts) scrapes, one
+    row per host."""
+    exporters, kv, n_hosts, per_host = agg_fleet
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.obs.top", "--once",
+         "--kv", f"127.0.0.1:{kv.port}"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "HOST" in proc.stdout and "RANKS" in proc.stdout
+    rows = [ln for ln in proc.stdout.splitlines()[2:] if ln.strip()]
+    assert len(rows) == n_hosts
+    assert all(ln.split()[1] == str(per_host) for ln in rows)

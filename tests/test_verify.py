@@ -102,6 +102,8 @@ def test_every_builder_roundtrips_through_match():
         kv_keys.serve_addr("h", 0): "serve_addr",
         kv_keys.serve_stop(): "serve_stop",
         kv_keys.metrics_addr("h", 0): "metrics_addr",
+        kv_keys.agg_addr("h"): "agg_addr",
+        kv_keys.agg_targets(): "agg_targets",
         kv_keys.tune_config("job"): "tune_config",
         kv_keys.tune_epoch("job", 7): "tune_epoch",
         kv_keys.task_fn(): "task_fn",
@@ -319,6 +321,22 @@ def test_kv_wal_generation_regression_flagged(tmp_path):
     kv = KVServer(port=0, kv_dir=str(tmp_path))
     kv.put_json(kv_keys.generation(), {"generation": 4}, epoch=kv.epoch)
     kv.put_json(kv_keys.generation(), {"generation": 2}, epoch=kv.epoch)
+    kv._httpd.server_close()
+    kv._wal.close()
+    divs = conformance.check_kv_wal(tmp_path)
+    assert any("generation regressed" in d for d in divs), divs
+
+
+def test_kv_wal_agg_targets_generation_regression_flagged(tmp_path):
+    """The tiered-scrape discovery table (ISSUE 18) is generation-stamped
+    like notify/generation: a replay that sees the aggregator list jump
+    backwards caught a stale driver publishing a pre-resize fleet."""
+    from horovod_tpu.runner.http_kv import KVServer
+    kv = KVServer(port=0, kv_dir=str(tmp_path))
+    kv.put_json(kv_keys.agg_targets(), {"generation": 3, "hosts": []},
+                epoch=kv.epoch)
+    kv.put_json(kv_keys.agg_targets(), {"generation": 1, "hosts": []},
+                epoch=kv.epoch)
     kv._httpd.server_close()
     kv._wal.close()
     divs = conformance.check_kv_wal(tmp_path)
