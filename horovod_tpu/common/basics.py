@@ -247,8 +247,9 @@ def _negotiate_subset_ports(members, is_leader: bool):
     port = env_int("HOROVOD_RENDEZVOUS_PORT")
     if not addr or not port:
         return None
-    from horovod_tpu.runner.http_kv import KVClient
-    client = KVClient(addr, port)
+    from horovod_tpu.runner.http_kv import (KVClient,
+                                            replica_endpoints_from_env)
+    client = KVClient(addr, port, endpoints=replica_endpoints_from_env())
     # per-init round counter (incremented by the caller; all members call
     # init in lockstep), so a second init(comm=...) in the same processes
     # can't read the previous round's — now closed — ports

@@ -108,6 +108,18 @@ _decl("HOROVOD_WORKER_HEARTBEAT_TIMEOUT_SECONDS", "float", 10.0,
 _decl("HOROVOD_HEADLESS_DEADLINE_SECONDS", "float", 1800.0,
       "how long a worker keeps training through a driver/KV outage "
       "(headless mode) before aborting (<=0 = never abort)")
+_decl("HOROVOD_KV_REPLICAS", "int", 0,
+      "run the durable KV as this many leader-lease replicas (<2 = the "
+      "single embedded KV; >=2 = supervisor-spawned replica subprocesses "
+      "with majority-acked replication and split-brain-proof failover)")
+_decl("HOROVOD_KV_REPLICA_ENDPOINTS", "str", None,
+      "comma-separated host:port list of the KV replica set; when set, "
+      "the driver and workers fail over across these endpoints "
+      "(follow 307 leader redirects, rotate on NotLeader/refused)")
+_decl("HOROVOD_KV_LEASE_SECONDS", "float", 2.0,
+      "KV leader lease duration: the leader renews it with each "
+      "majority-acked append round; followers wait 1.5 leases of "
+      "silence before electing a successor")
 _decl("HOROVOD_SOAK_ARTIFACT_DIR", "str", None,
       "chaos-soak runs copy their KV WAL + flight artifacts here so "
       "`make conformance` can replay the latest soak (hvd-check)")

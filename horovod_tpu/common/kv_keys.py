@@ -21,6 +21,15 @@ Writer roles: ``driver`` writes claim the control epoch (the KV fences
 strictly-older claimants — see ``runner/http_kv.py``); ``worker`` /
 ``serve-worker`` / ``tuner`` / ``task`` writes are deliberately
 epoch-less (workers never claim driver authority).
+
+Shards (ISSUE 19): every family maps to exactly one WAL **shard** so
+1024-rank heartbeat appends stop serializing behind resize records.
+The durable KV keeps one WAL + snapshot per shard (``core`` keeps the
+legacy ``wal.log``/``snapshot.json`` filenames); :func:`shard_for_key`
+/ :func:`shard_for_prefix` are the routing functions the server, the
+replication plane, and the conformance checker all share. Unregistered
+keys route to ``core`` — routing must never refuse a write the server
+would accept.
 """
 
 from __future__ import annotations
@@ -54,6 +63,7 @@ class KVKeyFamily:
     writer: str         # "driver" | "worker" | "serve-worker" | "tuner" | "task"
     epoch_claimed: bool  # driver-originated: writes must claim the epoch
     doc: str
+    shard: str = "core"  # WAL shard this family's mutations land in
 
     @property
     def prefix(self) -> str:
@@ -74,11 +84,17 @@ class KVKeyFamily:
 
 FAMILIES: Dict[str, KVKeyFamily] = {}
 
+# every declared shard; "core" is both the default and the legacy
+# (pre-sharding) WAL, so old kv_dirs replay unchanged
+SHARDS = ("core", "heartbeat", "serve", "tune", "autoscale")
+
 
 def _decl(name: str, pattern: str, writer: str, epoch_claimed: bool,
-          doc: str):
+          doc: str, shard: str = "core"):
     assert name not in FAMILIES, name
-    FAMILIES[name] = KVKeyFamily(name, pattern, writer, epoch_claimed, doc)
+    assert shard in SHARDS, (name, shard)
+    FAMILIES[name] = KVKeyFamily(name, pattern, writer, epoch_claimed,
+                                 doc, shard)
 
 
 # -- elastic rendezvous (driver-published, epoch-claimed) -------------------
@@ -93,12 +109,14 @@ _decl("go", "go/g<gen>", "driver", True,
 _decl("rank_and_size", "rank_and_size/g<gen>/<host>/<local_rank>", "driver",
       True, "per-slot topology record for one generation")
 _decl("metrics_targets", "metrics_targets", "driver", True,
-      "aggregated worker /metrics endpoints (hvd-top discovery)")
+      "aggregated worker /metrics endpoints (hvd-top discovery)",
+      shard="heartbeat")
 _decl("agg_targets", "agg_targets", "driver", True,
       "live per-host aggregator /agg.json endpoints (the tiered-scrape "
-      "discovery table: hvd-top host rollups and O(hosts) heartbeats)")
+      "discovery table: hvd-top host rollups and O(hosts) heartbeats)",
+      shard="heartbeat")
 _decl("serve_targets", "serve_targets", "driver", True,
-      "aggregated serving endpoints (router discovery)")
+      "aggregated serving endpoints (router discovery)", shard="serve")
 _decl("straggler", "straggler/g<gen>/<rank>", "driver", True,
       "driver-relayed straggler event for one rank")
 _decl("anomaly", "anomaly/g<gen>/<rank>", "driver", True,
@@ -108,7 +126,8 @@ _decl("anomaly", "anomaly/g<gen>/<rank>", "driver", True,
 _decl("worker_state", "worker_state/g<gen>/<host>/<local_rank>", "worker",
       False, "READY/SUCCESS/FAILURE/DRAINED registry record")
 _decl("worker_heartbeat", "worker_heartbeat/<host>/<slot>", "worker", False,
-      "worker liveness heartbeat (driver-recovery adoption)")
+      "worker liveness heartbeat (driver-recovery adoption)",
+      shard="heartbeat")
 _decl("drain", "drain/<host>/<slot>", "worker", False,
       "preemption-notice drain announcement")
 _decl("shard_handoff", "shard_handoff/w<world>/<old_rank>", "worker", False,
@@ -116,29 +135,35 @@ _decl("shard_handoff", "shard_handoff/w<world>/<old_rank>", "worker", False,
 _decl("reset_request", "reset_request/g<gen>", "worker", False,
       "worker request for a fresh rendezvous round past a dead generation")
 _decl("metrics_addr", "metrics_addr/<host>/<local_rank>", "worker", False,
-      "worker /metrics endpoint publication (driver aggregates)")
+      "worker /metrics endpoint publication (driver aggregates)",
+      shard="heartbeat")
 _decl("agg_addr", "agg_addr/<host>", "worker", False,
       "per-host aggregator /agg.json endpoint (published by local_rank "
-      "0's exporter; the driver prefers it over per-rank scrapes)")
+      "0's exporter; the driver prefers it over per-rank scrapes)",
+      shard="heartbeat")
 
 # -- serving plane ----------------------------------------------------------
 _decl("serve_addr", "serve_addr/<host>/<local_rank>", "serve-worker", False,
-      "serving worker endpoint publication (driver aggregates)")
+      "serving worker endpoint publication (driver aggregates)",
+      shard="serve")
 _decl("serve_stop", "serve_stop", "serve-worker", False,
-      "cooperative stop signal polled by serving workers")
+      "cooperative stop signal polled by serving workers", shard="serve")
 
 # -- traffic-driven autoscaler (driver-published, epoch-claimed) ------------
 _decl("autoscale_decision", "autoscale/decision", "driver", True,
       "the autoscaler's current decision record (decide→drain→resize→ack "
-      "state machine; a recovered driver resumes it instead of re-deciding)")
+      "state machine; a recovered driver resumes it instead of re-deciding)",
+      shard="autoscale")
 _decl("autoscale_event", "autoscale/event/<seq>", "driver", True,
-      "per-decision audit record (action, reason, victim, outcome)")
+      "per-decision audit record (action, reason, victim, outcome)",
+      shard="autoscale")
 
 # -- autotuner parameter sync ----------------------------------------------
 _decl("tune_config", "tune_config/<job>", "tuner", False,
-      "converged tuner config for a job (follower adoption)")
+      "converged tuner config for a job (follower adoption)", shard="tune")
 _decl("tune_epoch", "tune_epoch/<job>/<epoch>", "tuner", False,
-      "per-epoch tuner config broadcast (cycle-fenced adoption)")
+      "per-epoch tuner config broadcast (cycle-fenced adoption)",
+      shard="tune")
 
 # -- task execution (runner.run_task / cluster jobs) ------------------------
 _decl("task_fn", "task_fn", "task", False,
@@ -326,3 +351,30 @@ def slash_prefixes() -> Dict[str, str]:
 def singleton_names() -> Dict[str, str]:
     """{exact key -> family} for singleton families."""
     return {fam.pattern: fam.name for fam in FAMILIES.values() if fam.exact}
+
+
+# -- WAL shard routing (ISSUE 19) -------------------------------------------
+
+def shard_of(family: str) -> str:
+    """The WAL shard a registered family's mutations land in."""
+    return FAMILIES[family].shard
+
+
+def shard_for_key(key: str) -> str:
+    """Route a concrete key to its WAL shard. Unregistered keys route to
+    ``core`` — routing never refuses a write the server would accept."""
+    m = match(key)
+    return FAMILIES[m[0]].shard if m is not None else "core"
+
+
+def shard_for_prefix(prefix: str) -> str:
+    """Route a delete_prefix scan to the shard its family lives in (a GC
+    prefix never spans shards: each family maps to exactly one)."""
+    fam = match_prefix(prefix)
+    return FAMILIES[fam].shard if fam is not None else "core"
+
+
+def shard_families(shard: str) -> Tuple[str, ...]:
+    """Family names assigned to one shard (the conformance checker's
+    per-shard audit scope)."""
+    return tuple(f.name for f in FAMILIES.values() if f.shard == shard)

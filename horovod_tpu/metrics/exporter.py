@@ -156,12 +156,14 @@ def _publish_endpoint(exporter: MetricsExporter, log):
         kv_port = env_int("HOROVOD_RENDEZVOUS_PORT")
         if not addr or not kv_port:
             return
-        from horovod_tpu.runner.http_kv import KVClient
+        from horovod_tpu.runner.http_kv import (KVClient,
+                                                replica_endpoints_from_env)
         host = env_str("HOROVOD_HOSTNAME", socket.gethostname())
         local_rank = str(env_int("HOROVOD_LOCAL_RANK"))
         scrape_addr = "127.0.0.1" if host == "localhost" else host
         from horovod_tpu.common import kv_keys
-        KVClient(addr, kv_port).put_json(
+        KVClient(addr, kv_port,
+                 endpoints=replica_endpoints_from_env()).put_json(
             kv_keys.metrics_addr(host, local_rank),
             {"addr": scrape_addr, "port": exporter.port,
              "rank": env_int("HOROVOD_RANK")},
@@ -192,8 +194,10 @@ def _start_host_aggregator(exporter: MetricsExporter, base_port: int, log):
             # ephemeral ports), base-port arithmetic otherwise
             targets = []
             if kv_addr and kv_port:
-                from horovod_tpu.runner.http_kv import KVClient
-                client = KVClient(kv_addr, kv_port)
+                from horovod_tpu.runner.http_kv import (
+                    KVClient, replica_endpoints_from_env)
+                client = KVClient(kv_addr, kv_port,
+                                  endpoints=replica_endpoints_from_env())
                 for lr in range(local_size):
                     try:
                         info = client.get_json(
@@ -220,9 +224,11 @@ def _start_host_aggregator(exporter: MetricsExporter, base_port: int, log):
         log.info("per-host aggregator serving /agg.json on :%d",
                  exporter.port)
         if kv_addr and kv_port:
-            from horovod_tpu.runner.http_kv import KVClient
+            from horovod_tpu.runner.http_kv import (
+                KVClient, replica_endpoints_from_env)
             scrape_addr = "127.0.0.1" if host == "localhost" else host
-            KVClient(kv_addr, kv_port).put_json(
+            KVClient(kv_addr, kv_port,
+                     endpoints=replica_endpoints_from_env()).put_json(
                 kv_keys.agg_addr(host),
                 {"addr": scrape_addr, "port": exporter.port, "host": host,
                  "local_size": local_size},

@@ -153,9 +153,12 @@ def _parse_hostports(arg: str) -> List[dict]:
 
 
 def _kv_coords(args) -> Optional[Tuple[str, int]]:
-    """(host, port) of the rendezvous KV per --kv / the env, or None."""
+    """(host, port) of the rendezvous KV per --kv / the env, or None.
+    With a replicated ``--kv a:1,b:2,c:3`` list the first endpoint is
+    the coordinate (reads fail over via the endpoint list anyway)."""
     if args.kv:
-        host, _, port = args.kv.rpartition(":")
+        first = args.kv.split(",")[0].strip()
+        host, _, port = first.rpartition(":")
         try:
             return (host or "127.0.0.1", int(port))
         except ValueError:
@@ -167,6 +170,58 @@ def _kv_coords(args) -> Optional[Tuple[str, int]]:
         return (env_str("HOROVOD_RENDEZVOUS_ADDR"),
                 env_int("HOROVOD_RENDEZVOUS_PORT"))
     return None
+
+
+def _kv_endpoints(args) -> Optional[List[str]]:
+    """The replica endpoint list (for the KV health banner): a
+    comma-separated ``--kv``, else ``HOROVOD_KV_REPLICA_ENDPOINTS``."""
+    if args.kv and "," in args.kv:
+        return [e.strip() for e in args.kv.split(",") if e.strip()]
+    eps = env_str("HOROVOD_KV_REPLICA_ENDPOINTS")
+    if eps:
+        return [e.strip() for e in eps.split(",") if e.strip()]
+    return None
+
+
+def kv_health(endpoints: List[str]) -> dict:
+    """One ``/replica_status`` probe per replica, folded into the
+    banner doc: ``leader`` (replica id, None when no leaseholder
+    answered), its endpoint/epoch/lease age, per-shard WAL bytes, and
+    replica liveness (``up``/``total``)."""
+    from horovod_tpu.runner.replica_kv import replica_statuses
+    sts = replica_statuses(endpoints, timeout=1.0)
+    doc = {"up": sum(1 for st in sts.values() if st),
+           "total": len(endpoints), "leader": None}
+    for ep, st in sts.items():
+        if st and st.get("role") == "leader":
+            doc.update(leader=st.get("id"), endpoint=ep,
+                       epoch=st.get("epoch"),
+                       lease_age=st.get("lease_age", 0.0),
+                       lease_seconds=st.get("lease_seconds", 0.0),
+                       shards=st.get("shards", {}))
+            break
+    return doc
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}MB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KB"
+    return f"{int(n)}B"
+
+
+def render_kv_banner(h: dict) -> str:
+    if h["leader"] is None:
+        return (f"*** KV: NO LEADER reachable ({h['up']}/{h['total']} "
+                f"replicas up) — control plane suspect ***")
+    shards = " ".join(f"{s}:{_fmt_bytes(b)}"
+                      for s, b in sorted(h.get("shards", {}).items()))
+    return (f"KV: leader r{h['leader']}@{h['endpoint']} "
+            f"epoch {h['epoch']} "
+            f"lease {h['lease_age']:.1f}/{h['lease_seconds']:.1f}s "
+            f"replicas {h['up']}/{h['total']} up  WAL {shards}")
 
 
 def discover_targets(args) -> List[dict]:
@@ -648,7 +703,8 @@ class TopState:
     def __init__(self, targets: List[dict], serving: bool = False,
                  tune: bool = False, autoscale: bool = False,
                  kv: Optional[Tuple[str, int]] = None,
-                 rollup: bool = False):
+                 rollup: bool = False,
+                 kv_endpoints: Optional[List[str]] = None):
         self.targets = targets
         self.serving = serving
         self.tune = tune
@@ -656,6 +712,7 @@ class TopState:
         self.rollup = rollup
         self.stale_after = env_float("HOROVOD_AGG_STALE_SECONDS")
         self._kv = kv
+        self.kv_endpoints = kv_endpoints
         self._prev: Dict[int, Tuple] = {}
         self._last_rows: List[dict] = []
         self._last_scrape: Optional[float] = None  # monotonic
@@ -756,6 +813,12 @@ class TopState:
                       f"(driver/KV down?) — showing last scrape from "
                       f"{self.stale_age_seconds:.0f}s ago ***")
             text = banner + "\n" + text
+        if self.kv_endpoints:
+            try:
+                text = render_kv_banner(
+                    kv_health(self.kv_endpoints)) + "\n" + text
+            except Exception:  # noqa: BLE001 — banner is best-effort
+                pass
         return text
 
 
@@ -807,7 +870,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--targets",
                         help="comma-separated host:port metrics endpoints")
     parser.add_argument("--kv", help="rendezvous KV host:port publishing "
-                                     "the metrics_targets key")
+                                     "the metrics_targets key; a comma-"
+                                     "separated list names the whole "
+                                     "replica set (adds the KV health "
+                                     "banner)")
     parser.add_argument("--once", action="store_true",
                         help="print one snapshot and exit")
     parser.add_argument("--interval", type=float, default=None,
@@ -876,16 +942,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     if use_rollup:
         targets = agg_targets
 
+    kv_endpoints = _kv_endpoints(args)
     if not targets:
         print("hvd-top: no targets (pass --targets host:port, point --kv "
               "at the rendezvous KV, or set HOROVOD_METRICS_PORT)",
               file=sys.stderr)
         return 2
     state = TopState(targets, serving=args.serving, tune=args.tune,
-                     autoscale=args.autoscale, kv=kv, rollup=use_rollup)
+                     autoscale=args.autoscale, kv=kv, rollup=use_rollup,
+                     kv_endpoints=kv_endpoints)
 
     if args.once:
         rows, unreachable = state.refresh(window=False)
+        if kv_endpoints:
+            health = kv_health(kv_endpoints)
+            if health["leader"] is None:
+                print(f"hvd-top: control-plane suspect: no KV leader "
+                      f"reachable among {','.join(kv_endpoints)} "
+                      f"({health['up']}/{health['total']} replicas up)",
+                      file=sys.stderr)
+                return 1
         if not rows:
             print(f"hvd-top: none of {len(targets)} target(s) answered "
                   f"(workers down, or the driver/KV publishing "

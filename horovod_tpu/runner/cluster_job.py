@@ -142,9 +142,11 @@ def _negotiate_controller(env: Dict[str, str]) -> Dict[str, str]:
     controller/data ports on its own host — where its engine will bind
     moments later — and publishes them; everyone else polls. Returns the
     controller env entries."""
-    from horovod_tpu.runner.http_kv import KVClient
+    from horovod_tpu.runner.http_kv import (KVClient,
+                                            replica_endpoints_from_env)
     kv_addr = env["HOROVOD_RENDEZVOUS_ADDR"]
-    client = KVClient(kv_addr, int(env["HOROVOD_RENDEZVOUS_PORT"]))
+    client = KVClient(kv_addr, int(env["HOROVOD_RENDEZVOUS_PORT"]),
+                      endpoints=replica_endpoints_from_env())
     # the round scopes the key per execution: long-lived actor pools
     # (RayExecutor) negotiate afresh on every run(), and ranks >0 must not
     # read a previous run's — now closed — endpoint

@@ -116,7 +116,19 @@ class ElasticDriver:
         if kv_dir is None:
             kv_dir = env_str("HOROVOD_KV_DIR")
         self._kv_dir = kv_dir
-        self._kv = KVServer(port=kv_port, kv_dir=kv_dir).start()
+        # Replicated control plane (ISSUE 19): when the supervisor runs a
+        # KV replica set, the driver attaches to it through a failover
+        # handle instead of embedding the server — the KV now outlives
+        # the driver, and a KV-side election bumping the control epoch
+        # is adopted (same incarnation) rather than treated as a rival.
+        replica_eps = env_str("HOROVOD_KV_REPLICA_ENDPOINTS")
+        if replica_eps:
+            from horovod_tpu.runner.replica_kv import ReplicatedKVHandle
+            self._kv = ReplicatedKVHandle(
+                [e.strip() for e in replica_eps.split(",") if e.strip()],
+                epoch_adopted=self._adopt_control_epoch).start()
+        else:
+            self._kv = KVServer(port=kv_port, kv_dir=kv_dir).start()
         self._epoch = self._kv.epoch
         self._registry = WorkerStateRegistry(self._kv)
         self._generation = -1
@@ -212,6 +224,15 @@ class ElasticDriver:
             value = dict(value)
             value.setdefault("epoch", self._epoch)
         self._kv.put_json(key, value, epoch=self._epoch)
+
+    def _adopt_control_epoch(self, epoch: int):
+        """Replica-set callback: a KV leader election bumped the control
+        epoch under this SAME driver incarnation (the handle checked the
+        ``control_epoch`` ownership record). Adopt it so later driver
+        writes claim the current epoch instead of fencing themselves."""
+        self._epoch = max(self._epoch, int(epoch))
+        if self._autoscaler is not None:
+            self._autoscaler.epoch = self._epoch
 
     @property
     def epoch(self) -> int:

@@ -97,10 +97,28 @@ def driver_main(args_path: str) -> int:
 
 def run_supervised(args) -> int:
     """The launcher-side supervisor loop (``run_elastic`` dispatches here
-    when ``HOROVOD_KV_DIR`` + ``HOROVOD_DRIVER_SUPERVISE`` are set)."""
+    when ``HOROVOD_KV_DIR`` + ``HOROVOD_DRIVER_SUPERVISE`` are set).
+
+    With ``HOROVOD_KV_REPLICAS >= 2`` the supervisor also owns the KV
+    replica fleet: N ``replica_kv`` subprocesses on pre-allocated ports,
+    respawned individually when they die. The driver (and through it the
+    workers) get the endpoint list via ``HOROVOD_KV_REPLICA_ENDPOINTS``
+    and attach through failover clients — a SIGKILLed KV leader costs
+    one election, not the control plane."""
     from horovod_tpu.runner.launch import _engine_env, free_port
     kv_dir = env_str("HOROVOD_KV_DIR")
     os.makedirs(kv_dir, exist_ok=True)
+    replicas = env_int("HOROVOD_KV_REPLICAS")
+    endpoints: Optional[List[str]] = None
+    if replicas >= 2:
+        ports = [free_port() for _ in range(replicas)]
+        endpoints = [f"127.0.0.1:{p}" for p in ports]
+        os.environ["HOROVOD_KV_REPLICA_ENDPOINTS"] = ",".join(endpoints)
+        kv_port = ports[0]  # workers' seed endpoint; failover covers the rest
+    else:
+        # every driver incarnation must rebind the SAME KV port — the
+        # workers' HOROVOD_RENDEZVOUS_PORT is fixed at spawn time
+        kv_port = free_port()
     payload = {
         "min_np": args.min_np or args.num_proc,
         "max_np": args.max_np or args.num_proc or args.min_np,
@@ -110,24 +128,62 @@ def run_supervised(args) -> int:
         "reset_limit": args.reset_limit,
         "verbose": args.verbose,
         "start_timeout": args.start_timeout,
-        # every driver incarnation must rebind the SAME KV port — the
-        # workers' HOROVOD_RENDEZVOUS_PORT is fixed at spawn time
-        "kv_port": free_port(),
+        "kv_port": kv_port,
     }
     args_path = os.path.join(kv_dir, _ARGS_FILE)
     with open(args_path, "w") as f:
         json.dump(payload, f)
     return _supervise([sys.executable, "-m",
                        "horovod_tpu.runner.elastic.supervisor",
-                       "--driver", args_path], kv_dir)
+                       "--driver", args_path], kv_dir,
+                      replica_endpoints=endpoints)
 
 
-def _supervise(cmd: List[str], kv_dir: str) -> int:
+class _ReplicaFleet:
+    """The supervisor's KV replica subprocesses: spawn all, respawn any
+    that die (each replays its own WAL and rejoins as a follower —
+    rejoin resync repairs whatever suffix it lost or never committed)."""
+
+    def __init__(self, endpoints: List[str], kv_dir: str):
+        from horovod_tpu.runner.replica_kv import spawn_replica
+        self._spawn = spawn_replica
+        self.endpoints = endpoints
+        self.kv_dir = kv_dir
+        self.procs: dict = {}
+        for i in range(len(endpoints)):
+            self.procs[i] = self._spawn(i, endpoints, kv_dir)
+
+    def reap_and_respawn(self):
+        for i, p in list(self.procs.items()):
+            rc = p.poll()
+            if rc is not None:
+                _logger.warning(
+                    "kv replica %d died (exit %s); respawning: %s", i, rc,
+                    json.dumps({"event": "kv_replica_respawn",
+                                "replica": i, "exit_code": rc}))
+                self.procs[i] = self._spawn(i, self.endpoints, self.kv_dir)
+
+    def stop(self):
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 5.0
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _supervise(cmd: List[str], kv_dir: str,
+               replica_endpoints: Optional[List[str]] = None) -> int:
     limit = env_int("HOROVOD_DRIVER_RESTART_LIMIT")
     backoff = env_float("HOROVOD_DRIVER_RESTART_BACKOFF_SECONDS")
     restarts = 0
     stopping = {"sig": None}
     proc: Optional[subprocess.Popen] = None
+    fleet = _ReplicaFleet(replica_endpoints, kv_dir) \
+        if replica_endpoints else None
 
     def forward(sig, _frame):
         stopping["sig"] = sig
@@ -146,8 +202,17 @@ def _supervise(cmd: List[str], kv_dir: str) -> int:
                 os.remove(_done_path(kv_dir))
             except OSError:
                 pass
-            proc = subprocess.Popen(cmd)  # stdout/stderr inherited
-            rc = proc.wait()
+            from horovod_tpu.runner.replica_kv import die_with_parent
+            # stdout/stderr inherited; PDEATHSIG so a SIGKILLed
+            # supervisor can't leave an orphaned driver holding the
+            # launcher's pipes open
+            proc = subprocess.Popen(cmd, preexec_fn=die_with_parent)
+            while True:
+                try:
+                    rc = proc.wait(timeout=1.0 if fleet else None)
+                    break
+                except subprocess.TimeoutExpired:
+                    fleet.reap_and_respawn()
             done_rc = _read_done(kv_dir, proc.pid)
             if done_rc is not None:
                 return done_rc
@@ -168,6 +233,8 @@ def _supervise(cmd: List[str], kv_dir: str) -> int:
             if backoff > 0:
                 time.sleep(backoff)
     finally:
+        if fleet is not None:
+            fleet.stop()
         for sig, handler in prev.items():
             try:
                 signal.signal(sig, handler)

@@ -45,9 +45,14 @@ _logger = get_logger("elastic.worker")
 
 
 def kv_client():
-    from horovod_tpu.runner.http_kv import KVClient
+    # with a replicated control plane the worker fails over across the
+    # whole replica set (follows 307 leader redirects, rotates on
+    # NotLeader/refused) instead of pinning the one rendezvous endpoint
+    from horovod_tpu.runner.http_kv import (KVClient,
+                                            replica_endpoints_from_env)
     return KVClient(env_str("HOROVOD_RENDEZVOUS_ADDR"),
-                    env_int("HOROVOD_RENDEZVOUS_PORT"))
+                    env_int("HOROVOD_RENDEZVOUS_PORT"),
+                    endpoints=replica_endpoints_from_env())
 
 
 def is_elastic_worker() -> bool:

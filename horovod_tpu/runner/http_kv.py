@@ -25,25 +25,60 @@ elastic protocol rides (rendezvous, drain announcements, shard handoffs,
   pre-crash driver cannot mutate the store a recovered driver now owns.
   Epoch-less writes (worker READY records, heartbeats, drain announces)
   are never fenced — workers do not claim driver authority.
+
+Replicated control plane (ISSUE 19) adds three mechanisms here (the
+replica roles themselves live in ``runner/replica_kv.py``):
+
+- **Prefix-sharded WALs** — a durable store keeps one WAL + snapshot per
+  ``kv_keys`` shard (``core`` keeps the legacy ``wal.log`` /
+  ``snapshot.json`` filenames, so pre-sharding directories replay
+  unchanged); 1024-rank heartbeat appends no longer serialize behind
+  resize records, and conformance audits each shard independently. Every
+  logged op carries a server-global monotonic sequence ``"s"`` so the
+  cross-shard commit order stays reconstructible.
+- **Per-op sequence tokens** — mutations may carry ``X-Hvd-Client`` /
+  ``X-Hvd-Seq`` headers; the server drops an exact ``(client, seq)``
+  replay it has already applied. This is what makes a client retry after
+  a timed-out-but-committed write safe (the PR-19 double-apply bugfix),
+  and the tokens ride the WAL (``"c"``/``"n"``) so dedupe survives
+  restarts and leader failover.
+- **Client failover** — :class:`KVClient` optionally takes a replica
+  endpoint list: it follows leader redirects (307 + ``X-Hvd-Leader``
+  hint), rotates to the next replica on NotLeader/connection-refused,
+  and keeps the same sequence token across retries of one logical op so
+  failover never double-applies.
 """
 
 from __future__ import annotations
 
 import base64
+import hashlib
+import itertools
 import json
 import os
 import random
 import threading
 import time
+import uuid
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 from urllib import error as urlerror
+from urllib import parse as urlparse
 from urllib import request as urlrequest
+
+from horovod_tpu.common import kv_keys
 
 # HTTP header a writer uses to claim a control epoch; strictly-older
 # claims are fenced with 409 + a JSON body naming both epochs.
 EPOCH_HEADER = "X-Hvd-Epoch"
+# per-op idempotency token: a stable client id + a per-client monotonic
+# sequence number. A retried mutation reuses its token; the server drops
+# exact (client, seq) replays it already applied.
+CLIENT_HEADER = "X-Hvd-Client"
+SEQ_HEADER = "X-Hvd-Seq"
+# leader hint on a 307 redirect from a follower replica ("host:port")
+LEADER_HEADER = "X-Hvd-Leader"
 
 _WAL_FILE = "wal.log"
 _SNAPSHOT_FILE = "snapshot.json"
@@ -51,6 +86,20 @@ _EPOCH_FILE = "epoch"
 # sanity ceiling on a single WAL record (a corrupt length header must not
 # make replay try to allocate gigabytes)
 _MAX_RECORD_BYTES = 64 << 20
+# dedupe window: exact (client, seq) pairs remembered, FIFO-evicted. A
+# retry lands within seconds of its original; 8192 mutations of headroom
+# is orders of magnitude more than that window holds.
+_MAX_TOKENS = 8192
+
+
+def shard_wal_file(shard: str) -> str:
+    """WAL filename for one shard — ``core`` keeps the legacy name so
+    pre-sharding kv_dirs replay (and old tooling keeps working)."""
+    return _WAL_FILE if shard == "core" else f"wal-{shard}.log"
+
+
+def shard_snapshot_file(shard: str) -> str:
+    return _SNAPSHOT_FILE if shard == "core" else f"snapshot-{shard}.json"
 
 
 class StaleEpochError(RuntimeError):
@@ -114,21 +163,26 @@ class _Wal:
     written; replay tolerates exactly that (truncated tail, bad CRC) by
     stopping at the last complete record and truncating the garbage."""
 
-    def __init__(self, kv_dir: str, snapshot_bytes: int):
+    def __init__(self, kv_dir: str, snapshot_bytes: int,
+                 wal_file: str = _WAL_FILE,
+                 snap_file: str = _SNAPSHOT_FILE):
         self.dir = kv_dir
         self.snapshot_bytes = snapshot_bytes
         os.makedirs(kv_dir, exist_ok=True)
-        self.wal_path = os.path.join(kv_dir, _WAL_FILE)
-        self.snap_path = os.path.join(kv_dir, _SNAPSHOT_FILE)
+        self.wal_path = os.path.join(kv_dir, wal_file)
+        self.snap_path = os.path.join(kv_dir, snap_file)
         self._f = None
         self.wal_bytes = 0
         self.replay_seconds = 0.0
+        self.max_seq = 0              # highest "s" stamp seen (replay+snap)
+        self.tokens: List[Tuple[str, int]] = []  # (client, seq) in order
 
     # -- replay ---------------------------------------------------------------
 
-    def replay(self) -> Dict[str, bytes]:
+    def replay(self, into: Optional[Dict[str, bytes]] = None) \
+            -> Dict[str, bytes]:
         t0 = time.perf_counter()
-        store: Dict[str, bytes] = {}
+        store: Dict[str, bytes] = {} if into is None else into
         snap = self._load_snapshot()
         if snap:
             store.update(snap)
@@ -153,6 +207,10 @@ class _Wal:
             except ValueError:
                 break
             self._apply(store, op)
+            if isinstance(op.get("s"), int):
+                self.max_seq = max(self.max_seq, op["s"])
+            if op.get("c") is not None and isinstance(op.get("n"), int):
+                self.tokens.append((str(op["c"]), op["n"]))
             off += 8 + length
             good_end = off
         if good_end < len(data):
@@ -181,6 +239,11 @@ class _Wal:
             return {}
         try:
             doc = json.loads(raw)
+            if isinstance(doc.get("seq"), int):
+                # compaction truncates the WAL, so the snapshot carries
+                # the high-water "s" stamp — the global sequence must
+                # stay monotone across restarts for cross-shard merges
+                self.max_seq = max(self.max_seq, doc["seq"])
             return {k: base64.b64decode(v)
                     for k, v in doc.get("store", {}).items()}
         except (ValueError, TypeError, KeyError):
@@ -200,16 +263,22 @@ class _Wal:
     # -- append + compaction (caller holds the server lock) -------------------
 
     def append(self, op: dict, store: Dict[str, bytes]):
+        self.append_raw(op)
+        if self.wal_bytes > self.snapshot_bytes:
+            self.compact(store)
+
+    def append_raw(self, op: dict):
+        """Append without the compaction check — the sharded WAL manager
+        compacts itself with a per-shard store slice."""
         payload = json.dumps(op).encode()
         crc = zlib.crc32(payload) & 0xFFFFFFFF
         self._f.write(len(payload).to_bytes(4, "little") +
                       crc.to_bytes(4, "little") + payload)
         self._f.flush()
         self.wal_bytes += 8 + len(payload)
-        if self.wal_bytes > self.snapshot_bytes:
-            self.compact(store)
 
-    def compact(self, store: Dict[str, bytes]):
+    def compact(self, store: Dict[str, bytes],
+                seq: Optional[int] = None):
         """Write the full store as a snapshot (write-then-rename, so a
         crash mid-compaction leaves the previous snapshot + full WAL —
         replay of both is idempotent), then start a fresh WAL."""
@@ -217,6 +286,8 @@ class _Wal:
         doc = {"store": {k: base64.b64encode(v).decode()
                          for k, v in store.items()},
                "ts": time.time()}
+        if seq is not None:
+            doc["seq"] = int(seq)
         with open(tmp, "w") as f:
             json.dump(doc, f)
             f.flush()
@@ -251,6 +322,89 @@ class _Wal:
             pass
 
 
+class _ShardedWal:
+    """One :class:`_Wal` per ``kv_keys`` shard, behind the same append/
+    replay surface. Appends route by key (``kv_keys.shard_for_key``), so
+    high-rate heartbeat records never share a log file — or a compaction
+    stall — with core resize records. The in-memory store stays unified;
+    only the durability layer shards. Replay order (core first, then the
+    others into the same dict) keeps pre-sharding directories correct:
+    a legacy ``wal.log`` may hold any key, and the shard logs replay
+    over it."""
+
+    def __init__(self, kv_dir: str, snapshot_bytes: int):
+        os.makedirs(kv_dir, exist_ok=True)
+        self.dir = kv_dir
+        self._wals: Dict[str, _Wal] = {
+            shard: _Wal(kv_dir, snapshot_bytes,
+                        wal_file=shard_wal_file(shard),
+                        snap_file=shard_snapshot_file(shard))
+            for shard in kv_keys.SHARDS}
+        self.max_seq = 0
+        self.tokens: List[Tuple[str, int]] = []
+
+    @staticmethod
+    def shard_of(op: dict) -> str:
+        if "k" in op:
+            return kv_keys.shard_for_key(op["k"])
+        return kv_keys.shard_for_prefix(op.get("p", ""))
+
+    def replay(self) -> Dict[str, bytes]:
+        store: Dict[str, bytes] = {}
+        stamped = []
+        for shard in kv_keys.SHARDS:
+            w = self._wals[shard]
+            w.replay(into=store)
+            self.max_seq = max(self.max_seq, w.max_seq)
+            stamped.extend(w.tokens)
+        # dedupe-table rebuild order across shards doesn't matter: the
+        # table is an exact-match set, not a high-water mark
+        self.tokens = stamped
+        return store
+
+    def append(self, op: dict, store: Dict[str, bytes]):
+        shard = self.shard_of(op)
+        w = self._wals[shard]
+        if isinstance(op.get("s"), int):
+            self.max_seq = max(self.max_seq, op["s"])
+        w.append_raw(op)
+        if w.wal_bytes > w.snapshot_bytes:
+            w.compact({k: v for k, v in store.items()
+                       if kv_keys.shard_for_key(k) == shard},
+                      seq=self.max_seq)
+
+    def compact_all(self, store: Dict[str, bytes]):
+        """Rewrite every shard's snapshot from ``store`` and truncate all
+        WALs — the resync path uses this to discard a diverged suffix."""
+        for shard, w in self._wals.items():
+            w.compact({k: v for k, v in store.items()
+                       if kv_keys.shard_for_key(k) == shard},
+                      seq=self.max_seq)
+
+    def shard_bytes(self) -> Dict[str, int]:
+        return {shard: w.wal_bytes for shard, w in self._wals.items()}
+
+    @property
+    def wal_bytes(self) -> int:
+        return sum(w.wal_bytes for w in self._wals.values())
+
+    @property
+    def replay_seconds(self) -> float:
+        return sum(w.replay_seconds for w in self._wals.values())
+
+    def close(self):
+        for w in self._wals.values():
+            w.close()
+
+    # the control epoch stays a single dir-level file — it fences the
+    # whole store, not one shard
+    def load_epoch(self) -> int:
+        return self._wals["core"].load_epoch()
+
+    def store_epoch(self, epoch: int):
+        self._wals["core"].store_epoch(epoch)
+
+
 class KVServer:
     """Threaded HTTP KV server (launcher side), optionally durable.
 
@@ -262,22 +416,36 @@ class KVServer:
     at least one key — the signal the elastic driver uses to resume an
     interrupted job instead of cold-starting generation 0."""
 
+    _bump_epoch_on_start = True
+
     def __init__(self, port: int = 0, kv_dir: Optional[str] = None,
                  snapshot_bytes: Optional[int] = None):
         self._store: Dict[str, bytes] = {}
         self._lock = threading.Lock()
-        self._wal: Optional[_Wal] = None
+        self._wal: Optional[_ShardedWal] = None
         self.epoch = 0
         self.recovered = False
+        # exact-match idempotency window: (client, seq) pairs already
+        # applied, FIFO-evicted (dict keeps insertion order)
+        self._applied: Dict[Tuple[str, int], bool] = {}
+        self._seq = 0  # server-global op sequence ("s" WAL stamp)
         if kv_dir:
             if snapshot_bytes is None:
                 from horovod_tpu.common.env_registry import env_int
                 snapshot_bytes = env_int("HOROVOD_KV_SNAPSHOT_BYTES")
-            self._wal = _Wal(kv_dir, snapshot_bytes)
+            self._wal = _ShardedWal(kv_dir, snapshot_bytes)
             self._store = self._wal.replay()
             self.recovered = bool(self._store)
-            self.epoch = self._wal.load_epoch() + 1
-            self._wal.store_epoch(self.epoch)
+            # a restarting standalone KV is a new driver incarnation →
+            # bump; a restarting *replica* must NOT outrun its leader's
+            # term (ReplicaKVServer overrides the class attr)
+            self.epoch = self._wal.load_epoch() + \
+                (1 if self._bump_epoch_on_start else 0)
+            if self._bump_epoch_on_start:
+                self._wal.store_epoch(self.epoch)
+            self._seq = self._wal.max_seq
+            for tok in self._wal.tokens[-_MAX_TOKENS:]:
+                self._applied[tok] = True
             self._export_metrics()
         server = self
 
@@ -292,6 +460,14 @@ class KVServer:
                 except ValueError:
                     return None
 
+            def _token(self) -> Optional[Tuple[str, int]]:
+                cid = self.headers.get(CLIENT_HEADER)
+                raw = self.headers.get(SEQ_HEADER)
+                try:
+                    return (cid, int(raw)) if cid and raw else None
+                except ValueError:
+                    return None
+
             def _send_fenced(self, e: StaleEpochError):
                 body = json.dumps({
                     "error": "stale_epoch",
@@ -302,12 +478,22 @@ class KVServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_json(self, doc, status: int = 200):
+                body = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_PUT(self):
+                if server._route(self, "PUT"):
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 try:
                     server._put(self.path.lstrip("/"), body,
-                                epoch=self._claimed_epoch())
+                                epoch=self._claimed_epoch(),
+                                token=self._token())
                 except StaleEpochError as e:
                     self._send_fenced(e)
                     return
@@ -315,6 +501,17 @@ class KVServer:
                 self.end_headers()
 
             def do_GET(self):
+                if server._route(self, "GET"):
+                    return
+                path, _, query = self.path.partition("?")
+                if path == "/replica_status":
+                    self._send_json(server._replica_status())
+                    return
+                if path == "/_kv/keys":
+                    q = urlparse.parse_qs(query)
+                    prefix = q.get("prefix", [""])[0]
+                    self._send_json(server.keys(prefix))
+                    return
                 with server._lock:
                     val = server._store.get(self.path.lstrip("/"))
                 if val is None:
@@ -326,10 +523,27 @@ class KVServer:
                 self.end_headers()
                 self.wfile.write(val)
 
+            def do_POST(self):
+                if server._route(self, "POST"):
+                    return
+                self.send_response(404)
+                self.end_headers()
+
             def do_DELETE(self):
+                if server._route(self, "DELETE"):
+                    return
+                path, _, query = self.path.partition("?")
                 try:
-                    existed = server.delete(self.path.lstrip("/"),
-                                            epoch=self._claimed_epoch())
+                    if path == "/_kv/prefix":
+                        q = urlparse.parse_qs(query)
+                        server.delete_prefix(q.get("p", [""])[0],
+                                             epoch=self._claimed_epoch(),
+                                             token=self._token())
+                        existed = True
+                    else:
+                        existed = server.delete(self.path.lstrip("/"),
+                                                epoch=self._claimed_epoch(),
+                                                token=self._token())
                 except StaleEpochError as e:
                     self._send_fenced(e)
                     return
@@ -340,20 +554,73 @@ class KVServer:
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    # -- routing/extension hooks (the replica server overrides these) --------
+
+    def _route(self, handler, method: str) -> bool:
+        """Give a subclass first look at an HTTP request. Return True when
+        the request was fully handled (response sent). The base server
+        handles everything itself."""
+        return False
+
+    def _replica_status(self) -> dict:
+        """The ``/replica_status`` document. An unreplicated KV reports
+        itself as a single always-leader replica so hvd-top's KV-health
+        banner works against either deployment shape."""
+        with self._lock:
+            return {"id": 0, "role": "leader", "leader": 0,
+                    "epoch": self.epoch, "seq": self._seq,
+                    "lease_age": 0.0, "replicas": 1,
+                    "peers": {},
+                    "shards": (self._wal.shard_bytes()
+                               if self._wal is not None else {}),
+                    "store_hash": self._store_hash_locked()}
+
+    def _store_hash_locked(self) -> str:
+        """Order-independent digest of the full store — the chaos soak's
+        byte-identical-across-replicas oracle."""
+        h = hashlib.sha256()
+        for k in sorted(self._store):
+            h.update(k.encode())
+            h.update(b"\x00")
+            h.update(self._store[k])
+            h.update(b"\x01")
+        return h.hexdigest()
+
     # -- durability internals -------------------------------------------------
 
-    def _log_op(self, op: dict, epoch: Optional[int] = None):
+    def _log_op(self, op: dict, epoch: Optional[int] = None,
+                token: Optional[Tuple[str, int]] = None):
         """Caller holds self._lock. ``epoch`` (the writer's admitted
         control-epoch claim, when one was made) is recorded on the WAL
         op as ``"e"`` — replay ignores it, but the conformance checker
         (``horovod_tpu/verify/conformance.py``) replays the log against
         the epoch-monotonicity rule: a regression in the recorded claims
-        is split-brain evidence."""
+        is split-brain evidence. ``"s"`` is the server-global sequence
+        (cross-shard merge order); ``"c"``/``"n"`` persist the client's
+        idempotency token so the dedupe window survives restart and
+        leader failover."""
+        self._seq += 1
         if self._wal is not None:
+            op = dict(op, s=self._seq)
             if epoch is not None:
-                op = dict(op, e=int(epoch))
+                op["e"] = int(epoch)
+            if token is not None:
+                op["c"], op["n"] = token[0], int(token[1])
             self._wal.append(op, self._store)
             self._export_metrics()
+
+    def _dedup_locked(self, token: Optional[Tuple[str, int]]) -> bool:
+        """True when this exact (client, seq) token was already applied —
+        the mutation is a retry of a committed op and must be dropped
+        (acked as success, applied zero more times)."""
+        if token is None:
+            return False
+        if token in self._applied:
+            return True
+        while len(self._applied) >= _MAX_TOKENS:
+            self._applied.pop(next(iter(self._applied)))
+        self._applied[token] = True
+        return False
 
     def _export_metrics(self):
         try:
@@ -395,14 +662,17 @@ class KVServer:
         except Exception:  # noqa: BLE001 — logging must not mask the 409
             pass
 
-    def _put(self, key: str, body: bytes, epoch: Optional[int] = None):
+    def _put(self, key: str, body: bytes, epoch: Optional[int] = None,
+             token: Optional[Tuple[str, int]] = None):
         try:
             with self._lock:
                 self._check_epoch_locked(epoch)
+                if self._dedup_locked(token):
+                    return
                 self._store[key] = body
                 self._log_op({"op": "put", "k": key,
                               "v": base64.b64encode(body).decode()},
-                             epoch=epoch)
+                             epoch=epoch, token=token)
         except StaleEpochError as e:
             self._log_stale(e)
             raise
@@ -440,30 +710,38 @@ class KVServer:
             val = self._store.get(key)
         return json.loads(val) if val is not None else None
 
-    def delete(self, key: str, epoch: Optional[int] = None) -> bool:
+    def delete(self, key: str, epoch: Optional[int] = None,
+               token: Optional[Tuple[str, int]] = None) -> bool:
         try:
             with self._lock:
                 self._check_epoch_locked(epoch)
+                if self._dedup_locked(token):
+                    return True  # the original delete committed
                 existed = self._store.pop(key, None) is not None
                 if existed:
-                    self._log_op({"op": "del", "k": key}, epoch=epoch)
+                    self._log_op({"op": "del", "k": key}, epoch=epoch,
+                                 token=token)
                 return existed
         except StaleEpochError as e:
             self._log_stale(e)
             raise
 
-    def delete_prefix(self, prefix: str, epoch: Optional[int] = None):
+    def delete_prefix(self, prefix: str, epoch: Optional[int] = None,
+                      token: Optional[Tuple[str, int]] = None):
         """Drop every key under a prefix (generation GC: old topologies,
         worker states, go/reset records would otherwise accumulate for the
         life of an elastic job)."""
         try:
             with self._lock:
                 self._check_epoch_locked(epoch)
+                if self._dedup_locked(token):
+                    return
                 doomed = [k for k in self._store if k.startswith(prefix)]
                 for k in doomed:
                     del self._store[k]
                 if doomed:
-                    self._log_op({"op": "delp", "p": prefix}, epoch=epoch)
+                    self._log_op({"op": "delp", "p": prefix}, epoch=epoch,
+                                 token=token)
         except StaleEpochError as e:
             self._log_stale(e)
             raise
@@ -475,21 +753,88 @@ class KVServer:
             return [k for k in self._store if k.startswith(prefix)]
 
 
+class NotLeaderError(ConnectionError):
+    """Internal retry signal: the contacted replica cannot take the write
+    (follower redirect or no leader elected yet). Subclasses
+    ConnectionError so the shared retry loop treats it as transient —
+    the client has already rotated to its next candidate endpoint."""
+
+
+def replica_endpoints_from_env() -> Optional[List[str]]:
+    """The ``HOROVOD_KV_REPLICA_ENDPOINTS`` list, or None when the
+    control plane is unreplicated. Every worker-side KV client should
+    pass this as ``endpoints=`` — a client pinned to one replica keeps
+    working only until the first leader change."""
+    from horovod_tpu.common.env_registry import env_str
+    raw = env_str("HOROVOD_KV_REPLICA_ENDPOINTS")
+    eps = [e.strip() for e in (raw or "").split(",") if e.strip()]
+    return eps or None
+
+
 class KVClient:
     """Worker-side client (reference: runner/http/http_client.py).
 
     ``epoch`` (optional) is attached to every mutation as the control-
     epoch claim; a fenced 409 raises :class:`StaleEpochError` so a stale
     driver fails loudly instead of silently mutating a store a recovered
-    driver owns."""
+    driver owns.
 
-    def __init__(self, addr: str, port: int, epoch: Optional[int] = None):
-        self._base = f"http://{addr}:{port}/"
+    ``endpoints`` (optional, ISSUE 19) is the replica endpoint list
+    (``host:port`` strings). Mutations follow leader redirects (307 +
+    ``X-Hvd-Leader``) and rotate to the next replica on NotLeader or
+    connection-refused, all inside the caller's existing attempt/deadline
+    budget. Every mutation carries a per-op sequence token generated
+    ONCE per logical op — a retry (failover or timed-out-but-committed
+    write) reuses it, so the server applies the op at most once."""
+
+    def __init__(self, addr: str, port: int, epoch: Optional[int] = None,
+                 endpoints: Optional[List[str]] = None):
+        eps = [str(e).strip() for e in (endpoints or []) if str(e).strip()]
+        primary = f"{addr}:{port}"
+        if primary not in eps:
+            eps.insert(0, primary)
+        self._endpoints = eps
+        self._active = 0
         self.epoch = epoch
+        self._cid = uuid.uuid4().hex[:12]
+        self._op_seq = itertools.count(1)
 
-    def _headers(self) -> dict:
-        return {EPOCH_HEADER: str(self.epoch)} \
-            if self.epoch is not None else {}
+    @property
+    def _base(self) -> str:
+        return f"http://{self._endpoints[self._active]}/"
+
+    def _rotate(self):
+        self._active = (self._active + 1) % len(self._endpoints)
+
+    def _next_token(self) -> Tuple[str, int]:
+        return (self._cid, next(self._op_seq))
+
+    def _headers(self, token: Optional[Tuple[str, int]] = None) -> dict:
+        h: Dict[str, str] = {}
+        if self.epoch is not None:
+            h[EPOCH_HEADER] = str(self.epoch)
+        if token is not None:
+            h[CLIENT_HEADER] = token[0]
+            h[SEQ_HEADER] = str(token[1])
+        return h
+
+    def _mutation_http_error(self, e: urlerror.HTTPError):
+        """Classify a mutation's HTTP error: follow a leader redirect,
+        rotate on no-leader, surface a fence, re-raise the rest."""
+        if e.code == 307:
+            hint = e.headers.get(LEADER_HEADER)
+            if hint and hint in self._endpoints:
+                self._active = self._endpoints.index(hint)
+            elif hint:
+                self._endpoints.append(hint)
+                self._active = len(self._endpoints) - 1
+            else:
+                self._rotate()
+            raise NotLeaderError(f"redirected to leader {hint}") from e
+        if e.code == 503:
+            self._rotate()
+            raise NotLeaderError("replica has no leader") from e
+        self._raise_if_fenced(e)
 
     @staticmethod
     def _raise_if_fenced(e: urlerror.HTTPError):
@@ -512,6 +857,9 @@ class KVClient:
         (accept-but-never-respond) driver wedge a heartbeat/handoff
         thread for attempts x timeout."""
         body = json.dumps(value).encode()
+        token = self._next_token()  # ONE token per logical op: retries
+        # (failover, timed-out-but-committed) reuse it, so the server
+        # applies the mutation at most once
         abs_deadline = time.monotonic() + deadline \
             if deadline is not None else None
 
@@ -520,11 +868,15 @@ class KVClient:
             if abs_deadline is not None:
                 per = max(0.05, min(per, abs_deadline - time.monotonic()))
             req = urlrequest.Request(self._base + key, data=body,
-                                     method="PUT", headers=self._headers())
+                                     method="PUT",
+                                     headers=self._headers(token))
             try:
                 urlrequest.urlopen(req, timeout=per)
             except urlerror.HTTPError as e:
-                self._raise_if_fenced(e)
+                self._mutation_http_error(e)
+            except (urlerror.URLError, ConnectionError, OSError):
+                self._rotate()
+                raise
 
         _retrying(attempt, attempts, backoff, deadline=abs_deadline)
 
@@ -542,23 +894,85 @@ class KVClient:
                                         timeout=per) as resp:
                     return json.loads(resp.read())
             except urlerror.HTTPError as e:
-                if e.code != 404:
+                if e.code in (503, 307):
+                    self._rotate()  # replica mid-election: try a peer
+                elif e.code != 404:
                     raise
             except (urlerror.URLError, ConnectionError, OSError):
                 # unreachable, reset, or hung past the per-attempt
                 # timeout (a raw socket TimeoutError when the server
                 # accepts but never responds) — poll until the window
-                # closes
-                pass
+                # closes (rotating across replicas when we have them)
+                self._rotate()
             if time.monotonic() >= deadline:
                 return None
             time.sleep(poll_interval)
 
-    def delete(self, key: str, timeout: float = 10.0):
-        req = urlrequest.Request(self._base + key, method="DELETE",
-                                 headers=self._headers())
+    def delete(self, key: str, timeout: float = 10.0, attempts: int = 3,
+               backoff: float = 0.1):
+        token = self._next_token()
+
+        def attempt():
+            req = urlrequest.Request(self._base + key, method="DELETE",
+                                     headers=self._headers(token))
+            try:
+                urlrequest.urlopen(req, timeout=timeout)
+            except urlerror.HTTPError as e:
+                if e.code in (404, 200):
+                    return
+                self._mutation_http_error(e)
+            except (urlerror.URLError, ConnectionError, OSError):
+                self._rotate()
+                raise
+
+        _retrying(attempt, attempts, backoff)
+
+    def delete_prefix(self, prefix: str, timeout: float = 10.0,
+                      attempts: int = 3, backoff: float = 0.1):
+        token = self._next_token()
+        url = "_kv/prefix?" + urlparse.urlencode({"p": prefix})
+
+        def attempt():
+            req = urlrequest.Request(self._base + url, method="DELETE",
+                                     headers=self._headers(token))
+            try:
+                urlrequest.urlopen(req, timeout=timeout)
+            except urlerror.HTTPError as e:
+                if e.code == 404:
+                    return
+                self._mutation_http_error(e)
+            except (urlerror.URLError, ConnectionError, OSError):
+                self._rotate()
+                raise
+
+        _retrying(attempt, attempts, backoff)
+
+    def keys(self, prefix: str = "", timeout: float = 5.0,
+             attempts: int = 3, backoff: float = 0.1) -> List[str]:
+        url = "_kv/keys?" + urlparse.urlencode({"prefix": prefix})
+
+        def attempt():
+            try:
+                with urlrequest.urlopen(self._base + url,
+                                        timeout=timeout) as resp:
+                    return json.loads(resp.read())
+            except urlerror.HTTPError as e:
+                if e.code in (503, 307):
+                    self._rotate()
+                    raise NotLeaderError("replica mid-election") from e
+                raise
+            except (urlerror.URLError, ConnectionError, OSError):
+                self._rotate()
+                raise
+
+        return _retrying(attempt, attempts, backoff)
+
+    def replica_status(self, timeout: float = 2.0) -> Optional[dict]:
+        """Best-effort ``/replica_status`` probe of the active endpoint
+        (None when unreachable)."""
         try:
-            urlrequest.urlopen(req, timeout=timeout)
-        except urlerror.HTTPError as e:
-            if e.code == 409:
-                self._raise_if_fenced(e)
+            with urlrequest.urlopen(self._base + "replica_status",
+                                    timeout=timeout) as resp:
+                return json.loads(resp.read())
+        except (urlerror.URLError, ConnectionError, OSError, ValueError):
+            return None

@@ -416,6 +416,12 @@ def worker_env(slot, controller_addr, controller_port, data_port,
         env["HOROVOD_ELASTIC"] = "1"
         env["HOROVOD_ELASTIC_GENERATION"] = str(generation)
         env["HOROVOD_CONTROL_EPOCH"] = str(epoch)
+    # replicated control plane: hand workers the full replica endpoint
+    # list so their KV clients fail over instead of pinning one endpoint
+    from horovod_tpu.common.env_registry import env_str
+    replica_eps = env_str("HOROVOD_KV_REPLICA_ENDPOINTS")
+    if replica_eps:
+        env["HOROVOD_KV_REPLICA_ENDPOINTS"] = replica_eps
     # Workers must not grab a single-tenant accelerator relay the launcher
     # process may own; training scripts opt in explicitly.
     env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu"))

@@ -1,0 +1,886 @@
+"""Leader-lease replicated control-plane KV (ISSUE 19).
+
+The PR-10 durable KV survives driver *restarts* but still dies with its
+host — the one machine whose loss takes down rendezvous, elastic resize,
+autoscaling, tuning publication, and serve discovery at once. This
+module replicates it: N :class:`ReplicaKVServer` processes (indexed by
+``replica_id`` into a shared endpoint list) run the same sharded-WAL
+store, with
+
+- **one leader holding a time-bounded lease** — granted by a follower-
+  majority election, persisted as a ``lease`` record in the WAL, and
+  extended only by majority-acked append rounds. A leader that cannot
+  reach a majority lets its lease lapse and steps down; followers wait
+  1.5 leases of silence before electing, so (under bounded clock drift)
+  two replicas never both believe they hold the lease at one instant.
+- **synchronous majority replication** — client mutations are accepted
+  only by the leader, appended to its WAL, forwarded to every follower,
+  and acked to the client only once a majority (leader included) holds
+  them. Every envelope carries the control epoch as the replication
+  term: a deposed leader's in-flight forwards are 409ed by followers
+  that have seen a newer term, and the deposed leader **self-fences**
+  (steps down) on the first majority-refused write.
+- **highest-(epoch, WAL-length) elections** — the vote-grant rule
+  (shared with the ``ReplicaSpec`` model via
+  ``horovod_tpu/verify/rules.py``) refuses any candidate whose WAL is
+  shorter than the voter's, so a majority-committed (acked) write can
+  never be missing from a newly elected leader. Winning bumps the epoch.
+- **WAL-divergence repair** — a rejoining follower whose log does not
+  match the leader's (it accepted records that never reached a
+  majority, or it missed appends while partitioned) is resynced from
+  the leader's full state; its un-committed suffix is truncated with a
+  loud tripwire log, and its shard WALs are rewritten to the committed
+  prefix.
+
+The elastic driver talks to the replica set through
+:class:`ReplicatedKVHandle` — the same accessor surface as an in-process
+``KVServer`` (``put_json``/``get_json``/``delete``/``delete_prefix``/
+``keys``/``epoch``/``recovered``), backed by a failover-aware
+:class:`~horovod_tpu.runner.http_kv.KVClient`. At attach it bumps the
+control epoch (fencing any predecessor driver incarnation) and records
+its ownership under the ``control_epoch`` key; when an *election* bumps
+the epoch underneath it, the handle distinguishes "deposed by a rival
+driver" (stand down, :class:`StaleEpochError`) from "same driver, new
+KV term" (adopt and continue) by checking that ownership record.
+
+Run one replica as a subprocess::
+
+    python -m horovod_tpu.runner.replica_kv \
+        --id 0 --endpoints host:7001,host:7002,host:7003 --dir /kv/r0
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+from horovod_tpu.common import kv_keys
+from horovod_tpu.runner.http_kv import (LEADER_HEADER, KVClient, KVServer,
+                                        StaleEpochError)
+
+_MAX_VOTE_MEMORY = 64     # per-epoch vote records retained
+_RESYNC_TOKEN_WINDOW = 1024
+
+
+def _rules():
+    """The shared election/quorum rules (lazy: the verify package pulls
+    in the spec suite, which a replica subprocess shouldn't pay for at
+    import time)."""
+    from horovod_tpu.verify import rules
+    return rules
+
+
+def _logger():
+    from horovod_tpu.common.hvd_logging import get_logger
+    return get_logger("runner.replica_kv")
+
+
+class ReplicaKVServer(KVServer):
+    """One member of a replicated KV set. See the module docstring for
+    the protocol; this class adds the replica roles on top of the base
+    server's sharded-WAL store via the ``_route`` handler hook."""
+
+    # a restarting replica must NOT outrun its leader's term — epoch
+    # bumps come from elections and driver attach, never from restarts
+    _bump_epoch_on_start = False
+
+    def __init__(self, replica_id: int, endpoints: List[str],
+                 kv_dir: str, port: Optional[int] = None,
+                 lease_seconds: Optional[float] = None,
+                 snapshot_bytes: Optional[int] = None):
+        assert kv_dir, "a KV replica is always durable (kv_dir required)"
+        self.replica_id = int(replica_id)
+        self._endpoints = [str(e).strip() for e in endpoints]
+        assert 0 <= self.replica_id < len(self._endpoints)
+        if lease_seconds is None:
+            from horovod_tpu.common.env_registry import env_float
+            lease_seconds = env_float("HOROVOD_KV_LEASE_SECONDS")
+        self._lease = float(lease_seconds)
+        now = time.monotonic()
+        self._role = "follower"
+        self._leader_id: Optional[int] = None
+        self._leader_seen = now
+        self._lease_until = 0.0     # leader: lease valid until
+        self._lease_grant_t = 0.0   # leader: last majority extension
+        self._commit = 0            # highest majority-committed seq
+        self._votes_cast: Dict[int, int] = {}   # epoch -> candidate id
+        self._next_proposal = 0  # grows per attempt so split votes resolve
+        self._peer_seen: Dict[int, float] = {}  # id -> last good contact
+        # staggered bootstrap/election timers: replica 0 usually wins the
+        # first election, and retries never synchronize
+        self._elect_after = now + self._lease * (1.5 + 0.5 * self.replica_id
+                                                 + 0.3 * random.random())
+        self._stop_evt = threading.Event()
+        self._ticker: Optional[threading.Thread] = None
+        if port is None:
+            port = int(self._endpoints[self.replica_id].rsplit(":", 1)[1])
+        super().__init__(port=port, kv_dir=kv_dir,
+                         snapshot_bytes=snapshot_bytes)
+        # everything replayed from our own WAL is only *locally* durable;
+        # the commit point is re-learned from the leader on rejoin
+        self._commit = self._seq
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self):
+        super().start()
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5)
+        super().stop()
+
+    # -- HTTP routing (the base server's extension hook) ----------------------
+
+    def _route(self, handler, method: str) -> bool:
+        path, _, _ = handler.path.partition("?")
+        if method == "POST" and path == "/_replica/append":
+            self._h_append(handler)
+            return True
+        if method == "POST" and path == "/_replica/vote":
+            self._h_vote(handler)
+            return True
+        if method == "POST" and path == "/_replica/resync":
+            self._h_resync(handler)
+            return True
+        if method in ("PUT", "DELETE"):
+            self._h_client_mutation(handler, method)
+            return True
+        return False  # reads (incl. /replica_status, /_kv/keys): base
+
+    @staticmethod
+    def _read_doc(handler) -> dict:
+        length = int(handler.headers.get("Content-Length", 0))
+        raw = handler.rfile.read(length)
+        try:
+            doc = json.loads(raw) if raw else {}
+        except ValueError:
+            doc = {}
+        return doc if isinstance(doc, dict) else {}
+
+    # -- client-facing mutations ----------------------------------------------
+
+    def _h_client_mutation(self, handler, method: str):
+        from urllib import parse as urlparse
+        path, _, query = handler.path.partition("?")
+        body = b""
+        if method == "PUT":
+            length = int(handler.headers.get("Content-Length", 0))
+            body = handler.rfile.read(length)
+        with self._lock:
+            is_leader = self._role == "leader" and \
+                time.monotonic() < self._lease_until
+        if not is_leader:
+            self._send_not_leader(handler)
+            return
+        if method == "PUT":
+            op = {"op": "put", "k": path.lstrip("/"),
+                  "v": base64.b64encode(body).decode()}
+        elif path == "/_kv/prefix":
+            q = urlparse.parse_qs(query)
+            op = {"op": "delp", "p": q.get("p", [""])[0]}
+        else:
+            op = {"op": "del", "k": path.lstrip("/")}
+        try:
+            outcome, existed = self._replicate(op, handler._claimed_epoch(),
+                                               handler._token())
+        except StaleEpochError as e:
+            handler._send_fenced(e)
+            return
+        if outcome == "ok":
+            if method == "DELETE" and op["op"] == "del" and not existed:
+                handler.send_response(404)
+            else:
+                handler.send_response(200)
+            handler.end_headers()
+        elif outcome == "not_leader":
+            self._send_not_leader(handler)
+        else:  # lost leadership mid-write: never acked, client retries
+            handler._send_json({"error": "no_leader"}, status=503)
+
+    def _send_not_leader(self, handler):
+        with self._lock:
+            lid = self._leader_id
+            fresh = (time.monotonic() - self._leader_seen) < self._lease * 2
+        if lid is not None and lid != self.replica_id and fresh:
+            ep = self._endpoints[lid]
+            handler.send_response(307)
+            handler.send_header("Location", f"http://{ep}{handler.path}")
+            handler.send_header(LEADER_HEADER, ep)
+            handler.send_header("Content-Length", "0")
+            handler.end_headers()
+        else:
+            handler._send_json({"error": "no_leader"}, status=503)
+
+    # -- leader write path ----------------------------------------------------
+
+    def _replicate(self, op: dict, epoch_claim: Optional[int],
+                   token: Optional[Tuple[str, int]]) \
+            -> Tuple[str, bool]:
+        """Append ``op`` through the replication pipeline: local WAL →
+        synchronous forward → majority ack → commit. Returns
+        ``(outcome, existed)``; raises StaleEpochError for a fenced
+        client claim. Holding the lock across the forward serializes
+        writes — correct first, fast enough for a control plane."""
+        rules = _rules()
+        with self._lock:
+            now = time.monotonic()
+            if self._role != "leader" or now >= self._lease_until:
+                return "not_leader", False
+            try:
+                self._check_epoch_locked(epoch_claim)  # may adopt newer
+            except StaleEpochError as e:
+                self._log_stale(e)
+                raise
+            if self._dedup_locked(token):
+                return "ok", True  # retry of a committed op: applied once
+            prev = self._seq
+            self._seq += 1
+            rec = dict(op, s=self._seq)
+            if epoch_claim is not None:
+                rec["e"] = int(epoch_claim)
+            if token is not None:
+                rec["c"], rec["n"] = token[0], int(token[1])
+            existed = self._apply_record_locked(rec)
+            env = {"term": self.epoch, "leader": self.replica_id,
+                   "prev": prev, "ops": [rec], "commit": self._commit}
+            acks, resync_peers, deposed_by = self._send_round_locked(env)
+            if deposed_by is not None:
+                self._step_down_locked(
+                    f"majority-refused write (newer term {deposed_by})")
+                return "lost", existed
+            if acks >= rules.majority(len(self._endpoints)):
+                self._commit = rec["s"]
+                self._lease_until = now + self._lease
+                self._lease_grant_t = now
+                outcome = "ok"
+            else:
+                self._step_down_locked(
+                    "write could not reach a follower majority")
+                outcome = "lost"
+        for pid in resync_peers:
+            self._resync_peer(pid)
+        return outcome, existed
+
+    def _apply_record_locked(self, rec: dict) -> bool:
+        """Apply one replicated record: store mutation + WAL append +
+        dedupe-token registration. Caller holds the lock."""
+        kind = rec.get("op")
+        existed = True
+        if kind == "put":
+            self._store[rec["k"]] = base64.b64decode(rec["v"])
+        elif kind == "del":
+            existed = self._store.pop(rec["k"], None) is not None
+        elif kind == "delp":
+            for k in [k for k in self._store
+                      if k.startswith(rec.get("p", ""))]:
+                del self._store[k]
+        # "lease" records mutate nothing: they are the persisted grant
+        if rec.get("c") is not None and rec.get("n") is not None:
+            self._applied[(rec["c"], int(rec["n"]))] = True
+        if isinstance(rec.get("s"), int):
+            self._seq = max(self._seq, rec["s"])
+        if self._wal is not None:
+            self._wal.append(rec, self._store)
+            self._export_metrics()
+        return existed
+
+    def _send_round_locked(self, env: dict) \
+            -> Tuple[int, List[int], Optional[int]]:
+        """One append round to every peer: ``(acks_including_self,
+        peers_needing_resync, deposing_term_or_None)``."""
+        acks = 1  # self
+        resync_peers: List[int] = []
+        deposed_by: Optional[int] = None
+        now = time.monotonic()
+        for pid, resp in self._broadcast("/_replica/append", env,
+                                         timeout=max(0.2, self._lease / 2)):
+            if resp is None:
+                continue
+            if resp.get("fenced"):
+                term = int(resp.get("term", self.epoch + 1))
+                self._adopt_term_locked(max(term, self.epoch))
+                deposed_by = term
+                continue
+            if resp.get("resync"):
+                self._peer_seen[pid] = now
+                resync_peers.append(pid)
+                continue
+            if resp.get("ok"):
+                self._peer_seen[pid] = now
+                acks += 1
+        return acks, resync_peers, deposed_by
+
+    def _step_down_locked(self, why: str):
+        if self._role == "leader":
+            _logger().warning(
+                "kv-replica %d: self-fencing (stepping down): %s",
+                self.replica_id, why)
+        self._role = "follower"
+        self._leader_id = None
+        self._lease_until = 0.0
+        self._elect_after = time.monotonic() + self._lease * (
+            1.5 + 0.5 * self.replica_id + 0.3 * random.random())
+
+    def _adopt_term_locked(self, term: int):
+        if term > self.epoch:
+            self.epoch = int(term)
+            if self._wal is not None:
+                self._wal.store_epoch(self.epoch)
+
+    # -- follower: replicated append ------------------------------------------
+
+    def _h_append(self, handler):
+        doc = self._read_doc(handler)
+        term = int(doc.get("term", -1))
+        now = time.monotonic()
+        with self._lock:
+            if term < self.epoch:
+                # a deposed leader's in-flight forward: 409 everywhere
+                handler._send_json({"fenced": True, "term": self.epoch},
+                                   status=409)
+                return
+            self._adopt_term_locked(term)
+            if self._role != "follower":
+                self._step_down_locked(
+                    f"append from leader {doc.get('leader')} at term {term}")
+            self._role = "follower"
+            self._leader_id = int(doc.get("leader", -1))
+            self._leader_seen = now
+            if int(doc.get("prev", -1)) != self._seq:
+                handler._send_json({"ok": False, "resync": True,
+                                    "have": self._seq})
+                return
+            for rec in doc.get("ops", []):
+                self._apply_record_locked(rec)
+            self._commit = max(self._commit, int(doc.get("commit", 0)))
+            handler._send_json({"ok": True, "seq": self._seq})
+
+    # -- votes ----------------------------------------------------------------
+
+    def _h_vote(self, handler):
+        rules = _rules()
+        doc = self._read_doc(handler)
+        cand = int(doc.get("cand", -1))
+        cand_epoch = int(doc.get("epoch", -1))
+        cand_len = int(doc.get("len", -1))
+        now = time.monotonic()
+        with self._lock:
+            heard = self._leader_id is not None and \
+                (now - self._leader_seen) < self._lease * 1.5
+            if self._role == "leader" and now < self._lease_until:
+                heard = True  # we ARE the fresh leaseholder
+            granted = rules.vote_grants(self.epoch, self._seq, cand_epoch,
+                                        cand_len, heard) and \
+                self._votes_cast.get(cand_epoch, cand) == cand
+            if granted:
+                self._votes_cast[cand_epoch] = cand
+                while len(self._votes_cast) > _MAX_VOTE_MEMORY:
+                    self._votes_cast.pop(min(self._votes_cast))
+            handler._send_json({"granted": bool(granted),
+                                "term": self.epoch, "len": self._seq})
+
+    def _run_election(self):
+        rules = _rules()
+        now = time.monotonic()
+        with self._lock:
+            if self._role == "leader":
+                return
+            # each attempt proposes a strictly higher epoch than any
+            # prior one — otherwise two candidates that split a vote at
+            # epoch+1 have both burned their one vote there and no
+            # election at that epoch can ever reach a majority
+            proposed = max(self.epoch + 1, self._next_proposal)
+            self._next_proposal = proposed + 1
+            my_len = self._seq
+            self._votes_cast[proposed] = self.replica_id  # self-vote
+        votes = 1
+        for _pid, resp in self._broadcast(
+                "/_replica/vote",
+                {"cand": self.replica_id, "epoch": proposed, "len": my_len},
+                timeout=max(0.2, self._lease / 2)):
+            if resp is None:
+                continue
+            if resp.get("granted"):
+                votes += 1
+            elif int(resp.get("term", 0)) > proposed:
+                with self._lock:
+                    self._adopt_term_locked(int(resp["term"]))
+                return
+        won = False
+        with self._lock:
+            if self.epoch >= proposed:
+                return  # superseded while soliciting
+            if votes >= rules.majority(len(self._endpoints)):
+                self._adopt_term_locked(proposed)
+                self._role = "leader"
+                self._leader_id = self.replica_id
+                self._lease_until = now + self._lease
+                self._lease_grant_t = now
+                won = True
+            else:
+                self._elect_after = now + self._lease * (
+                    0.5 + 0.5 * self.replica_id + random.random())
+        if won:
+            _logger().warning(
+                "kv-replica %d: elected leader (epoch %d, wal seq %d, "
+                "%d/%d votes)", self.replica_id, proposed, my_len, votes,
+                len(self._endpoints))
+            # persist + replicate the lease grant; failing to establish
+            # it with a majority immediately self-fences
+            self._replicate({"op": "lease", "leader": self.replica_id,
+                             "dur": self._lease}, self.epoch, None)
+
+    # -- resync (WAL-divergence repair) ---------------------------------------
+
+    def _resync_peer(self, pid: int):
+        with self._lock:
+            doc = {"term": self.epoch, "leader": self.replica_id,
+                   "seq": self._seq, "commit": self._commit,
+                   "store": {k: base64.b64encode(v).decode()
+                             for k, v in self._store.items()},
+                   "tokens": [list(t) for t in
+                              list(self._applied)[-_RESYNC_TOKEN_WINDOW:]]}
+        self._post_json(self._endpoints[pid], "/_replica/resync", doc,
+                        timeout=max(1.0, self._lease))
+
+    def _h_resync(self, handler):
+        doc = self._read_doc(handler)
+        term = int(doc.get("term", -1))
+        now = time.monotonic()
+        with self._lock:
+            if term < self.epoch:
+                handler._send_json({"fenced": True, "term": self.epoch},
+                                   status=409)
+                return
+            new_store = {k: base64.b64decode(v)
+                         for k, v in doc.get("store", {}).items()}
+            leader_seq = int(doc.get("seq", 0))
+            diverged = sorted(
+                k for k, v in self._store.items()
+                if new_store.get(k) != v)
+            if self._seq > leader_seq or diverged:
+                # TRIPWIRE: this follower accepted records that never
+                # reached a majority — truncate them to the committed
+                # prefix, loudly. Anything acked to a client is in the
+                # leader's state by the election rule, so nothing acked
+                # is lost here.
+                _logger().warning(
+                    "kv-replica %d: WAL DIVERGENCE REPAIR on rejoin: "
+                    "truncating un-majority-committed suffix (local seq "
+                    "%d > leader seq %d; %d diverged key(s): %s)",
+                    self.replica_id, self._seq, leader_seq,
+                    len(diverged), diverged[:8])
+            elif self._seq < leader_seq:
+                _logger().info(
+                    "kv-replica %d: catching up from leader %s "
+                    "(local seq %d -> %d)", self.replica_id,
+                    doc.get("leader"), self._seq, leader_seq)
+            self._adopt_term_locked(term)
+            self._store = new_store
+            self._seq = leader_seq
+            self._commit = int(doc.get("commit", 0))
+            self._applied = {}
+            for tok in doc.get("tokens", []):
+                try:
+                    self._applied[(str(tok[0]), int(tok[1]))] = True
+                except (TypeError, ValueError, IndexError):
+                    pass
+            if self._wal is not None:
+                self._wal.max_seq = self._seq
+                self._wal.compact_all(self._store)
+                self._export_metrics()
+            self._role = "follower"
+            self._leader_id = int(doc.get("leader", -1))
+            self._leader_seen = now
+            handler._send_json({"ok": True, "seq": self._seq})
+
+    # -- lease ticker ----------------------------------------------------------
+
+    def _tick_loop(self):
+        period = max(0.05, self._lease / 4)
+        while not self._stop_evt.wait(period):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the ticker must survive
+                pass
+
+    def _tick(self):
+        now = time.monotonic()
+        with self._lock:
+            role = self._role
+            silent = now - self._leader_seen
+            elect_due = now >= self._elect_after
+        if role == "leader":
+            self._heartbeat()
+        elif elect_due and silent > self._lease * 1.5:
+            self._run_election()
+
+    def _heartbeat(self):
+        """Leader lease extension: an empty majority-acked append round.
+        Doubles as the follower resync trigger (prev-seq mismatch)."""
+        rules = _rules()
+        resync_peers: List[int] = []
+        with self._lock:
+            if self._role != "leader":
+                return
+            now = time.monotonic()
+            env = {"term": self.epoch, "leader": self.replica_id,
+                   "prev": self._seq, "ops": [], "commit": self._commit}
+            acks, resync_peers, deposed_by = self._send_round_locked(env)
+            if deposed_by is not None:
+                self._step_down_locked(
+                    f"heartbeat refused (newer term {deposed_by})")
+            elif acks >= rules.majority(len(self._endpoints)):
+                self._lease_until = now + self._lease
+                self._lease_grant_t = now
+            elif now >= self._lease_until:
+                self._step_down_locked(
+                    "lease expired without a follower majority")
+        for pid in resync_peers:
+            self._resync_peer(pid)
+
+    # -- peer transport --------------------------------------------------------
+
+    def _post_json(self, endpoint: str, path: str, doc: dict,
+                   timeout: float) -> Optional[dict]:
+        req = urlrequest.Request(
+            f"http://{endpoint}{path}", data=json.dumps(doc).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            with urlrequest.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read())
+        except urlerror.HTTPError as e:
+            try:
+                return json.loads(e.read())
+            except ValueError:
+                return None
+        except (urlerror.URLError, ConnectionError, OSError, ValueError):
+            return None
+
+    def _broadcast(self, path: str, doc: dict, timeout: float) \
+            -> List[Tuple[int, Optional[dict]]]:
+        """POST to every peer in parallel; collect (peer_id, response)."""
+        peers = [(i, ep) for i, ep in enumerate(self._endpoints)
+                 if i != self.replica_id]
+        if not peers:
+            return []
+        results: List[Tuple[int, Optional[dict]]] = []
+        lock = threading.Lock()
+
+        def one(pid, ep):
+            resp = self._post_json(ep, path, doc, timeout)
+            with lock:
+                results.append((pid, resp))
+
+        threads = [threading.Thread(target=one, args=p, daemon=True)
+                   for p in peers]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout + 0.5
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        with lock:
+            return list(results)
+
+    # -- status ----------------------------------------------------------------
+
+    def _replica_status(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            if self._role == "leader":
+                lease_age = now - self._lease_grant_t
+                leader = self.replica_id
+            else:
+                lease_age = now - self._leader_seen
+                leader = self._leader_id
+            return {"id": self.replica_id, "role": self._role,
+                    "leader": leader, "epoch": self.epoch,
+                    "seq": self._seq, "commit": self._commit,
+                    "lease_age": round(lease_age, 3),
+                    "lease_seconds": self._lease,
+                    "replicas": len(self._endpoints),
+                    "endpoints": self._endpoints,
+                    "peers": {str(pid): round(now - t, 3)
+                              for pid, t in self._peer_seen.items()},
+                    "shards": (self._wal.shard_bytes()
+                               if self._wal is not None else {}),
+                    "store_hash": self._store_hash_locked()}
+
+
+# ===========================================================================
+# replica-set helpers (supervisor + chaos harness)
+# ===========================================================================
+
+def replica_dir(base_dir: str, replica_id: int) -> str:
+    return os.path.join(base_dir, f"replica{int(replica_id)}")
+
+
+def die_with_parent():
+    """``preexec_fn`` asking the kernel to SIGTERM this child when its
+    parent dies (Linux ``PR_SET_PDEATHSIG``). A SIGKILLed supervisor
+    never runs its cleanup path — without this its replica fleet (and
+    driver) would outlive it as orphans holding inherited pipes open.
+    Best-effort no-op elsewhere."""
+    try:
+        import ctypes
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, signal.SIGTERM)  # PR_SET_PDEATHSIG = 1
+    except Exception:  # noqa: BLE001 — portability fallback, not a gate
+        pass
+
+
+def spawn_replica(replica_id: int, endpoints: List[str], base_dir: str,
+                  lease_seconds: Optional[float] = None,
+                  env: Optional[dict] = None) -> subprocess.Popen:
+    """Launch one replica as a subprocess (the supervisor's — and the
+    chaos harness's — unit of kill/respawn)."""
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.replica_kv",
+           "--id", str(int(replica_id)),
+           "--endpoints", ",".join(endpoints),
+           "--dir", replica_dir(base_dir, replica_id)]
+    if lease_seconds is not None:
+        cmd += ["--lease", str(float(lease_seconds))]
+    return subprocess.Popen(cmd, env=dict(env or os.environ),
+                            preexec_fn=die_with_parent)
+
+
+def wait_for_leader(endpoints: List[str], timeout: float = 30.0,
+                    poll: float = 0.1) -> Optional[dict]:
+    """Poll ``/replica_status`` across the set until some replica reports
+    itself leader. Returns its status doc (None on timeout)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for ep in endpoints:
+            try:
+                with urlrequest.urlopen(f"http://{ep}/replica_status",
+                                        timeout=1.0) as resp:
+                    st = json.loads(resp.read())
+                if st.get("role") == "leader":
+                    st["endpoint"] = ep
+                    return st
+            except (urlerror.URLError, ConnectionError, OSError,
+                    ValueError):
+                continue
+        time.sleep(poll)
+    return None
+
+
+def replica_statuses(endpoints: List[str], timeout: float = 1.0) \
+        -> Dict[str, Optional[dict]]:
+    """One best-effort ``/replica_status`` probe per endpoint."""
+    out: Dict[str, Optional[dict]] = {}
+    for ep in endpoints:
+        try:
+            with urlrequest.urlopen(f"http://{ep}/replica_status",
+                                    timeout=timeout) as resp:
+                out[ep] = json.loads(resp.read())
+        except (urlerror.URLError, ConnectionError, OSError, ValueError):
+            out[ep] = None
+    return out
+
+
+# ===========================================================================
+# driver-side handle
+# ===========================================================================
+
+class ReplicatedKVHandle:
+    """The elastic driver's view of the replica set: the in-process
+    ``KVServer`` accessor surface over a failover-aware client.
+
+    Attach semantics (the PR-10 incarnation bump, relocated): the handle
+    waits for a leader, claims ``leader_epoch + 1`` (fencing any
+    lingering predecessor driver everywhere, via replication), and
+    records ``{"epoch", "owner"}`` under the ``control_epoch`` key. When
+    a later write is fenced, the handle re-reads that record: same owner
+    means the epoch advanced by a KV *election* — adopt the new epoch
+    and retry once; a different owner means a rival driver incarnation
+    took over — stand down (StaleEpochError propagates, exactly the
+    PR-10 contract)."""
+
+    def __init__(self, endpoints: List[str],
+                 epoch_adopted=None):
+        eps = [str(e).strip() for e in endpoints if str(e).strip()]
+        assert eps, "replica endpoint list is empty"
+        self._endpoints = eps
+        host, _, port = eps[0].rpartition(":")
+        self.port = int(port)
+        self.host = host
+        self._client = KVClient(host, self.port, endpoints=eps)
+        self.epoch = 0
+        self.recovered = False
+        self._incarnation = uuid.uuid4().hex
+        self._on_epoch_adopted = epoch_adopted  # callback(new_epoch)
+
+    # KVServer-surface compatibility -----------------------------------------
+
+    def start(self, timeout: float = 60.0):
+        st = wait_for_leader(self._endpoints, timeout=timeout)
+        if st is None:
+            raise TimeoutError(
+                f"no KV leader reachable among {self._endpoints} "
+                f"within {timeout:.0f}s")
+        self.epoch = int(st["epoch"]) + 1
+        self._client.epoch = self.epoch
+        try:
+            self.recovered = bool(self._client.keys(""))
+        except Exception:  # noqa: BLE001 — recovery probe is advisory
+            self.recovered = False
+        self._client.put_json(
+            kv_keys.control_epoch(),
+            {"epoch": self.epoch, "owner": self._incarnation},
+            attempts=6, deadline=timeout)
+        return self
+
+    def stop(self):
+        pass  # the replica set outlives any one driver
+
+    @property
+    def wal_bytes(self) -> int:
+        st = self._client.replica_status()
+        return sum((st or {}).get("shards", {}).values())
+
+    @property
+    def replay_seconds(self) -> float:
+        return 0.0
+
+    def _sync_epoch(self, epoch: Optional[int]):
+        if epoch is not None and epoch > (self._client.epoch or 0):
+            self._client.epoch = int(epoch)
+            self.epoch = max(self.epoch, int(epoch))
+
+    def _adopt_after_election(self, e: StaleEpochError) -> bool:
+        """True when the fence came from a KV election under the SAME
+        driver (adopt + continue); False for a rival driver."""
+        rec = self._client.get_json(kv_keys.control_epoch(), timeout=5.0)
+        if not isinstance(rec, dict) or \
+                rec.get("owner") != self._incarnation:
+            return False
+        new_epoch = max(int(e.current), int(rec.get("epoch", 0)))
+        self.epoch = new_epoch
+        self._client.epoch = new_epoch
+        _logger().warning(
+            "driver KV handle: adopting post-election control epoch %d "
+            "(was fenced at %d; same driver incarnation)", new_epoch,
+            e.offered)
+        if self._on_epoch_adopted is not None:
+            try:
+                self._on_epoch_adopted(new_epoch)
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    def _mutate(self, fn):
+        try:
+            return fn()
+        except StaleEpochError as e:
+            if not self._adopt_after_election(e):
+                raise
+            return fn()  # once, at the adopted epoch
+
+    def put_json(self, key: str, value: Any, epoch: Optional[int] = None):
+        self._sync_epoch(epoch)
+        # Ownership is handle-level bookkeeping: a driver re-publishing
+        # the control epoch (recovery, topology notify) writes a plain
+        # {"epoch"} payload and would otherwise clobber the owner stamp
+        # `_adopt_after_election` depends on — after the next election
+        # the handle would mistake its own driver for a rival and stand
+        # down instead of adopting.
+        stamp_owner = (key == kv_keys.control_epoch()
+                       and isinstance(value, dict))
+        # A payload whose embedded "epoch" equals the claimed epoch is a
+        # driver command embedding its fencing token for workers. It is
+        # rebuilt per attempt so a post-adoption retry carries the
+        # adopted epoch — workers whose floor already rose past the
+        # election would silently ignore the pre-fence value.
+        refresh = (isinstance(value, dict) and epoch is not None
+                   and value.get("epoch") == epoch)
+        if not (stamp_owner or refresh):
+            return self._mutate(lambda: self._client.put_json(
+                key, value, attempts=6, backoff=0.1, deadline=30.0))
+
+        def write():
+            v = dict(value)
+            if stamp_owner:
+                v.setdefault("owner", self._incarnation)
+            if isinstance(v.get("epoch"), int):
+                v["epoch"] = max(v["epoch"], self.epoch)
+            return self._client.put_json(
+                key, v, attempts=6, backoff=0.1, deadline=30.0)
+        return self._mutate(write)
+
+    def get_json(self, key: str) -> Optional[Any]:
+        # the in-process server returns immediately; so does the handle
+        # (timeout covers transport + one failover rotation, not polling)
+        return self._client.get_json(key, timeout=5.0, poll_interval=0.05)
+
+    def delete(self, key: str, epoch: Optional[int] = None) -> bool:
+        self._sync_epoch(epoch)
+        self._mutate(lambda: self._client.delete(key, attempts=6))
+        return True
+
+    def delete_prefix(self, prefix: str, epoch: Optional[int] = None):
+        self._sync_epoch(epoch)
+        return self._mutate(
+            lambda: self._client.delete_prefix(prefix, attempts=6))
+
+    def keys(self, prefix: str = "") -> List[str]:
+        try:
+            return self._client.keys(prefix, attempts=6)
+        except (urlerror.URLError, ConnectionError, OSError):
+            return []
+
+    def replica_status(self) -> Optional[dict]:
+        return self._client.replica_status()
+
+
+# ===========================================================================
+# subprocess entry point
+# ===========================================================================
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.runner.replica_kv",
+        description="run one leader-lease KV replica")
+    ap.add_argument("--id", type=int, required=True)
+    ap.add_argument("--endpoints", required=True,
+                    help="comma-separated host:port list, one per replica")
+    ap.add_argument("--dir", required=True, help="this replica's kv_dir")
+    ap.add_argument("--lease", type=float, default=None,
+                    help="lease seconds (default HOROVOD_KV_LEASE_SECONDS)")
+    args = ap.parse_args(argv)
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    srv = ReplicaKVServer(args.id, endpoints, kv_dir=args.dir,
+                          lease_seconds=args.lease).start()
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    _logger().info("kv-replica %d serving on %s (of %s)", args.id,
+                   endpoints[args.id], endpoints)
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,8 +30,9 @@ def _kv_client():
     port = env_int("HOROVOD_RENDEZVOUS_PORT")
     if not addr or not port:
         return None
-    from horovod_tpu.runner.http_kv import KVClient
-    return KVClient(addr, port)
+    from horovod_tpu.runner.http_kv import (KVClient,
+                                            replica_endpoints_from_env)
+    return KVClient(addr, port, endpoints=replica_endpoints_from_env())
 
 
 def main():

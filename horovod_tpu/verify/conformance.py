@@ -36,11 +36,11 @@ _MAX_RECORD_BYTES = 64 << 20  # mirrors runner/http_kv.py's replay ceiling
 # KV WAL replay (read-only)
 # ===========================================================================
 
-def iter_wal_ops(kv_dir) -> Iterator[dict]:
-    """Yield the decoded JSON ops of ``wal.log`` in commit order,
+def iter_wal_ops(kv_dir, wal_file: str = "wal.log") -> Iterator[dict]:
+    """Yield the decoded JSON ops of one WAL file in commit order,
     stopping (like the real replay) at the first truncated or corrupt
     record — but never mutating the artifact."""
-    path = Path(kv_dir) / "wal.log"
+    path = Path(kv_dir) / wal_file
     try:
         data = path.read_bytes()
     except OSError:
@@ -62,11 +62,12 @@ def iter_wal_ops(kv_dir) -> Iterator[dict]:
         off += 8 + length
 
 
-def load_snapshot_keys(kv_dir) -> List[str]:
-    """Keys present in the compacted snapshot (compaction truncates the
+def load_snapshot_keys(kv_dir, snap_file: str = "snapshot.json") \
+        -> List[str]:
+    """Keys present in a compacted snapshot (compaction truncates the
     WAL, so ordering checks must treat snapshot contents as 'already
     seen')."""
-    path = Path(kv_dir) / "snapshot.json"
+    path = Path(kv_dir) / snap_file
     try:
         doc = json.loads(path.read_bytes())
         return list(doc.get("store", {}))
@@ -82,16 +83,21 @@ def _decoded_value(op: dict) -> Optional[dict]:
     return val if isinstance(val, dict) else None
 
 
-def check_kv_wal(kv_dir) -> List[str]:
-    """Divergences between a KV write-ahead log and the protocol rules.
-    Empty list = conformant."""
+_GENERATION_FAMILIES = ("generation", "notify", "agg_targets")
+
+
+def _audit_stream(ops: List[dict], label: str, seen_keys: set,
+                  shard: Optional[str] = None,
+                  check_go: bool = True) -> List[str]:
+    """Audit one WAL's op stream against the protocol rules. ``shard``
+    (non-core shard WALs only) additionally enforces the kv_keys shard
+    routing — a heartbeat record in the serve WAL is a divergence; the
+    core WAL is exempt because it is also the legacy pre-sharding log
+    and may replay anything."""
     out: List[str] = []
-    seen_keys = set(load_snapshot_keys(kv_dir))
     max_claimed_epoch: Optional[int] = None
     max_generation: Optional[int] = None
-    n = 0
-    for i, op in enumerate(iter_wal_ops(kv_dir)):
-        n += 1
+    for i, op in enumerate(ops):
         kind = op.get("op")
         # op-level epoch claim (recorded by KVServer._log_op): the
         # strongest split-brain oracle — EVERY admitted claim must be
@@ -101,26 +107,33 @@ def check_kv_wal(kv_dir) -> List[str]:
             e = int(claimed)
             if max_claimed_epoch is not None and e < max_claimed_epoch:
                 out.append(
-                    f"wal[{i}]: op claimed control epoch {e} after "
+                    f"{label}[{i}]: op claimed control epoch {e} after "
                     f"{max_claimed_epoch} was admitted — a fenced-out "
                     "stale driver's mutation landed (split-brain)")
             max_claimed_epoch = max(max_claimed_epoch or e, e)
+        if kind == "lease":
+            continue  # replica lease grant: the epoch claim above is
+            # its whole conformance contract (no store mutation)
         if kind == "delp":
             prefix = op.get("p", "")
             if kv_keys.match_prefix(prefix) is None:
-                out.append(f"wal[{i}]: delete_prefix of unregistered key "
-                           f"namespace {prefix!r}")
-            seen_keys = {k for k in seen_keys
-                         if not k.startswith(prefix)}
+                out.append(f"{label}[{i}]: delete_prefix of unregistered "
+                           f"key namespace {prefix!r}")
+            seen_keys -= {k for k in seen_keys if k.startswith(prefix)}
             continue
         key = op.get("k", "")
         m = kv_keys.match(key)
         if m is None:
-            out.append(f"wal[{i}]: key {key!r} matches no registered "
+            out.append(f"{label}[{i}]: key {key!r} matches no registered "
                        "family (common/kv_keys.py)")
             continue
         family, _args = m
         fam = kv_keys.FAMILIES[family]
+        if shard is not None and fam.shard != shard:
+            out.append(
+                f"{label}[{i}]: key {key!r} routes to shard "
+                f"{fam.shard!r} but was recorded in the {shard!r} WAL — "
+                "shard routing divergence")
         if kind == "del":
             seen_keys.discard(key)
             continue
@@ -131,16 +144,16 @@ def check_kv_wal(kv_dir) -> List[str]:
             try:
                 e = int(val["epoch"])
             except (TypeError, ValueError):
-                out.append(f"wal[{i}]: {key}: non-integer epoch "
+                out.append(f"{label}[{i}]: {key}: non-integer epoch "
                            f"{val['epoch']!r}")
                 continue
             if max_claimed_epoch is not None and e < max_claimed_epoch:
                 out.append(
-                    f"wal[{i}]: {key}: control epoch regressed "
+                    f"{label}[{i}]: {key}: control epoch regressed "
                     f"({e} after {max_claimed_epoch}) — a fenced-out "
                     "stale driver's write landed (split-brain)")
             max_claimed_epoch = max(max_claimed_epoch or e, e)
-        if family in ("generation", "notify", "agg_targets") \
+        if family in _GENERATION_FAMILIES \
                 and isinstance(val, dict) and "generation" in val:
             try:
                 g = int(val["generation"])
@@ -149,18 +162,91 @@ def check_kv_wal(kv_dir) -> List[str]:
             if g is not None:
                 if max_generation is not None and g < max_generation:
                     out.append(
-                        f"wal[{i}]: {key}: generation regressed "
+                        f"{label}[{i}]: {key}: generation regressed "
                         f"({g} after {max_generation})")
                 max_generation = max(max_generation or g, g)
-        if family == "go":
+        if check_go and family == "go":
             gen = kv_keys.FAMILIES["go"].regex.match(key).group("gen")
             prefix = kv_keys.rank_and_size_prefix(int(gen))
             if not any(k.startswith(prefix) for k in seen_keys):
                 out.append(
-                    f"wal[{i}]: {key}: go barrier released before any "
-                    f"{prefix}* topology record existed")
-    if n == 0 and not (Path(kv_dir) / "wal.log").exists() and \
-            not (Path(kv_dir) / "snapshot.json").exists():
+                    f"{label}[{i}]: {key}: go barrier released before "
+                    f"any {prefix}* topology record existed")
+    return out
+
+
+def _audit_cross_shard(ops: List[dict]) -> List[str]:
+    """Epoch + generation monotonicity over the MERGED commit order (the
+    server-global ``"s"`` sequence) — per-shard audits can each be clean
+    while a stale driver's writes interleave regressively across shards."""
+    out: List[str] = []
+    max_e: Optional[int] = None
+    max_gen: Optional[int] = None
+    for op in ops:
+        claimed = op.get("e")
+        if claimed is not None:
+            e = int(claimed)
+            if max_e is not None and e < max_e:
+                out.append(
+                    f"cross-shard s={op['s']}: op claimed control epoch "
+                    f"{e} after {max_e} was admitted in another shard — "
+                    "a fenced-out stale driver's mutation landed "
+                    "(split-brain)")
+            max_e = max(max_e or e, e)
+        if op.get("op") != "put":
+            continue
+        m = kv_keys.match(op.get("k", ""))
+        if m is None or m[0] not in _GENERATION_FAMILIES:
+            continue
+        val = _decoded_value(op)
+        if isinstance(val, dict) and "generation" in val:
+            try:
+                g = int(val["generation"])
+            except (TypeError, ValueError):
+                continue
+            if max_gen is not None and g < max_gen:
+                out.append(
+                    f"cross-shard s={op['s']}: {op['k']}: generation "
+                    f"regressed ({g} after {max_gen}) across shards")
+            max_gen = max(max_gen or g, g)
+    return out
+
+
+def check_kv_wal(kv_dir) -> List[str]:
+    """Divergences between a KV's write-ahead logs and the protocol
+    rules. Empty list = conformant. Each shard's WAL (``wal.log`` for
+    core, ``wal-<shard>.log`` otherwise) is audited independently, then
+    the ``"s"``-stamped ops of every shard are merged back into the
+    server-global commit order for the cross-shard epoch/generation
+    monotonicity pass."""
+    out: List[str] = []
+    kv_dir = Path(kv_dir)
+    shard_files = {"core": ("wal.log", "snapshot.json")}
+    for f in sorted(kv_dir.glob("wal-*.log")):
+        shard = f.name[len("wal-"):-len(".log")]
+        shard_files[shard] = (f.name, f"snapshot-{shard}.json")
+    for f in sorted(kv_dir.glob("snapshot-*.json")):
+        shard = f.name[len("snapshot-"):-len(".json")]
+        shard_files.setdefault(shard, (f"wal-{shard}.log", f.name))
+    any_artifact = False
+    populated = 0
+    all_stamped: List[dict] = []
+    for shard, (wal_file, snap_file) in shard_files.items():
+        if (kv_dir / wal_file).exists() or (kv_dir / snap_file).exists():
+            any_artifact = True
+        ops = list(iter_wal_ops(kv_dir, wal_file))
+        if ops:
+            populated += 1
+        all_stamped += [op for op in ops if isinstance(op.get("s"), int)]
+        seen_keys = set(load_snapshot_keys(kv_dir, snap_file))
+        label = "wal" if shard == "core" else f"wal-{shard}"
+        out += _audit_stream(ops, label, seen_keys,
+                             shard=None if shard == "core" else shard,
+                             check_go=(shard == "core"))
+    if populated > 1:
+        all_stamped.sort(key=lambda op: op["s"])
+        out += _audit_cross_shard(all_stamped)
+    if not any_artifact:
         out.append(f"{kv_dir}: no wal.log or snapshot.json — not a "
                    "durable KV directory")
     return out

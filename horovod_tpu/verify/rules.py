@@ -46,6 +46,28 @@ def worker_accepts(floor: int, offered: Optional[int]) \
     return True, offered
 
 
+def majority(replicas: int) -> int:
+    """Quorum size for a replica set (``runner/replica_kv.py``): a write
+    is committed — and an election won — only when this many replicas
+    (leader/candidate included) hold it."""
+    return replicas // 2 + 1
+
+
+def vote_grants(voter_epoch: int, voter_len: int, cand_epoch: int,
+                cand_len: int, heard_from_leader: bool) -> bool:
+    """The replica election grant rule (``ReplicaKVServer`` vote
+    handler): a voter grants a candidate iff
+
+    - it has NOT heard from a live leader inside the lease window (the
+      clock assumption that makes at-most-one-leaseholder hold), and
+    - the candidate proposes a strictly newer epoch, and
+    - the candidate's WAL is at least as long as the voter's — the
+      highest-(epoch, WAL-length) replica wins, so no acked (majority-
+      replicated) write can be missing from the new leader."""
+    return (not heard_from_leader) and cand_epoch > voter_epoch \
+        and cand_len >= voter_len
+
+
 def express_eligible(size_bytes: int, threshold: int,
                      grouped: bool = False,
                      data_bearing: bool = True) -> bool:

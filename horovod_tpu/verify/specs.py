@@ -1,6 +1,8 @@
 """Executable protocol specs for the control plane.
 
-Four explicit state machines covering the five interlocking protocols:
+Explicit state machines covering the interlocking control protocols
+(the ``SPECS`` registry at the bottom is the authoritative list —
+``hvd-check`` and ``make check-protocols`` enumerate it):
 
 - :class:`CycleSpec` — the coordination cycle + fast abort + the
   express-lane response partition (cross-rank exec-order agreement);
@@ -9,7 +11,12 @@ Four explicit state machines covering the five interlocking protocols:
 - :class:`DrainSpec` — preemption drain → shard handoff → resize, with
   the driver's scan-before-refresh heartbeat ordering and the reap-time
   last-chance drain check;
-- :class:`TuneSpec` — the cycle-boundary ``TunedParams`` broadcast.
+- :class:`TuneSpec` — the cycle-boundary ``TunedParams`` broadcast;
+- :class:`AutoscaleSpec` — the SLO→fleet-size decision loop;
+- :class:`PagedCacheSpec` — serving block-paged KV-cache accounting;
+- :class:`ScrapeSpec` — the tiered telemetry scrape plane;
+- :class:`ReplicaSpec` — leader-lease KV replication: majority-ack
+  commit, epoch-as-term elections, self-fencing, divergence repair.
 
 Spec constants come from the real code: the express threshold and flag
 bits are parsed out of ``engine/src`` (``engine_constants``), KV keys in
@@ -951,6 +958,279 @@ class AutoscaleSpec(Spec):
 
 
 # ===========================================================================
+# Replicated control plane: leader lease, majority replication, election
+# ===========================================================================
+
+class ReplicaState(NamedTuple):
+    believes: tuple    # per replica: believes it holds a valid lease
+    epoch: tuple       # per replica: adopted control epoch (term)
+    log: tuple         # per replica: tuple of WAL entries — a positive
+    #                    int is a client write id, a negative int -e is
+    #                    the lease record persisted at the epoch-e grant
+    alive: tuple       # per replica: process up
+    part: tuple        # per replica: partitioned off from the others
+    lease_live: bool   # the current grant's real-time window is open
+    #                    (followers must wait it out before electing)
+    acked: frozenset   # write ids acked to the client
+    regressed: bool    # a grant's epoch failed to exceed every prior one
+    writes_left: int
+    retries_left: int
+    kills_left: int
+    parts_left: int
+    heals_left: int
+    elects_left: int
+
+
+class ReplicaSpec(Spec):
+    """Three KV replicas (``runner/replica_kv.py``), one client write +
+    one retry of it (same idempotency token), modeled at the grain the
+    protocol argues at: lease grants, majority-acked appends, elections,
+    rejoin resync. Faults: one replica kill, one partition (isolating
+    one replica), one heal. ``lease_live`` is the bounded-clock
+    abstraction — while True, no correct voter grants (it is still
+    inside the lease wait window); expiry requires the leaseholder dead
+    or partitioned (a healthy leader keeps renewing), and the expiring
+    leader **self-fences** in the same instant (its own write-path lease
+    check — exactly what ``stale_lease_accepts_write`` removes).
+
+    The election rule is the shared :func:`rules.vote_grants` /
+    :func:`rules.majority` pair the real vote handler uses, and the
+    lease record the winner replicates is IN the model (a log entry):
+    it is load-bearing — a deposed leader carries at most one un-acked
+    suffix record (it self-fences on the first majority-refused write),
+    and the grant record keeps every majority log at least that long,
+    which is why highest-(epoch, WAL-length) never elects a leader
+    missing an acked write."""
+
+    N = 3
+    WRITE = 1  # the one modeled client write id
+
+    def __init__(self, stale_lease_accepts_write: bool = False,
+                 election_without_majority: bool = False,
+                 retry_double_apply: bool = False):
+        super().__init__(name="replica", mutations=tuple(
+            m for m, on in [
+                ("stale_lease_accepts_write", stale_lease_accepts_write),
+                ("election_without_majority", election_without_majority),
+                ("retry_double_apply", retry_double_apply)] if on))
+        self.stale_lease = stale_lease_accepts_write
+        self.minority_elect = election_without_majority
+        self.double_apply = retry_double_apply
+
+    def initial(self) -> ReplicaState:
+        n = self.N
+        return ReplicaState(
+            believes=(False,) * n, epoch=(0,) * n, log=((),) * n,
+            alive=(True,) * n, part=(False,) * n, lease_live=False,
+            acked=frozenset(), regressed=False,
+            writes_left=1, retries_left=1, kills_left=1, parts_left=1,
+            heals_left=1, elects_left=2)
+
+    # -- helpers --------------------------------------------------------------
+
+    def _reachable(self, s: ReplicaState, i: int) -> List[int]:
+        """Peers replica i can talk to: alive, and on the same side of
+        the (single modeled) partition."""
+        return [j for j in range(self.N)
+                if j != i and s.alive[j] and s.part[j] == s.part[i]]
+
+    @staticmethod
+    def _max_holder_epoch(s: ReplicaState) -> int:
+        """Highest epoch any lease was ever granted at — recoverable
+        from the persisted lease records, so not extra state."""
+        return max([0] + [-e for log in s.log for e in log if e < 0])
+
+    # -- transitions ----------------------------------------------------------
+
+    def actions(self, s: ReplicaState):
+        out = []
+        for c in range(self.N):
+            if s.alive[c] and not s.believes[c] and s.elects_left > 0:
+                act = self._elect(s, c)
+                if act is not None:
+                    out.append(act)
+        for i in range(self.N):
+            if s.believes[i] and s.alive[i]:
+                if s.writes_left > 0:
+                    out.append(self._write(s, i, retry=False))
+                if s.retries_left > 0 and s.writes_left == 0:
+                    out.append(self._write(s, i, retry=True))
+        holder_blocked = all(
+            not s.believes[i] or not s.alive[i] or s.part[i]
+            for i in range(self.N))
+        if s.lease_live and holder_blocked:
+            believes = s.believes if self.stale_lease \
+                else (False,) * self.N
+            label = ("lease expires; the unreachable leader self-fences "
+                     "on its own expiry check"
+                     if not self.stale_lease else
+                     "lease expires (MUTATION: the leader's write-path "
+                     "expiry check is gone — it keeps accepting)")
+            out.append((label, s._replace(lease_live=False,
+                                          believes=believes)))
+        for i in range(self.N):
+            if s.alive[i] and s.kills_left > 0:
+                out.append((
+                    f"fault: replica{i} SIGKILLed"
+                    + (" (the leaseholder)" if s.believes[i] else ""),
+                    s._replace(alive=_rep(s.alive, i, False),
+                               believes=_rep(s.believes, i, False),
+                               kills_left=s.kills_left - 1)))
+            if s.alive[i] and not s.part[i] and s.parts_left > 0:
+                out.append((
+                    f"fault: replica{i} partitioned off",
+                    s._replace(part=_rep(s.part, i, True),
+                               parts_left=s.parts_left - 1)))
+        if any(s.part) and s.heals_left > 0:
+            out.append((
+                "partition heals (links restored)",
+                s._replace(part=(False,) * self.N,
+                           heals_left=s.heals_left - 1)))
+        resync = self._resync(s)
+        if resync is not None:
+            out.append(resync)
+        return out
+
+    def _elect(self, s: ReplicaState, c: int):
+        electorate = self._reachable(s, c)
+        proposed = max([s.epoch[c]] + [s.epoch[j] for j in electorate]) + 1
+        votes = 1  # self
+        granting = []
+        for j in electorate:
+            heard = s.lease_live or s.believes[j]
+            if rules.vote_grants(s.epoch[j], len(s.log[j]), proposed,
+                                 len(s.log[c]), heard):
+                votes += 1
+                granting.append(j)
+        quorum = 1 if self.minority_elect else rules.majority(self.N)
+        if votes < quorum:
+            return None  # a failed solicitation changes nothing
+        regressed = s.regressed or proposed <= self._max_holder_epoch(s)
+        epoch = s.epoch
+        log = s.log
+        # the winner persists + replicates the lease record (its first
+        # majority-acked append); granting voters adopt the new epoch
+        for j in [c] + granting:
+            epoch = _rep(epoch, j, proposed)
+            log = _rep(log, j, s.log[j] + (-proposed,))
+        label = (f"replica{c} elected: epoch {proposed}, "
+                 f"{votes}/{self.N} votes; lease record replicated")
+        if self.minority_elect and votes < rules.majority(self.N):
+            label = (f"replica{c} elects ITSELF (MUTATION: {votes} "
+                     f"vote(s), no majority) at epoch {proposed}")
+        return label, s._replace(
+            believes=_rep(s.believes, c, True), epoch=epoch, log=log,
+            lease_live=True, regressed=regressed,
+            elects_left=s.elects_left - 1)
+
+    def _write(self, s: ReplicaState, i: int, retry: bool):
+        w = self.WRITE
+        budget = {"retries_left": s.retries_left - 1} if retry \
+            else {"writes_left": s.writes_left - 1}
+        tag = "retried " if retry else ""
+        if retry and not self.double_apply and w in s.log[i]:
+            # the (client, seq) token was already applied here — dedupe
+            # drops the replay and re-acks
+            return (f"replica{i} dedupes the retried write (token "
+                    f"already applied)",
+                    s._replace(acked=s.acked | {w}, **budget))
+        mutated = retry and self.double_apply and w in s.log[i]
+        reachable = self._reachable(s, i)
+        refused = any(s.epoch[j] > s.epoch[i] for j in reachable)
+        if refused:
+            # a follower on a newer term 409s the forward: the deposed
+            # leader self-fences; its local append is the un-acked
+            # suffix resync later truncates
+            return (f"replica{i}'s {tag}write forward is 409'd by a "
+                    f"newer-term follower; it self-fences",
+                    s._replace(log=_rep(s.log, i, s.log[i] + (w,)),
+                               believes=_rep(s.believes, i, False),
+                               **budget))
+        # only a follower whose log matches the leader's accepts the
+        # append (the real prev-seq check); a diverged one answers
+        # "resync me" and does NOT ack this round
+        accepting = [j for j in reachable if s.log[j] == s.log[i]]
+        if 1 + len(accepting) < rules.majority(self.N):
+            return (f"replica{i}'s {tag}write cannot reach a follower "
+                    f"majority; it self-fences un-acked",
+                    s._replace(log=_rep(s.log, i, s.log[i] + (w,)),
+                               believes=_rep(s.believes, i, False),
+                               **budget))
+        log = _rep(s.log, i, s.log[i] + (w,))
+        epoch = s.epoch
+        for j in accepting:
+            log = _rep(log, j, s.log[j] + (w,))
+            epoch = _rep(epoch, j, max(s.epoch[j], s.epoch[i]))
+        label = (f"replica{i} commits the {tag}write to a majority "
+                 f"({1 + len(accepting)}/{self.N}); acked")
+        if mutated:
+            label = (f"replica{i} re-appends the retried write "
+                     f"(MUTATION: dedupe token check skipped); acked")
+        return label, s._replace(
+            log=log, epoch=epoch, acked=s.acked | {w}, lease_live=True,
+            **budget)
+
+    def _resync(self, s: ReplicaState):
+        """The leader's heartbeat notices a reachable diverged follower
+        and ships it full state (the WAL-divergence repair path: the
+        follower's un-majority-committed suffix is truncated, loudly).
+        Unbudgeted — it converges (the guard disables once logs match),
+        like the real ticker retriggering until the fleet agrees."""
+        holder = next((i for i in range(self.N)
+                       if s.believes[i] and s.alive[i]), None)
+        if holder is None:
+            return None
+        diverged = [j for j in self._reachable(s, holder)
+                    if s.log[j] != s.log[holder]]
+        if not diverged:
+            return None
+        log, epoch = s.log, s.epoch
+        for j in diverged:
+            log = _rep(log, j, s.log[holder])
+            epoch = _rep(epoch, j, max(s.epoch[j], s.epoch[holder]))
+        return (f"leader resyncs diverged replica(s) "
+                f"{diverged} (un-committed WAL suffixes truncated)",
+                s._replace(log=log, epoch=epoch))
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        def one_leaseholder(s: ReplicaState) -> bool:
+            return sum(s.believes) <= 1
+
+        def no_acked_loss(s: ReplicaState) -> bool:
+            return all(w in s.log[i]
+                       for i in range(self.N) if s.believes[i]
+                       for w in s.acked)
+
+        def applied_once(s: ReplicaState) -> bool:
+            return all(log.count(self.WRITE) <= 1 for log in s.log)
+
+        return [
+            Invariant(
+                "at_most_one_leaseholder",
+                "no instant has two replicas both believing they hold "
+                "the lease (two writers accepting = split brain)",
+                one_leaseholder),
+            Invariant(
+                "no_acked_write_loss",
+                "every write acked to the client is present in the "
+                "current leaseholder's WAL — elections can never seat a "
+                "leader missing a majority-committed record",
+                no_acked_loss),
+            Invariant(
+                "epoch_monotonic_across_elections",
+                "every lease grant's epoch strictly exceeds every "
+                "earlier grant's (the fencing token never regresses)",
+                lambda s: not s.regressed),
+            Invariant(
+                "write_applied_at_most_once",
+                "a retried client op lands at most once in any "
+                "replica's WAL (the idempotency-token dedupe)",
+                applied_once),
+        ]
+
+
+# ===========================================================================
 # Registries
 # ===========================================================================
 
@@ -1328,6 +1608,7 @@ SPECS: Dict[str, type] = {
     "autoscale": AutoscaleSpec,
     "paged_cache": PagedCacheSpec,
     "scrape": ScrapeSpec,
+    "replica": ReplicaSpec,
 }
 
 # mutant name -> (spec name, constructor kwarg, description). Each is a
@@ -1418,6 +1699,22 @@ MUTANTS: Dict[str, Tuple[str, str, str]] = {
         "tier: the generation change keeps metrics_prev, so the first "
         "post-rebalance heartbeat diffs a restarted rank against a "
         "dead incarnation's counters"),
+    "replica_stale_lease_accepts_write": (
+        "replica", "stale_lease_accepts_write",
+        "the leader's write-path lease-expiry check removed: a slow "
+        "(paused/partitioned) leader keeps accepting writes after its "
+        "lease lapsed, so once a successor is elected two replicas "
+        "accept writes at the same instant (split brain)"),
+    "replica_election_without_majority": (
+        "replica", "election_without_majority",
+        "the election quorum check removed: a partitioned minority "
+        "replica elects itself on its own vote, producing a second "
+        "simultaneous leaseholder at a non-advancing epoch"),
+    "replica_retry_double_apply": (
+        "replica", "retry_double_apply",
+        "the (client, seq) idempotency-token dedupe removed: a client "
+        "retry after a timed-out-but-committed write re-appends the "
+        "same op, which lands twice in every replica's WAL"),
     "scrape_consume_stale_window": (
         "scrape", "consume_stale_window",
         "the per-host window floor removed: an age-fresh /agg.json "

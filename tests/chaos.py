@@ -186,6 +186,140 @@ class ControlPlane:
             pass
 
 
+def kv_replica_procs() -> Dict[int, List[str]]:
+    """PID -> argv for every live ``replica_kv`` subprocess (the chaos
+    surface for supervised runs: argv carries ``--id`` and the full
+    ``--endpoints`` list, so tests can find the leader from outside)."""
+    out: Dict[int, List[str]] = {}
+    for pid in find_worker_pids("horovod_tpu.runner.replica_kv"):
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                out[pid] = f.read().decode().split("\x00")
+        except OSError:
+            continue
+    return out
+
+
+def kill_kv_leader(timeout: float = 30.0, sig: int = signal.SIGKILL):
+    """SIGKILL the KV replica subprocess currently holding the lease.
+    Returns ``(pid, replica_id)``; asserts a replica fleet exists."""
+    from horovod_tpu.runner.replica_kv import wait_for_leader
+    procs = kv_replica_procs()
+    endpoints = None
+    for argv in procs.values():
+        if "--endpoints" in argv:
+            endpoints = argv[argv.index("--endpoints") + 1].split(",")
+            break
+    assert endpoints, "no replica_kv subprocess found"
+    st = wait_for_leader(endpoints, timeout=timeout)
+    assert st is not None, "no KV leader reachable"
+    lid = int(st["id"])
+    for pid, argv in procs.items():
+        if "--id" in argv and int(argv[argv.index("--id") + 1]) == lid:
+            os.kill(pid, sig)
+            return pid, lid
+    raise AssertionError(f"leader replica {lid} has no live process")
+
+
+class ReplicatedControlPlane:
+    """N ``replica_kv`` subprocesses + a failover client — the
+    replicated analog of :class:`ControlPlane` (ISSUE 19).
+
+    ``kill_leader()`` SIGKILLs the leaseholder's process (a follower
+    must win the next election and bump the epoch); ``partition_leader``
+    SIGSTOPs it for the scope of the returned context — its sockets stay
+    open but silent, the classic split-brain probe — and SIGCONTs on
+    exit, after which the deposed leader must rejoin as a follower and
+    resync to byte-identical state."""
+
+    def __init__(self, base_dir: str, replicas: int = 3,
+                 lease_seconds: float = 0.4):
+        from horovod_tpu.runner import replica_kv
+        from horovod_tpu.runner.http_kv import KVClient
+        from horovod_tpu.runner.launch import free_port
+        self._rk = replica_kv
+        self.base_dir = base_dir
+        self.lease = lease_seconds
+        self.endpoints = [f"127.0.0.1:{free_port()}"
+                          for _ in range(replicas)]
+        self.procs = {
+            i: replica_kv.spawn_replica(i, self.endpoints, base_dir,
+                                        lease_seconds=lease_seconds)
+            for i in range(replicas)}
+        st = replica_kv.wait_for_leader(self.endpoints, timeout=30.0)
+        assert st is not None, "no KV leader elected at bootstrap"
+        self.epochs = [int(st["epoch"])]
+        host, _, port = self.endpoints[0].rpartition(":")
+        self.client = KVClient(host, int(port), endpoints=self.endpoints)
+
+    def leader(self, timeout: float = 30.0) -> dict:
+        st = self._rk.wait_for_leader(self.endpoints, timeout=timeout)
+        assert st is not None, "no KV leader emerged"
+        return st
+
+    def await_leader_other_than(self, old_id: int,
+                                timeout: float = 30.0) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self._rk.wait_for_leader(
+                self.endpoints, timeout=max(0.5, deadline -
+                                            time.monotonic()))
+            if st is not None and int(st["id"]) != old_id:
+                self.epochs.append(int(st["epoch"]))
+                return st
+            time.sleep(0.1)
+        raise AssertionError(
+            f"no leader other than replica {old_id} within {timeout}s")
+
+    def kill_leader(self) -> int:
+        lid = int(self.leader()["id"])
+        self.procs[lid].kill()
+        self.procs[lid].wait()
+        return lid
+
+    def respawn(self, replica_id: int):
+        self.procs[replica_id] = self._rk.spawn_replica(
+            replica_id, self.endpoints, self.base_dir,
+            lease_seconds=self.lease)
+
+    @contextlib.contextmanager
+    def partition_leader(self):
+        lid = int(self.leader()["id"])
+        with Partition(self.procs[lid].pid):
+            yield lid
+
+    def statuses(self) -> Dict[str, Optional[dict]]:
+        return self._rk.replica_statuses(self.endpoints)
+
+    def store_hashes(self, settle: float = 0.0) -> Dict[int, str]:
+        """``replica_id -> store_hash`` for live replicas; with
+        ``settle`` polls until every live replica reports the same hash
+        (resync convergence) or the deadline passes."""
+        deadline = time.monotonic() + settle
+        while True:
+            live = [st for st in self.statuses().values() if st]
+            hashes = {int(st["id"]): st["store_hash"] for st in live}
+            converged = (len(live) == len(self.endpoints)
+                         and len(set(hashes.values())) <= 1)
+            if converged or time.monotonic() > deadline:
+                return hashes
+            time.sleep(0.1)
+
+    def replica_dirs(self) -> List[str]:
+        return [self._rk.replica_dir(self.base_dir, i)
+                for i in range(len(self.endpoints))]
+
+    def close(self):
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+
+
 # ===========================================================================
 # Simulated elastic cluster (ISSUE 9): real ShardedState protocol over an
 # in-memory collective bus, at world sizes subprocesses can't reach.

@@ -130,18 +130,27 @@ def test_int8_resize_wire_cut(monkeypatch):
 
 
 def _interleave_soak(world: int, events: int, seed: int,
-                     control_plane=None):
+                     control_plane=None, replica_plane=None):
     """``control_plane``: an optional chaos.ControlPlane sidecar — ISSUE
     10 mixes ``driver_kill`` events into the schedule: the durable KV is
     killed mid-soak and restarted (WAL replay + epoch bump) while the
     cluster keeps training through the outage, and the store must come
-    back byte-identical."""
+    back byte-identical.
+
+    ``replica_plane``: an optional chaos.ReplicatedControlPlane — ISSUE
+    19 mixes ``kv_leader_kill`` and ``kv_partition`` events in: the KV
+    leaseholder is SIGKILLed (or SIGSTOPped past its lease) mid-soak, a
+    follower must win the election and bump the epoch while training
+    continues, every write acked before the fault must survive it, and
+    after heal the surviving replicas converge byte-identically."""
     rng = np.random.RandomState(seed)
     bound = env_float("HOROVOD_ELASTIC_RECOVERY_BOUND_SECONDS")
     recoveries = []
     kinds = ["kill", "drain", "partition", "rejoin", "drain_kill"]
     if control_plane is not None:
         kinds.append("driver_kill")
+    if replica_plane is not None:
+        kinds += ["kv_leader_kill", "kv_partition"]
     with chaos.SimCluster(world, n_params=world * 100,
                           block_size=64, seed=seed) as c:
         for ev in range(events):
@@ -149,6 +158,10 @@ def _interleave_soak(world: int, events: int, seed: int,
             c.run_steps(int(rng.randint(0, 3)))  # live, uncommitted tail
             n = len(c.members)
             kind = rng.choice(kinds)
+            if replica_plane is not None and ev in (2, 6):
+                # guarantee both KV fault kinds land regardless of what
+                # the seeded draw happens to pick
+                kind = "kv_leader_kill" if ev == 2 else "kv_partition"
             if kind == "kill" and n > max(2, world // 2):
                 c.kill(int(rng.randint(n)))
             elif kind == "drain" and n > max(2, world // 2):
@@ -178,6 +191,45 @@ def _interleave_soak(world: int, events: int, seed: int,
                 assert cp.kv.recovered
                 assert cp.store() == before, \
                     "KV state changed across kill+replay"
+            elif kind == "kv_leader_kill":
+                rp = replica_plane
+                rp.client.put_json(f"soak/ev{100 + ev}", {"event": ev},
+                                   deadline=20.0)
+                lid = rp.kill_leader()
+                # the leaseholder is DOWN: training continues — the
+                # data plane never depended on the control plane
+                c.run_steps(1, commit_every=1)
+                c.check_consistency()
+                rp.await_leader_other_than(lid, timeout=30.0)
+                assert rp.epochs == sorted(rp.epochs), \
+                    "KV epoch regressed across an election"
+                # the pre-kill acked write survived, and the healed set
+                # (dead replica respawned over its own WAL) converges to
+                # byte-identical state
+                assert rp.client.get_json(f"soak/ev{100 + ev}",
+                                          timeout=10.0) == {"event": ev}
+                rp.client.put_json(f"soak/ev{300 + ev}", {"event": ev},
+                                   deadline=20.0)
+                rp.respawn(lid)
+                hashes = rp.store_hashes(settle=30.0)
+                assert len(set(hashes.values())) == 1, \
+                    f"replica stores diverged after heal: {hashes}"
+            elif kind == "kv_partition":
+                rp = replica_plane
+                rp.client.put_json(f"soak/ev{100 + ev}", {"event": ev},
+                                   deadline=20.0)
+                with rp.partition_leader() as lid:
+                    rp.await_leader_other_than(lid, timeout=30.0)
+                    c.run_steps(1, commit_every=1)
+                    c.check_consistency()
+                    rp.client.put_json(f"soak/ev{200 + ev}",
+                                       {"event": ev}, deadline=20.0)
+                hashes = rp.store_hashes(settle=30.0)
+                assert len(set(hashes.values())) == 1, \
+                    f"split-brain state survived heal: {hashes}"
+                assert rp.client.get_json(f"soak/ev{200 + ev}",
+                                          timeout=10.0) == {"event": ev}
+                assert rp.epochs == sorted(rp.epochs)
             # partition: membership unchanged — the identity fast path
             recoveries.append(c.resize())
             c.check_consistency()
@@ -249,6 +301,39 @@ def test_chaos_soak_64_ranks_with_driver_kills(tmp_path):
     finally:
         cp.close()
         headless._reset_for_tests()
+
+
+@pytest.mark.slow
+def test_chaos_soak_64_ranks_with_kv_leader_kills(tmp_path):
+    """ISSUE 19 soak variant (`make soak`): the 64-rank event schedule
+    with replicated-control-plane faults mixed in — the KV leaseholder
+    is SIGKILLed or partitioned mid-soak while training and resizes
+    continue. Every event asserts: a follower won the election, the
+    epoch only moved forward, no acked write was lost, and the healed
+    replica set converged byte-identically. The surviving per-shard
+    WALs are exported for ``make conformance`` and must replay clean on
+    every replica."""
+    rp = chaos.ReplicatedControlPlane(str(tmp_path / "kv"),
+                                      lease_seconds=0.3)
+    try:
+        pre_soak_epochs = len(rp.epochs)
+        recoveries = _interleave_soak(world=64, events=10, seed=11,
+                                      replica_plane=rp)
+        assert len(recoveries) == 10
+        assert len(rp.epochs) > pre_soak_epochs, \
+            "seeded schedule produced no KV fault event"
+        assert rp.epochs == sorted(rp.epochs)
+        # freeze the fleet, export the per-shard WALs, replay them
+        # against the protocol rules on EVERY replica — the soak doubles
+        # as the conformance oracle, replicated edition
+        rp.close()
+        from horovod_tpu.verify import conformance
+        conformance.copy_soak_artifacts(kv_dir=rp.base_dir)
+        for d in rp.replica_dirs():
+            divergences = conformance.check_kv_wal(d)
+            assert divergences == [], divergences
+    finally:
+        rp.close()
 
 
 @pytest.mark.slow

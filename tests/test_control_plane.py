@@ -825,3 +825,107 @@ def test_driver_kill_mid_training_acceptance(tmp_path):
                 f"{name}: stepping paused {max(gaps):.1f}s at driver kill"
             gap_ok = True
     assert gap_ok, "no worker log covered the driver-kill window"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: the replicated control plane under the supervised launcher —
+# SIGKILL the KV *leaseholder* (not the driver) and the job must ride
+# the election.
+
+
+def _check_replica_wals(base_dir: str, replicas: int = 3):
+    from horovod_tpu.runner.replica_kv import replica_dir
+    from horovod_tpu.verify import conformance
+    for i in range(replicas):
+        d = replica_dir(base_dir, i)
+        divergences = conformance.check_kv_wal(d)
+        assert divergences == [], (i, divergences)
+
+
+def test_kv_leader_kill_smoke_subprocess(tmp_path):
+    """Supervised launch with ``HOROVOD_KV_REPLICAS=3``: SIGKILL the KV
+    leaseholder while engine-less workers step. A follower must win the
+    election, the supervisor respawns the dead replica, worker
+    heartbeats and the final SUCCESS records ride the failover client,
+    and the job completes rc 0 with conformance-clean per-shard WALs on
+    every replica."""
+    proc, _ = _launch_supervised(tmp_path, SMOKE_WORKER,
+                                 {"WORK_SECONDS": "8",
+                                  "HOROVOD_KV_REPLICAS": "3",
+                                  "HOROVOD_KV_LEASE_SECONDS": "0.5"})
+    lines = []
+    assert _read_until(proc, "smoke-step", 45, lines), "".join(lines)
+    _pid, lid = chaos.kill_kv_leader()
+    try:
+        out, _ = proc.communicate(timeout=90)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    text = "".join(lines) + out.decode(errors="replace")
+    assert proc.returncode == 0, text
+    assert "kv_replica_respawn" in text, text  # supervisor healed fleet
+    assert "elected leader" in text, text      # a follower took over
+    done = [line for line in text.splitlines() if "smoke-done" in line]
+    assert len(done) == 2, text
+    assert f'"replica": {lid}' in text, text   # the leader was the victim
+    _check_replica_wals(str(tmp_path / "kvdir"))
+
+
+ACCEPT_KV_TRAIN = ACCEPT_TRAIN
+
+
+@pytest.mark.slow
+def test_kv_leader_kill_mid_training_acceptance(tmp_path):
+    """ISSUE 19 acceptance: SIGKILL the KV leaseholder mid-ZeRO-training
+    under a 3-replica control plane. A follower is elected (epoch bump),
+    training and heartbeats continue through the failover, and a
+    subsequent worker SIGKILL still drives the full blacklist → resize →
+    recovery path against the replica set — zero acked-write loss (the
+    recovered protocol state is exactly what the resize needs), zero
+    split-brain (conformance-clean, epoch-monotone WALs everywhere)."""
+    proc, worker = _launch_supervised(
+        tmp_path, ACCEPT_KV_TRAIN,
+        {"TOTAL_STEPS": "400",
+         "HOROVOD_KV_REPLICAS": "3",
+         "HOROVOD_KV_LEASE_SECONDS": "0.5",
+         "HOROVOD_CONTROLLER_TIMEOUT_SECONDS": "10",
+         "HOROVOD_FAILURES_TO_BLACKLIST": "1",
+         "HOROVOD_BLACKLIST_COOLDOWN_SECONDS": "2",
+         "HOROVOD_ELASTIC_RETRY_BACKOFF_SECONDS": "0.1"})
+    lines = []
+    assert _read_until(proc, "step=5 ", 120, lines), "".join(lines)
+
+    # --- phase 1: kill the KV LEASEHOLDER, not the driver, not a worker
+    _pid, lid = chaos.kill_kv_leader()
+    assert _read_until(proc, "elected leader", 60, lines), "".join(lines)
+    # training never stopped while the election ran
+    assert _read_until(proc, "aprogress", 30, lines), "".join(lines)
+
+    # --- phase 2: a worker dies — the elastic resize must complete
+    # against the post-failover replica set
+    killed = chaos.kill_workers("cp_worker.py", sig=signal.SIGKILL,
+                                count=1)
+    assert killed, "no worker found to kill"
+    try:
+        out, _ = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    text = "".join(lines) + out.decode(errors="replace")
+    assert proc.returncode == 0, text
+    assert "blacklisting localhost" in text, text
+    assert "accept-done" in text, text
+    assert "kv_replica_respawn" in text, text
+    assert f'"replica": {lid}' in text, text
+    # per-rank step sequences never decrease: no acked protocol state
+    # (generation, go-barrier, worker records) was lost to the failover
+    per_rank = {}
+    for line in text.splitlines():
+        if "aprogress" in line and "step=" in line:
+            r = int(line.split("rank=")[1].split()[0])
+            s = int(line.split("step=")[1].split()[0])
+            assert s >= per_rank.get(r, 0), \
+                f"rank {r} rolled back to step {s}:\n{text}"
+            per_rank[r] = s
+    assert per_rank and max(per_rank.values()) == 400, per_rank
+    _check_replica_wals(str(tmp_path / "kvdir"))

@@ -1,0 +1,472 @@
+"""Leader-lease replicated control-plane KV (ISSUE 19).
+
+In-process ``ReplicaKVServer`` sets cover the protocol core — single
+leaseholder at bootstrap, majority-acked writes surviving a leader stop,
+follower redirects, self-fencing without a majority, retry dedupe after
+a killed ack path, and WAL-divergence repair on rejoin. Subprocess sets
+(the chaos harness's :class:`~chaos.ReplicatedControlPlane`) cover the
+real failure surface: SIGKILLed leaders and SIGSTOP partitions, with
+byte-identical store convergence after heal and conformance-clean
+per-shard WALs. The shared election rules (``verify/rules.py``) are
+asserted against both the model (tests/test_verify.py enrolls
+``ReplicaSpec``) and the live server here — one contract, three
+enforcement points.
+"""
+
+import base64
+import contextlib
+import json
+import logging
+import socket
+import time
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+import pytest
+
+import chaos
+from horovod_tpu.common import kv_keys
+from horovod_tpu.runner import replica_kv
+from horovod_tpu.runner.http_kv import (CLIENT_HEADER, EPOCH_HEADER,
+                                        SEQ_HEADER, KVClient,
+                                        NotLeaderError, StaleEpochError)
+from horovod_tpu.verify import rules
+
+LEASE = 0.4
+
+
+@contextlib.contextmanager
+def replica_set(tmp_path, n=3, lease=LEASE):
+    from horovod_tpu.runner.launch import free_port
+    eps = [f"127.0.0.1:{free_port()}" for _ in range(n)]
+    servers = [replica_kv.ReplicaKVServer(
+        i, eps, kv_dir=replica_kv.replica_dir(str(tmp_path), i),
+        lease_seconds=lease).start() for i in range(n)]
+    try:
+        yield eps, servers
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — already stopped is fine
+                pass
+
+
+def _leader(eps, servers, timeout=20.0):
+    st = replica_kv.wait_for_leader(eps, timeout=timeout)
+    assert st is not None, "no leader elected"
+    return servers[int(st["id"])], st
+
+
+def _status(ep):
+    with urlrequest.urlopen(f"http://{ep}/replica_status",
+                            timeout=2.0) as resp:
+        return json.loads(resp.read())
+
+
+@contextlib.contextmanager
+def _capture_replica_logs():
+    logger = logging.getLogger("horovod_tpu.runner.replica_kv")
+    records = []
+
+    class _Cap(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = _Cap()
+    logger.addHandler(h)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(h)
+
+
+# ---------------------------------------------------------------------------
+# protocol core (in-process replica sets)
+
+
+def test_bootstrap_elects_exactly_one_leader(tmp_path):
+    with replica_set(tmp_path) as (eps, servers):
+        leader, st = _leader(eps, servers)
+        assert st["epoch"] >= 1  # winning bumped the epoch
+        time.sleep(LEASE)  # one heartbeat round settles follower views
+        statuses = [_status(ep) for ep in eps]
+        assert sum(s["role"] == "leader" for s in statuses) == 1
+        # every follower agrees on WHO leads
+        assert {s["leader"] for s in statuses} == {leader.replica_id}
+
+
+def test_acked_write_survives_leader_stop(tmp_path):
+    with replica_set(tmp_path) as (eps, servers):
+        leader, st = _leader(eps, servers)
+        client = KVClient("127.0.0.1", 0, endpoints=eps)
+        client.put_json("soak/k1", {"v": 1}, deadline=20.0)
+        leader.stop()
+        deadline = time.monotonic() + 20.0
+        new_st = None
+        while time.monotonic() < deadline:
+            new_st = replica_kv.wait_for_leader(eps, timeout=2.0)
+            if new_st and int(new_st["id"]) != leader.replica_id:
+                break
+        assert new_st and int(new_st["id"]) != leader.replica_id, \
+            "no follower took over"
+        assert new_st["epoch"] > st["epoch"], "election did not bump epoch"
+        # the acked write is on the new leader (no-acked-write-loss), and
+        # the surviving set still accepts writes
+        assert client.get_json("soak/k1", timeout=10.0) == {"v": 1}
+        client.put_json("soak/k2", {"v": 2}, deadline=20.0)
+        assert client.get_json("soak/k2", timeout=10.0) == {"v": 2}
+
+
+def test_follower_redirects_client_to_leader(tmp_path):
+    with replica_set(tmp_path) as (eps, servers):
+        leader, _ = _leader(eps, servers)
+        follower_ep = next(ep for i, ep in enumerate(eps)
+                           if i != leader.replica_id)
+        host, _, port = follower_ep.rpartition(":")
+        # a client pinned to a FOLLOWER: the 307 + leader-hint redirect
+        # must land the write on the leaseholder
+        pinned = KVClient(host, int(port))
+        pinned.put_json("soak/via_follower", {"ok": True}, deadline=20.0)
+        assert leader.get_json("soak/via_follower") == {"ok": True}
+
+
+def test_leader_without_majority_self_fences(tmp_path):
+    with replica_set(tmp_path) as (eps, servers):
+        leader, _ = _leader(eps, servers)
+        for s in servers:
+            if s is not leader:
+                s.stop()
+        client = KVClient("127.0.0.1", 0, endpoints=[
+            eps[leader.replica_id]])
+        with _capture_replica_logs() as records:
+            with pytest.raises((NotLeaderError, urlerror.URLError,
+                                ConnectionError)):
+                client.put_json("soak/lost", {"v": 1}, attempts=2,
+                                deadline=5.0)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and \
+                    _status(eps[leader.replica_id])["role"] == "leader":
+                time.sleep(0.1)
+        st = _status(eps[leader.replica_id])
+        assert st["role"] == "follower", \
+            "leader kept the lease with no reachable majority"
+        assert any("self-fencing" in m for m in records), records
+        # the write was never ACKED (pytest.raises above) — it may sit
+        # in the deposed leader's local store as an un-committed suffix,
+        # which is exactly what divergence repair truncates on rejoin
+        # (test_wal_divergence_repair_truncates_and_tripwires)
+
+
+def test_retry_after_killed_ack_path_applies_once(tmp_path):
+    """Satellite (b) regression: the client commits a put, the ack dies
+    on the wire (connection closed before the response is read), and the
+    retry carries the SAME (client, seq) token — the server must dedupe
+    instead of double-applying."""
+    with replica_set(tmp_path) as (eps, servers):
+        leader, st0 = _leader(eps, servers)
+        ep = eps[leader.replica_id]
+        host, _, port = ep.rpartition(":")
+        body = json.dumps({"n": 7}).encode()
+        headers = {EPOCH_HEADER: str(st0["epoch"]),
+                   CLIENT_HEADER: "dupetest", SEQ_HEADER: "1"}
+        seq0 = _status(ep)["seq"]
+        # first attempt: full request sent, connection slammed shut
+        # before reading the ack — the server still commits
+        req = (f"PUT /soak/dupe HTTP/1.1\r\nHost: {host}\r\n"
+               + "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+               + f"Content-Length: {len(body)}\r\n"
+               "Connection: close\r\n\r\n").encode() + body
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(req)
+        s.close()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                leader.get_json("soak/dupe") is None:
+            time.sleep(0.05)
+        assert leader.get_json("soak/dupe") == {"n": 7}
+        seq1 = _status(ep)["seq"]
+        assert seq1 == seq0 + 1
+        # the retry: same token, this time the ack path works
+        r = urlrequest.Request(f"http://{ep}/soak/dupe", data=body,
+                               method="PUT", headers=headers)
+        with urlrequest.urlopen(r, timeout=5.0) as resp:
+            assert resp.status == 200
+        assert _status(ep)["seq"] == seq1, \
+            "retry of a committed op re-applied (double-apply)"
+        assert leader.get_json("soak/dupe") == {"n": 7}
+
+
+def test_wal_divergence_repair_truncates_and_tripwires(tmp_path):
+    """Satellite (d): a follower holding records that never reached a
+    majority (crafted un-committed suffix) must truncate them on rejoin
+    — loudly — and converge to the leader's exact state, including the
+    on-disk WAL."""
+    with replica_set(tmp_path) as (eps, servers):
+        leader, _ = _leader(eps, servers)
+        client = KVClient("127.0.0.1", 0, endpoints=eps)
+        client.put_json("soak/real", {"v": 1}, deadline=20.0)
+        follower = next(s for s in servers if s is not leader)
+        with _capture_replica_logs() as records:
+            with follower._lock:
+                # the un-majority-committed suffix: a record only this
+                # follower ever saw (a deposed leader's orphan forward)
+                follower._apply_record_locked(
+                    {"op": "put", "k": "soak/ghost",
+                     "v": base64.b64encode(b'{"boo": 1}').decode(),
+                     "s": follower._seq + 1})
+            assert follower.get_json("soak/ghost") is not None
+            # the next leader heartbeat sees the prev-seq mismatch and
+            # resyncs the follower from its own state
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and \
+                    follower.get_json("soak/ghost") is not None:
+                time.sleep(0.05)
+        assert follower.get_json("soak/ghost") is None, \
+            "divergent suffix survived rejoin"
+        assert any("WAL DIVERGENCE REPAIR" in m for m in records), records
+        with leader._lock, follower._lock:
+            assert follower._store_hash_locked() == \
+                leader._store_hash_locked()
+        # the repair rewrote the on-disk WAL too: a fresh replay of the
+        # follower's directory must NOT resurrect the ghost
+        fid = follower.replica_id
+        follower.stop()
+        reborn = replica_kv.ReplicaKVServer(
+            fid, eps, kv_dir=replica_kv.replica_dir(str(tmp_path), fid),
+            lease_seconds=LEASE)
+        assert reborn.get_json("soak/ghost") is None
+        assert reborn.get_json("soak/real") == {"v": 1}
+
+
+def test_vote_rules_agree_with_live_server(tmp_path):
+    """The house rule: ``verify/rules.py`` is the single source of truth
+    for vote grants — the model checker exercises it exhaustively, and
+    this test pins the LIVE server's /_replica/vote to the same
+    function."""
+    # the rule itself, at the boundary cases the spec closes over
+    assert rules.majority(3) == 2 and rules.majority(5) == 3
+    assert rules.vote_grants(1, 5, 2, 5, heard_from_leader=False)
+    assert not rules.vote_grants(1, 5, 2, 4, False)   # shorter WAL
+    assert not rules.vote_grants(2, 5, 2, 9, False)   # no epoch advance
+    assert not rules.vote_grants(1, 5, 2, 9, True)    # live leaseholder
+    with replica_set(tmp_path) as (eps, servers):
+        leader, st = _leader(eps, servers)
+        client = KVClient("127.0.0.1", 0, endpoints=eps)
+        client.put_json("soak/len", {"v": 1}, deadline=20.0)
+        follower_ep = next(ep for i, ep in enumerate(eps)
+                           if i != leader.replica_id)
+        voter = _status(follower_ep)
+
+        def vote(epoch, length):
+            req = urlrequest.Request(
+                f"http://{follower_ep}/_replica/vote",
+                data=json.dumps({"cand": 99, "epoch": epoch,
+                                 "len": length}).encode(),
+                method="POST")
+            with urlrequest.urlopen(req, timeout=2.0) as resp:
+                return json.loads(resp.read())["granted"]
+
+        # a live follower has heard from the leader: every grant refused,
+        # exactly what the rule says for heard_from_leader=True
+        probes = [(voter["epoch"] + 1, voter["seq"] - 1),  # shorter WAL
+                  (voter["epoch"], voter["seq"] + 5),      # stale epoch
+                  (voter["epoch"] + 1, voter["seq"] + 5)]  # heard
+        for epoch, length in probes:
+            assert vote(epoch, length) == rules.vote_grants(
+                voter["epoch"], voter["seq"], epoch, length, True)
+
+
+def test_handle_adopts_election_epoch_same_driver(tmp_path):
+    with replica_set(tmp_path) as (eps, servers):
+        adopted = []
+        handle = replica_kv.ReplicatedKVHandle(
+            eps, epoch_adopted=adopted.append).start(timeout=30.0)
+        epoch0 = handle.epoch
+        assert handle.get_json(kv_keys.control_epoch())["epoch"] == epoch0
+        handle.put_json("soak/before", {"v": 1})
+        leader, _ = _leader(eps, servers)
+        leader.stop()  # force an election underneath the live handle
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            st = replica_kv.wait_for_leader(eps, timeout=2.0)
+            if st and int(st["id"]) != leader.replica_id:
+                break
+        # the next write is fenced by the election's epoch bump; the
+        # handle sees its OWN ownership record and adopts + retries
+        handle.put_json("soak/after", {"v": 2})
+        assert handle.epoch > epoch0
+        assert adopted and adopted[-1] == handle.epoch
+        assert handle.get_json("soak/after") == {"v": 2}
+        assert handle.get_json("soak/before") == {"v": 1}
+
+
+def test_handle_republished_control_epoch_keeps_ownership(tmp_path):
+    """Regression: the driver re-publishes ``control_epoch`` with a plain
+    ``{"epoch"}`` payload on every topology notify (driver.py). The
+    handle must stamp its owner onto that write — otherwise the record
+    loses ownership and, after the next election, the handle mistakes
+    its own driver for a rival and stands down instead of adopting
+    (wedging the resize; the ISSUE-19 acceptance run caught this)."""
+    with replica_set(tmp_path) as (eps, servers):
+        adopted = []
+        handle = replica_kv.ReplicatedKVHandle(
+            eps, epoch_adopted=adopted.append).start(timeout=30.0)
+        epoch0 = handle.epoch
+        # driver-style republish: embedded epoch == claimed epoch, no owner
+        handle.put_json(kv_keys.control_epoch(), {"epoch": epoch0},
+                        epoch=epoch0)
+        rec = handle.get_json(kv_keys.control_epoch())
+        assert rec["owner"] == handle._incarnation, rec
+        leader, _ = _leader(eps, servers)
+        leader.stop()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            st = replica_kv.wait_for_leader(eps, timeout=2.0)
+            if st and int(st["id"]) != leader.replica_id:
+                break
+        # a fenced driver command adopts (same owner) and the retried
+        # payload carries the ADOPTED epoch, not the pre-fence one —
+        # workers whose floor rose with the election must not ignore it
+        handle.put_json(kv_keys.notify(), {"generation": 1,
+                                           "epoch": epoch0}, epoch=epoch0)
+        assert handle.epoch > epoch0
+        assert adopted and adopted[-1] == handle.epoch
+        assert handle.get_json(kv_keys.notify())["epoch"] == handle.epoch
+        # and a post-adoption control_epoch republish still owns the record
+        handle.put_json(kv_keys.control_epoch(), {"epoch": handle.epoch},
+                        epoch=handle.epoch)
+        rec = handle.get_json(kv_keys.control_epoch())
+        assert rec["owner"] == handle._incarnation, rec
+        assert rec["epoch"] == handle.epoch, rec
+
+
+def test_handle_stands_down_for_rival_driver(tmp_path):
+    with replica_set(tmp_path) as (eps, servers):
+        h1 = replica_kv.ReplicatedKVHandle(eps).start(timeout=30.0)
+        h1.put_json("soak/h1", {"v": 1})
+        # a RIVAL driver incarnation attaches: bumps the epoch and takes
+        # the ownership record
+        h2 = replica_kv.ReplicatedKVHandle(eps).start(timeout=30.0)
+        assert h2.epoch > h1.epoch
+        with pytest.raises(StaleEpochError):
+            h1.put_json("soak/h1", {"v": 2})
+        # the rival is unaffected, and h1's write never landed
+        assert h2.get_json("soak/h1") == {"v": 1}
+
+
+def test_sharded_wals_stay_conformant_across_failover(tmp_path):
+    """Traffic across every shard family + a leader stop: each replica's
+    per-shard WAL set must replay clean under the conformance rules
+    (shard routing, epoch monotonicity, cross-shard merge)."""
+    from horovod_tpu.verify import conformance
+    with replica_set(tmp_path) as (eps, servers):
+        handle = replica_kv.ReplicatedKVHandle(eps).start(timeout=30.0)
+        handle.put_json(kv_keys.generation(), {"generation": 1})
+        handle.put_json(kv_keys.worker_heartbeat("h0", 0),
+                        {"pid": 1, "rank": 0, "generation": 1,
+                         "ts": time.time()})
+        leader, _ = _leader(eps, servers)
+        leader.stop()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            st = replica_kv.wait_for_leader(eps, timeout=2.0)
+            if st and int(st["id"]) != leader.replica_id:
+                break
+        handle.put_json(kv_keys.worker_heartbeat("h0", 1),
+                        {"pid": 2, "rank": 1, "generation": 1,
+                         "ts": time.time()})
+    for i in range(len(eps)):
+        d = replica_kv.replica_dir(str(tmp_path), i)
+        assert conformance.check_kv_wal(d) == [], f"replica {i} diverged"
+
+
+# ---------------------------------------------------------------------------
+# hvd-top KV health banner (satellite e)
+
+
+def test_top_kv_banner_names_leader_and_shards(tmp_path):
+    from horovod_tpu.obs import top
+    with replica_set(tmp_path) as (eps, servers):
+        leader, st = _leader(eps, servers)
+        client = KVClient("127.0.0.1", 0, endpoints=eps)
+        client.put_json("soak/k", {"v": 1}, deadline=20.0)
+        health = top.kv_health(eps)
+        assert health["leader"] == leader.replica_id
+        assert health["up"] == len(eps)
+        banner = top.render_kv_banner(health)
+        assert f"KV: leader r{leader.replica_id}@" in banner
+        assert f"replicas {len(eps)}/{len(eps)} up" in banner
+        assert "WAL" in banner and "core:" in banner
+        # kill the whole set: the banner flips to the suspect form
+        for s in servers:
+            s.stop()
+        down = top.render_kv_banner(top.kv_health(eps))
+        assert "NO LEADER" in down and "control plane suspect" in down
+
+
+def test_top_once_exits_one_naming_kv_suspect(capsys):
+    """--once with a replica list but no reachable leader must exit 1
+    and NAME the control plane as the suspect (not the workers)."""
+    from horovod_tpu.obs import top
+    from horovod_tpu.runner.launch import free_port
+    dead = [f"127.0.0.1:{free_port()}" for _ in range(3)]
+    rc = top.main(["--once", "--targets", f"127.0.0.1:{free_port()}",
+                   "--kv", ",".join(dead)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "control-plane suspect" in err
+    assert "no KV leader reachable" in err
+    assert "0/3 replicas up" in err
+
+
+# ---------------------------------------------------------------------------
+# subprocess replica fleet (the chaos harness surface)
+
+
+def test_subprocess_leader_kill_failover_and_heal(tmp_path):
+    cp = chaos.ReplicatedControlPlane(str(tmp_path / "kv"),
+                                      lease_seconds=0.3)
+    try:
+        cp.client.put_json("soak/a", {"v": 1}, deadline=20.0)
+        lid = cp.kill_leader()
+        st = cp.await_leader_other_than(lid, timeout=30.0)
+        assert cp.epochs == sorted(cp.epochs)
+        assert st["epoch"] > cp.epochs[0]
+        assert cp.client.get_json("soak/a", timeout=10.0) == {"v": 1}
+        cp.client.put_json("soak/b", {"v": 2}, deadline=20.0)
+        cp.respawn(lid)
+        hashes = cp.store_hashes(settle=20.0)
+        assert len(hashes) == len(cp.endpoints), hashes
+        assert len(set(hashes.values())) == 1, \
+            f"replicas diverged after heal: {hashes}"
+    finally:
+        cp.close()
+
+
+def test_subprocess_partition_no_split_brain(tmp_path):
+    """SIGSTOP the leader (sockets open, nothing flows): the survivors
+    elect, and on SIGCONT the deposed leader must rejoin as a follower
+    and converge byte-identically — no write it accepts alone survives,
+    no acked write is lost."""
+    cp = chaos.ReplicatedControlPlane(str(tmp_path / "kv"),
+                                      lease_seconds=0.3)
+    try:
+        cp.client.put_json("soak/pre", {"v": 1}, deadline=20.0)
+        with cp.partition_leader() as lid:
+            st = cp.await_leader_other_than(lid, timeout=30.0)
+            assert int(st["id"]) != lid
+            cp.client.put_json("soak/during", {"v": 2}, deadline=20.0)
+        # healed: the old leader rejoins, resyncs, and demotes
+        hashes = cp.store_hashes(settle=20.0)
+        assert len(hashes) == len(cp.endpoints), hashes
+        assert len(set(hashes.values())) == 1, \
+            f"split-brain state survived heal: {hashes}"
+        assert cp.client.get_json("soak/pre", timeout=10.0) == {"v": 1}
+        assert cp.client.get_json("soak/during",
+                                  timeout=10.0) == {"v": 2}
+        statuses = [s for s in cp.statuses().values() if s]
+        assert sum(s["role"] == "leader" for s in statuses) == 1
+        assert cp.epochs == sorted(cp.epochs)
+    finally:
+        cp.close()
