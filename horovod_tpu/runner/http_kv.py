@@ -83,6 +83,7 @@ LEADER_HEADER = "X-Hvd-Leader"
 _WAL_FILE = "wal.log"
 _SNAPSHOT_FILE = "snapshot.json"
 _EPOCH_FILE = "epoch"
+_VOTE_FILE = "vote"
 # sanity ceiling on a single WAL record (a corrupt length header must not
 # make replay try to allocate gigabytes)
 _MAX_RECORD_BYTES = 64 << 20
@@ -175,6 +176,7 @@ class _Wal:
         self.wal_bytes = 0
         self.replay_seconds = 0.0
         self.max_seq = 0              # highest "s" stamp seen (replay+snap)
+        self.last_term = 0            # "t" stamp of the record AT max_seq
         self.tokens: List[Tuple[str, int]] = []  # (client, seq) in order
 
     # -- replay ---------------------------------------------------------------
@@ -207,8 +209,10 @@ class _Wal:
             except ValueError:
                 break
             self._apply(store, op)
-            if isinstance(op.get("s"), int):
-                self.max_seq = max(self.max_seq, op["s"])
+            if isinstance(op.get("s"), int) and op["s"] >= self.max_seq:
+                self.max_seq = op["s"]
+                if isinstance(op.get("t"), int):
+                    self.last_term = op["t"]
             if op.get("c") is not None and isinstance(op.get("n"), int):
                 self.tokens.append((str(op["c"]), op["n"]))
             off += 8 + length
@@ -239,11 +243,16 @@ class _Wal:
             return {}
         try:
             doc = json.loads(raw)
-            if isinstance(doc.get("seq"), int):
+            if isinstance(doc.get("seq"), int) and \
+                    doc["seq"] >= self.max_seq:
                 # compaction truncates the WAL, so the snapshot carries
                 # the high-water "s" stamp — the global sequence must
-                # stay monotone across restarts for cross-shard merges
-                self.max_seq = max(self.max_seq, doc["seq"])
+                # stay monotone across restarts for cross-shard merges —
+                # and the replication term at that stamp (the Raft
+                # log-matching state compaction would otherwise lose)
+                self.max_seq = doc["seq"]
+                if isinstance(doc.get("term"), int):
+                    self.last_term = doc["term"]
             return {k: base64.b64decode(v)
                     for k, v in doc.get("store", {}).items()}
         except (ValueError, TypeError, KeyError):
@@ -278,7 +287,8 @@ class _Wal:
         self.wal_bytes += 8 + len(payload)
 
     def compact(self, store: Dict[str, bytes],
-                seq: Optional[int] = None):
+                seq: Optional[int] = None,
+                term: Optional[int] = None):
         """Write the full store as a snapshot (write-then-rename, so a
         crash mid-compaction leaves the previous snapshot + full WAL —
         replay of both is idempotent), then start a fresh WAL."""
@@ -288,6 +298,8 @@ class _Wal:
                "ts": time.time()}
         if seq is not None:
             doc["seq"] = int(seq)
+        if term is not None:
+            doc["term"] = int(term)
         with open(tmp, "w") as f:
             json.dump(doc, f)
             f.flush()
@@ -321,6 +333,36 @@ class _Wal:
         except OSError:
             pass
 
+    # -- persistent vote (replica election safety) ----------------------------
+
+    def load_vote(self) -> Tuple[int, Optional[int]]:
+        """The highest ``(epoch, voted_for)`` this replica ever granted,
+        or ``(0, None)``. A voter that forgets its vote across a respawn
+        could grant the same epoch to a second candidate — two leaders
+        winning one term — so the grant is durable, like the epoch."""
+        try:
+            with open(os.path.join(self.dir, _VOTE_FILE)) as f:
+                doc = json.loads(f.read())
+            return int(doc["epoch"]), int(doc["cand"])
+        except (OSError, ValueError, TypeError, KeyError):
+            return 0, None
+
+    def store_vote(self, epoch: int, cand: int) -> bool:
+        """Durably record a grant. False = could not persist — the
+        caller must NOT grant (an unrecorded vote is a forgettable one,
+        exactly the double-vote hazard this file closes)."""
+        path = os.path.join(self.dir, _VOTE_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"epoch": int(epoch), "cand": int(cand)}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            return False
+
 
 class _ShardedWal:
     """One :class:`_Wal` per ``kv_keys`` shard, behind the same append/
@@ -341,6 +383,7 @@ class _ShardedWal:
                         snap_file=shard_snapshot_file(shard))
             for shard in kv_keys.SHARDS}
         self.max_seq = 0
+        self.last_term = 0  # term of the record at the GLOBAL max_seq
         self.tokens: List[Tuple[str, int]] = []
 
     @staticmethod
@@ -355,7 +398,9 @@ class _ShardedWal:
         for shard in kv_keys.SHARDS:
             w = self._wals[shard]
             w.replay(into=store)
-            self.max_seq = max(self.max_seq, w.max_seq)
+            if w.max_seq >= self.max_seq:
+                self.max_seq = w.max_seq
+                self.last_term = w.last_term
             stamped.extend(w.tokens)
         # dedupe-table rebuild order across shards doesn't matter: the
         # table is an exact-match set, not a high-water mark
@@ -367,11 +412,13 @@ class _ShardedWal:
         w = self._wals[shard]
         if isinstance(op.get("s"), int):
             self.max_seq = max(self.max_seq, op["s"])
+            if isinstance(op.get("t"), int):
+                self.last_term = op["t"]
         w.append_raw(op)
         if w.wal_bytes > w.snapshot_bytes:
             w.compact({k: v for k, v in store.items()
                        if kv_keys.shard_for_key(k) == shard},
-                      seq=self.max_seq)
+                      seq=self.max_seq, term=self.last_term)
 
     def compact_all(self, store: Dict[str, bytes]):
         """Rewrite every shard's snapshot from ``store`` and truncate all
@@ -379,7 +426,7 @@ class _ShardedWal:
         for shard, w in self._wals.items():
             w.compact({k: v for k, v in store.items()
                        if kv_keys.shard_for_key(k) == shard},
-                      seq=self.max_seq)
+                      seq=self.max_seq, term=self.last_term)
 
     def shard_bytes(self) -> Dict[str, int]:
         return {shard: w.wal_bytes for shard, w in self._wals.items()}
@@ -396,13 +443,19 @@ class _ShardedWal:
         for w in self._wals.values():
             w.close()
 
-    # the control epoch stays a single dir-level file — it fences the
-    # whole store, not one shard
+    # the control epoch and the vote stay single dir-level files — they
+    # fence/bind the whole store, not one shard
     def load_epoch(self) -> int:
         return self._wals["core"].load_epoch()
 
     def store_epoch(self, epoch: int):
         self._wals["core"].store_epoch(epoch)
+
+    def load_vote(self) -> Tuple[int, Optional[int]]:
+        return self._wals["core"].load_vote()
+
+    def store_vote(self, epoch: int, cand: int) -> bool:
+        return self._wals["core"].store_vote(epoch, cand)
 
 
 class KVServer:
@@ -907,6 +960,40 @@ class KVClient:
             if time.monotonic() >= deadline:
                 return None
             time.sleep(poll_interval)
+
+    def get_json_leader(self, key: str, timeout: float = 3.0,
+                        attempts: int = 6, backoff: float = 0.2,
+                        deadline: Optional[float] = None) -> Optional[Any]:
+        """Read ``key`` through the current LEADER (``/_replica/read``),
+        never a follower's local store. For reads whose staleness is a
+        correctness hazard — e.g. the driver's ownership check after a
+        fence, where a lagging follower's old owner stamp would let a
+        genuinely deposed driver adopt the rival's epoch and write on.
+        Follows the 307 leader redirect (urllib follows it for GETs) and
+        rotates on no-leader; raises the last connection error when no
+        leader is reachable within the attempt/deadline budget."""
+        url = "_replica/read?" + urlparse.urlencode({"k": key})
+        abs_deadline = time.monotonic() + deadline \
+            if deadline is not None else None
+
+        def attempt():
+            try:
+                with urlrequest.urlopen(self._base + url,
+                                        timeout=timeout) as resp:
+                    doc = json.loads(resp.read())
+            except urlerror.HTTPError as e:
+                if e.code in (503, 307):
+                    self._rotate()
+                    raise NotLeaderError("replica has no leader") from e
+                raise
+            except (urlerror.URLError, ConnectionError, OSError):
+                self._rotate()
+                raise
+            if not doc.get("found"):
+                return None
+            return json.loads(base64.b64decode(doc["v"]))
+
+        return _retrying(attempt, attempts, backoff, deadline=abs_deadline)
 
     def delete(self, key: str, timeout: float = 10.0, attempts: int = 3,
                backoff: float = 0.1):

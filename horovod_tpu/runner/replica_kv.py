@@ -20,15 +20,22 @@ store, with
   term: a deposed leader's in-flight forwards are 409ed by followers
   that have seen a newer term, and the deposed leader **self-fences**
   (steps down) on the first majority-refused write.
-- **highest-(epoch, WAL-length) elections** — the vote-grant rule
-  (shared with the ``ReplicaSpec`` model via
-  ``horovod_tpu/verify/rules.py``) refuses any candidate whose WAL is
-  shorter than the voter's, so a majority-committed (acked) write can
-  never be missing from a newly elected leader. Winning bumps the epoch.
-- **WAL-divergence repair** — a rejoining follower whose log does not
-  match the leader's (it accepted records that never reached a
-  majority, or it missed appends while partitioned) is resynced from
-  the leader's full state; its un-committed suffix is truncated with a
+- **highest-(epoch, last-term, WAL-length) elections** — every WAL
+  record is stamped with the replication term it was appended under,
+  and the vote-grant rule (shared with the ``ReplicaSpec`` model via
+  ``horovod_tpu/verify/rules.py``) refuses any candidate whose
+  ``(last-record term, length)`` is behind the voter's — the Raft
+  up-to-date ordering, under which a majority-committed (acked) write
+  can never be missing from a newly elected leader. Grants are
+  **persisted** (``vote`` file) before they are sent, so a replica the
+  supervisor respawns mid-election cannot vote twice in one epoch.
+  Winning bumps the epoch.
+- **WAL-divergence repair** — every append envelope carries the
+  previous record's ``(seq, term)`` and a follower matching on either
+  dimension failing answers "resync me" (Raft log matching — bare
+  sequence numbers cannot see two equal-length logs that diverged
+  across a failover). The diverged follower is resynced from the
+  leader's full state; its un-committed suffix is truncated with a
   loud tripwire log, and its shard WALs are rewritten to the committed
   prefix.
 
@@ -41,7 +48,8 @@ control epoch (fencing any predecessor driver incarnation) and records
 its ownership under the ``control_epoch`` key; when an *election* bumps
 the epoch underneath it, the handle distinguishes "deposed by a rival
 driver" (stand down, :class:`StaleEpochError`) from "same driver, new
-KV term" (adopt and continue) by checking that ownership record.
+KV term" (adopt and continue) by checking that ownership record —
+read through the *leader*, never a possibly-lagging follower.
 
 Run one replica as a subprocess::
 
@@ -115,7 +123,9 @@ class ReplicaKVServer(KVServer):
         self._lease_until = 0.0     # leader: lease valid until
         self._lease_grant_t = 0.0   # leader: last majority extension
         self._commit = 0            # highest majority-committed seq
+        self._last_term = 0         # term ("t") of the last WAL record
         self._votes_cast: Dict[int, int] = {}   # epoch -> candidate id
+        self._vote_floor = 0     # highest epoch ever granted (persisted)
         self._next_proposal = 0  # grows per attempt so split votes resolve
         self._peer_seen: Dict[int, float] = {}  # id -> last good contact
         # staggered bootstrap/election timers: replica 0 usually wins the
@@ -128,9 +138,19 @@ class ReplicaKVServer(KVServer):
             port = int(self._endpoints[self.replica_id].rsplit(":", 1)[1])
         super().__init__(port=port, kv_dir=kv_dir,
                          snapshot_bytes=snapshot_bytes)
-        # everything replayed from our own WAL is only *locally* durable;
-        # the commit point is re-learned from the leader on rejoin
-        self._commit = self._seq
+        # everything replayed from our own WAL is only *locally* durable
+        # — it may be an un-majority-committed suffix — so the commit
+        # point stays 0 and is re-learned from append/heartbeat rounds
+        # (or, as leader, from the first majority-acked append). The
+        # last record's replication term IS restored: it is this
+        # replica's position in the Raft log-matching order.
+        self._last_term = self._wal.last_term
+        # a voter that forgets a granted vote across a respawn could
+        # grant the same epoch twice (two leaders, one term) — reload
+        # the durable grant and never vote at or below it differently
+        self._vote_floor, voted_for = self._wal.load_vote()
+        if voted_for is not None:
+            self._votes_cast[self._vote_floor] = voted_for
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -159,10 +179,13 @@ class ReplicaKVServer(KVServer):
         if method == "POST" and path == "/_replica/resync":
             self._h_resync(handler)
             return True
+        if method == "GET" and path == "/_replica/read":
+            self._h_leader_read(handler)
+            return True
         if method in ("PUT", "DELETE"):
             self._h_client_mutation(handler, method)
             return True
-        return False  # reads (incl. /replica_status, /_kv/keys): base
+        return False  # plain reads (incl. /replica_status, /_kv): base
 
     @staticmethod
     def _read_doc(handler) -> dict:
@@ -214,6 +237,28 @@ class ReplicaKVServer(KVServer):
         else:  # lost leadership mid-write: never acked, client retries
             handler._send_json({"error": "no_leader"}, status=503)
 
+    def _h_leader_read(self, handler):
+        """Leader-only read (``GET /_replica/read?k=...``): 307/503 from
+        anyone not holding a live lease. Plain GETs are served from
+        whichever replica the client hit — fine for rendezvous polling,
+        but a read that *decides* something (the driver's post-fence
+        ownership check) must not see a lagging follower's stale state."""
+        from urllib import parse as urlparse
+        _, _, query = handler.path.partition("?")
+        key = urlparse.parse_qs(query).get("k", [""])[0]
+        with self._lock:
+            is_leader = self._role == "leader" and \
+                time.monotonic() < self._lease_until
+            val = self._store.get(key) if is_leader else None
+            epoch = self.epoch
+        if not is_leader:
+            self._send_not_leader(handler)
+            return
+        handler._send_json(
+            {"found": val is not None, "epoch": epoch,
+             "v": base64.b64encode(val).decode()
+             if val is not None else None})
+
     def _send_not_leader(self, handler):
         with self._lock:
             lid = self._leader_id
@@ -251,15 +296,22 @@ class ReplicaKVServer(KVServer):
             if self._dedup_locked(token):
                 return "ok", True  # retry of a committed op: applied once
             prev = self._seq
+            prev_term = self._last_term
             self._seq += 1
-            rec = dict(op, s=self._seq)
+            # "t" is the replication term this record was appended
+            # under — the Raft log-matching stamp. Without it two
+            # equal-length logs that diverged across a failover (a
+            # deposed leader's un-acked suffix vs the successor's
+            # committed one) are indistinguishable by seq alone.
+            rec = dict(op, s=self._seq, t=self.epoch)
             if epoch_claim is not None:
                 rec["e"] = int(epoch_claim)
             if token is not None:
                 rec["c"], rec["n"] = token[0], int(token[1])
             existed = self._apply_record_locked(rec)
             env = {"term": self.epoch, "leader": self.replica_id,
-                   "prev": prev, "ops": [rec], "commit": self._commit}
+                   "prev": prev, "prev_term": prev_term,
+                   "ops": [rec], "commit": self._commit}
             acks, resync_peers, deposed_by = self._send_round_locked(env)
             if deposed_by is not None:
                 self._step_down_locked(
@@ -296,6 +348,8 @@ class ReplicaKVServer(KVServer):
             self._applied[(rec["c"], int(rec["n"]))] = True
         if isinstance(rec.get("s"), int):
             self._seq = max(self._seq, rec["s"])
+        if isinstance(rec.get("t"), int):
+            self._last_term = rec["t"]
         if self._wal is not None:
             self._wal.append(rec, self._store)
             self._export_metrics()
@@ -363,9 +417,17 @@ class ReplicaKVServer(KVServer):
             self._role = "follower"
             self._leader_id = int(doc.get("leader", -1))
             self._leader_seen = now
-            if int(doc.get("prev", -1)) != self._seq:
+            # Raft log matching: the append lands only when BOTH the
+            # previous index and its term agree. Index alone cannot see
+            # an equal-length diverged log (a deposed leader that kept
+            # a never-majority-acked record at the same seq the new
+            # leader committed a different one) — term mismatch at the
+            # same seq is exactly that split, and it must resync.
+            if int(doc.get("prev", -1)) != self._seq or \
+                    int(doc.get("prev_term", -1)) != self._last_term:
                 handler._send_json({"ok": False, "resync": True,
-                                    "have": self._seq})
+                                    "have": self._seq,
+                                    "have_term": self._last_term})
                 return
             for rec in doc.get("ops", []):
                 self._apply_record_locked(rec)
@@ -380,21 +442,32 @@ class ReplicaKVServer(KVServer):
         cand = int(doc.get("cand", -1))
         cand_epoch = int(doc.get("epoch", -1))
         cand_len = int(doc.get("len", -1))
+        cand_term = int(doc.get("last_term", -1))
         now = time.monotonic()
         with self._lock:
             heard = self._leader_id is not None and \
                 (now - self._leader_seen) < self._lease * 1.5
             if self._role == "leader" and now < self._lease_until:
                 heard = True  # we ARE the fresh leaseholder
-            granted = rules.vote_grants(self.epoch, self._seq, cand_epoch,
-                                        cand_len, heard) and \
+            granted = rules.vote_grants(
+                self.epoch, self._last_term, self._seq,
+                cand_epoch, cand_term, cand_len, heard) and \
+                cand_epoch >= self._vote_floor and \
                 self._votes_cast.get(cand_epoch, cand) == cand
             if granted:
+                # the grant is durable BEFORE it is sent: a voter the
+                # supervisor respawns mid-election must refuse a second
+                # candidate at any epoch it already voted in. Persist
+                # failure = no grant.
+                granted = self._wal.store_vote(cand_epoch, cand)
+            if granted:
+                self._vote_floor = cand_epoch
                 self._votes_cast[cand_epoch] = cand
                 while len(self._votes_cast) > _MAX_VOTE_MEMORY:
                     self._votes_cast.pop(min(self._votes_cast))
             handler._send_json({"granted": bool(granted),
-                                "term": self.epoch, "len": self._seq})
+                                "term": self.epoch, "len": self._seq,
+                                "last_term": self._last_term})
 
     def _run_election(self):
         rules = _rules()
@@ -405,15 +478,24 @@ class ReplicaKVServer(KVServer):
             # each attempt proposes a strictly higher epoch than any
             # prior one — otherwise two candidates that split a vote at
             # epoch+1 have both burned their one vote there and no
-            # election at that epoch can ever reach a majority
-            proposed = max(self.epoch + 1, self._next_proposal)
+            # election at that epoch can ever reach a majority. The
+            # persisted vote floor joins the max: a respawned candidate
+            # must not self-vote in an epoch it already granted away.
+            proposed = max(self.epoch + 1, self._next_proposal,
+                           self._vote_floor + 1)
             self._next_proposal = proposed + 1
             my_len = self._seq
-            self._votes_cast[proposed] = self.replica_id  # self-vote
+            my_term = self._last_term
+            # the self-vote is durable like any other grant
+            if not self._wal.store_vote(proposed, self.replica_id):
+                return
+            self._vote_floor = proposed
+            self._votes_cast[proposed] = self.replica_id
         votes = 1
         for _pid, resp in self._broadcast(
                 "/_replica/vote",
-                {"cand": self.replica_id, "epoch": proposed, "len": my_len},
+                {"cand": self.replica_id, "epoch": proposed,
+                 "len": my_len, "last_term": my_term},
                 timeout=max(0.2, self._lease / 2)):
             if resp is None:
                 continue
@@ -452,7 +534,8 @@ class ReplicaKVServer(KVServer):
     def _resync_peer(self, pid: int):
         with self._lock:
             doc = {"term": self.epoch, "leader": self.replica_id,
-                   "seq": self._seq, "commit": self._commit,
+                   "seq": self._seq, "last_term": self._last_term,
+                   "commit": self._commit,
                    "store": {k: base64.b64encode(v).decode()
                              for k, v in self._store.items()},
                    "tokens": [list(t) for t in
@@ -495,6 +578,7 @@ class ReplicaKVServer(KVServer):
             self._adopt_term_locked(term)
             self._store = new_store
             self._seq = leader_seq
+            self._last_term = int(doc.get("last_term", 0))
             self._commit = int(doc.get("commit", 0))
             self._applied = {}
             for tok in doc.get("tokens", []):
@@ -504,6 +588,7 @@ class ReplicaKVServer(KVServer):
                     pass
             if self._wal is not None:
                 self._wal.max_seq = self._seq
+                self._wal.last_term = self._last_term
                 self._wal.compact_all(self._store)
                 self._export_metrics()
             self._role = "follower"
@@ -542,7 +627,8 @@ class ReplicaKVServer(KVServer):
                 return
             now = time.monotonic()
             env = {"term": self.epoch, "leader": self.replica_id,
-                   "prev": self._seq, "ops": [], "commit": self._commit}
+                   "prev": self._seq, "prev_term": self._last_term,
+                   "ops": [], "commit": self._commit}
             acks, resync_peers, deposed_by = self._send_round_locked(env)
             if deposed_by is not None:
                 self._step_down_locked(
@@ -612,7 +698,8 @@ class ReplicaKVServer(KVServer):
                 leader = self._leader_id
             return {"id": self.replica_id, "role": self._role,
                     "leader": leader, "epoch": self.epoch,
-                    "seq": self._seq, "commit": self._commit,
+                    "seq": self._seq, "last_term": self._last_term,
+                    "commit": self._commit,
                     "lease_age": round(lease_age, 3),
                     "lease_seconds": self._lease,
                     "replicas": len(self._endpoints),
@@ -767,8 +854,25 @@ class ReplicatedKVHandle:
 
     def _adopt_after_election(self, e: StaleEpochError) -> bool:
         """True when the fence came from a KV election under the SAME
-        driver (adopt + continue); False for a rival driver."""
-        rec = self._client.get_json(kv_keys.control_epoch(), timeout=5.0)
+        driver (adopt + continue); False for a rival driver.
+
+        The ownership record is read THROUGH THE LEADER (the leader-only
+        ``/_replica/read`` endpoint), never a follower's local store: a
+        genuinely fenced-out stale driver could otherwise hit a lagging
+        follower, see its own old owner stamp, adopt the rival's epoch,
+        and retry its mutation into a store the rival now owns —
+        re-opening the split-brain this check exists to close. No leader
+        reachable = ownership unprovable = stand down (the safe side)."""
+        try:
+            rec = self._client.get_json_leader(
+                kv_keys.control_epoch(), attempts=20, backoff=0.2,
+                deadline=15.0)
+        except (urlerror.URLError, ConnectionError, OSError):
+            _logger().warning(
+                "driver KV handle: fenced at epoch %d and no KV leader "
+                "reachable to verify ownership — standing down",
+                e.offered)
+            return False
         if not isinstance(rec, dict) or \
                 rec.get("owner") != self._incarnation:
             return False

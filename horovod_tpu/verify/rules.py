@@ -53,19 +53,26 @@ def majority(replicas: int) -> int:
     return replicas // 2 + 1
 
 
-def vote_grants(voter_epoch: int, voter_len: int, cand_epoch: int,
-                cand_len: int, heard_from_leader: bool) -> bool:
+def vote_grants(voter_epoch: int, voter_last_term: int, voter_len: int,
+                cand_epoch: int, cand_last_term: int, cand_len: int,
+                heard_from_leader: bool) -> bool:
     """The replica election grant rule (``ReplicaKVServer`` vote
     handler): a voter grants a candidate iff
 
     - it has NOT heard from a live leader inside the lease window (the
       clock assumption that makes at-most-one-leaseholder hold), and
     - the candidate proposes a strictly newer epoch, and
-    - the candidate's WAL is at least as long as the voter's — the
-      highest-(epoch, WAL-length) replica wins, so no acked (majority-
-      replicated) write can be missing from the new leader."""
+    - the candidate's WAL is at least as up-to-date as the voter's by
+      the Raft ordering: ``(last-record term, length)`` compared
+      lexicographically. Bare length is NOT enough — two equal-length
+      logs can diverge (a deposed leader's un-acked suffix vs the
+      successor's committed suffix), and only the term of the last
+      record tells them apart. A majority-acked write is on some voter
+      in every quorum, and that voter's (term, length) dominates any
+      candidate missing it, so no acked write can be missing from the
+      new leader."""
     return (not heard_from_leader) and cand_epoch > voter_epoch \
-        and cand_len >= voter_len
+        and (cand_last_term, cand_len) >= (voter_last_term, voter_len)
 
 
 def express_eligible(size_bytes: int, threshold: int,

@@ -964,9 +964,12 @@ class AutoscaleSpec(Spec):
 class ReplicaState(NamedTuple):
     believes: tuple    # per replica: believes it holds a valid lease
     epoch: tuple       # per replica: adopted control epoch (term)
-    log: tuple         # per replica: tuple of WAL entries — a positive
-    #                    int is a client write id, a negative int -e is
-    #                    the lease record persisted at the epoch-e grant
+    log: tuple         # per replica: tuple of WAL entries, each a
+    #                    (term, id) pair — the term stamps the entry
+    #                    with the epoch it was appended under (the Raft
+    #                    log-matching state); id > 0 is a client write,
+    #                    id == -e is the lease record of the epoch-e
+    #                    grant (appended at term e)
     alive: tuple       # per replica: process up
     part: tuple        # per replica: partitioned off from the others
     lease_live: bool   # the current grant's real-time window is open
@@ -994,13 +997,16 @@ class ReplicaSpec(Spec):
     check — exactly what ``stale_lease_accepts_write`` removes).
 
     The election rule is the shared :func:`rules.vote_grants` /
-    :func:`rules.majority` pair the real vote handler uses, and the
-    lease record the winner replicates is IN the model (a log entry):
-    it is load-bearing — a deposed leader carries at most one un-acked
+    :func:`rules.majority` pair the real vote handler uses — the Raft
+    up-to-date order over term-stamped log entries — and the lease
+    record the winner replicates is IN the model (a log entry): it is
+    load-bearing — a deposed leader carries at most one un-acked
     suffix record (it self-fences on the first majority-refused write),
-    and the grant record keeps every majority log at least that long,
-    which is why highest-(epoch, WAL-length) never elects a leader
-    missing an acked write."""
+    appended at its OLD term, while the grant record puts the winner's
+    new term at the top of every majority log — which is why
+    highest-(epoch, last-term, WAL-length) never elects a leader
+    missing an acked write, even against an equal-*length* diverged
+    rival."""
 
     N = 3
     WRITE = 1  # the one modeled client write id
@@ -1038,7 +1044,14 @@ class ReplicaSpec(Spec):
     def _max_holder_epoch(s: ReplicaState) -> int:
         """Highest epoch any lease was ever granted at — recoverable
         from the persisted lease records, so not extra state."""
-        return max([0] + [-e for log in s.log for e in log if e < 0])
+        return max([0] + [-eid for log in s.log
+                          for _t, eid in log if eid < 0])
+
+    @staticmethod
+    def _last_term(log: tuple) -> int:
+        """Term of the last WAL entry — the replica's position in the
+        Raft up-to-date order (``rules.vote_grants``)."""
+        return log[-1][0] if log else 0
 
     # -- transitions ----------------------------------------------------------
 
@@ -1098,7 +1111,9 @@ class ReplicaSpec(Spec):
         granting = []
         for j in electorate:
             heard = s.lease_live or s.believes[j]
-            if rules.vote_grants(s.epoch[j], len(s.log[j]), proposed,
+            if rules.vote_grants(s.epoch[j], self._last_term(s.log[j]),
+                                 len(s.log[j]), proposed,
+                                 self._last_term(s.log[c]),
                                  len(s.log[c]), heard):
                 votes += 1
                 granting.append(j)
@@ -1112,7 +1127,7 @@ class ReplicaSpec(Spec):
         # majority-acked append); granting voters adopt the new epoch
         for j in [c] + granting:
             epoch = _rep(epoch, j, proposed)
-            log = _rep(log, j, s.log[j] + (-proposed,))
+            log = _rep(log, j, s.log[j] + ((proposed, -proposed),))
         label = (f"replica{c} elected: epoch {proposed}, "
                  f"{votes}/{self.N} votes; lease record replicated")
         if self.minority_elect and votes < rules.majority(self.N):
@@ -1125,16 +1140,18 @@ class ReplicaSpec(Spec):
 
     def _write(self, s: ReplicaState, i: int, retry: bool):
         w = self.WRITE
+        entry = (s.epoch[i], w)  # appended under the writer's term
+        applied = any(eid == w for _t, eid in s.log[i])
         budget = {"retries_left": s.retries_left - 1} if retry \
             else {"writes_left": s.writes_left - 1}
         tag = "retried " if retry else ""
-        if retry and not self.double_apply and w in s.log[i]:
+        if retry and not self.double_apply and applied:
             # the (client, seq) token was already applied here — dedupe
             # drops the replay and re-acks
             return (f"replica{i} dedupes the retried write (token "
                     f"already applied)",
                     s._replace(acked=s.acked | {w}, **budget))
-        mutated = retry and self.double_apply and w in s.log[i]
+        mutated = retry and self.double_apply and applied
         reachable = self._reachable(s, i)
         refused = any(s.epoch[j] > s.epoch[i] for j in reachable)
         if refused:
@@ -1143,23 +1160,24 @@ class ReplicaSpec(Spec):
             # suffix resync later truncates
             return (f"replica{i}'s {tag}write forward is 409'd by a "
                     f"newer-term follower; it self-fences",
-                    s._replace(log=_rep(s.log, i, s.log[i] + (w,)),
+                    s._replace(log=_rep(s.log, i, s.log[i] + (entry,)),
                                believes=_rep(s.believes, i, False),
                                **budget))
         # only a follower whose log matches the leader's accepts the
-        # append (the real prev-seq check); a diverged one answers
-        # "resync me" and does NOT ack this round
+        # append (the real prev-(seq, term) check — term-stamped
+        # entries make equal-length diverged logs visible); a diverged
+        # one answers "resync me" and does NOT ack this round
         accepting = [j for j in reachable if s.log[j] == s.log[i]]
         if 1 + len(accepting) < rules.majority(self.N):
             return (f"replica{i}'s {tag}write cannot reach a follower "
                     f"majority; it self-fences un-acked",
-                    s._replace(log=_rep(s.log, i, s.log[i] + (w,)),
+                    s._replace(log=_rep(s.log, i, s.log[i] + (entry,)),
                                believes=_rep(s.believes, i, False),
                                **budget))
-        log = _rep(s.log, i, s.log[i] + (w,))
+        log = _rep(s.log, i, s.log[i] + (entry,))
         epoch = s.epoch
         for j in accepting:
-            log = _rep(log, j, s.log[j] + (w,))
+            log = _rep(log, j, s.log[j] + (entry,))
             epoch = _rep(epoch, j, max(s.epoch[j], s.epoch[i]))
         label = (f"replica{i} commits the {tag}write to a majority "
                  f"({1 + len(accepting)}/{self.N}); acked")
@@ -1198,12 +1216,13 @@ class ReplicaSpec(Spec):
             return sum(s.believes) <= 1
 
         def no_acked_loss(s: ReplicaState) -> bool:
-            return all(w in s.log[i]
+            return all(any(eid == w for _t, eid in s.log[i])
                        for i in range(self.N) if s.believes[i]
                        for w in s.acked)
 
         def applied_once(s: ReplicaState) -> bool:
-            return all(log.count(self.WRITE) <= 1 for log in s.log)
+            return all(sum(eid == self.WRITE for _t, eid in log) <= 1
+                       for log in s.log)
 
         return [
             Invariant(

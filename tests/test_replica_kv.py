@@ -237,6 +237,104 @@ def test_wal_divergence_repair_truncates_and_tripwires(tmp_path):
             lease_seconds=LEASE)
         assert reborn.get_json("soak/ghost") is None
         assert reborn.get_json("soak/real") == {"v": 1}
+        # replayed records are only LOCALLY durable — a respawn must not
+        # report them as majority-committed until a leader re-teaches it
+        assert reborn._seq > 0 and reborn._commit == 0
+
+
+def test_equal_length_divergence_repaired_by_term_check(tmp_path):
+    """Raft log-matching regression: a diverged log of the SAME length
+    as the leader's (a deposed leader kept a never-majority-acked
+    record at the seq where the successor committed a different one) is
+    invisible to a bare prev-seq check. The prev-TERM mismatch must
+    trigger resync and converge the follower."""
+    with replica_set(tmp_path) as (eps, servers):
+        leader, _ = _leader(eps, servers)
+        client = KVClient("127.0.0.1", 0, endpoints=eps)
+        client.put_json("soak/real", {"v": 1}, deadline=20.0)
+        follower = next(s for s in servers if s is not leader)
+        with _capture_replica_logs() as records:
+            with follower._lock:
+                # same seq the follower already holds, stamped with a
+                # rogue old term and a different value — log length
+                # does not change, only the content and last term
+                follower._apply_record_locked(
+                    {"op": "put", "k": "soak/real",
+                     "v": base64.b64encode(b'{"v": 666}').decode(),
+                     "s": follower._seq, "t": 0})
+            assert follower.get_json("soak/real") == {"v": 666}
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and \
+                    follower.get_json("soak/real") != {"v": 1}:
+                time.sleep(0.05)
+        assert follower.get_json("soak/real") == {"v": 1}, \
+            "equal-length diverged log was never repaired"
+        assert any("WAL DIVERGENCE REPAIR" in m for m in records), records
+        with leader._lock, follower._lock:
+            assert follower._last_term == leader._last_term
+            assert follower._store_hash_locked() == \
+                leader._store_hash_locked()
+
+
+def test_vote_persisted_across_respawn(tmp_path):
+    """Election-safety regression: a voter the supervisor respawns
+    mid-election must NOT forget its grant — a second candidate asking
+    at the same epoch would otherwise collect a second vote and two
+    leaders could win one term."""
+    from horovod_tpu.runner.launch import free_port
+    eps = [f"127.0.0.1:{free_port()}" for _ in range(3)]
+    kv = replica_kv.replica_dir(str(tmp_path), 0)
+
+    def vote(cand, epoch):
+        req = urlrequest.Request(
+            f"http://{eps[0]}/_replica/vote",
+            data=json.dumps({"cand": cand, "epoch": epoch,
+                             "len": 0, "last_term": 0}).encode(),
+            method="POST")
+        with urlrequest.urlopen(req, timeout=2.0) as resp:
+            return json.loads(resp.read())["granted"]
+
+    # long lease: no self-election interferes inside the test window
+    srv = replica_kv.ReplicaKVServer(0, eps, kv_dir=kv,
+                                     lease_seconds=10.0).start()
+    try:
+        assert vote(1, 50)
+        assert not vote(2, 50)  # same epoch, different candidate
+    finally:
+        srv.stop()
+    srv = replica_kv.ReplicaKVServer(0, eps, kv_dir=kv,
+                                     lease_seconds=10.0).start()
+    try:
+        assert not vote(2, 50), \
+            "respawned voter granted epoch 50 a second time"
+        assert vote(1, 50)       # re-grant to the SAME candidate is fine
+        assert not vote(2, 49)   # below the persisted floor
+        assert vote(2, 51)       # a fresh higher epoch is a fresh vote
+    finally:
+        srv.stop()
+
+
+def test_leader_read_follows_redirect_and_fails_without_leader(tmp_path):
+    """The driver's post-fence ownership check reads through the LEADER
+    (``get_json_leader``): a follower redirects rather than serving its
+    possibly-stale local store, and with no leader reachable the read
+    raises instead of answering at all."""
+    with replica_set(tmp_path) as (eps, servers):
+        leader, _ = _leader(eps, servers)
+        client = KVClient("127.0.0.1", 0, endpoints=eps)
+        client.put_json("soak/owned", {"who": "me"}, deadline=20.0)
+        follower_ep = next(ep for i, ep in enumerate(eps)
+                           if i != leader.replica_id)
+        host, _, port = follower_ep.rpartition(":")
+        pinned = KVClient(host, int(port))
+        assert pinned.get_json_leader("soak/owned") == {"who": "me"}
+        assert pinned.get_json_leader("soak/missing") is None
+        for s in servers:
+            s.stop()
+        with pytest.raises((NotLeaderError, ConnectionError,
+                            urlerror.URLError, OSError)):
+            KVClient(host, int(port)).get_json_leader(
+                "soak/owned", attempts=2, deadline=2.0)
 
 
 def test_vote_rules_agree_with_live_server(tmp_path):
@@ -244,12 +342,21 @@ def test_vote_rules_agree_with_live_server(tmp_path):
     for vote grants — the model checker exercises it exhaustively, and
     this test pins the LIVE server's /_replica/vote to the same
     function."""
-    # the rule itself, at the boundary cases the spec closes over
+    # the rule itself, at the boundary cases the spec closes over —
+    # args: (voter_epoch, voter_last_term, voter_len,
+    #        cand_epoch, cand_last_term, cand_len, heard)
     assert rules.majority(3) == 2 and rules.majority(5) == 3
-    assert rules.vote_grants(1, 5, 2, 5, heard_from_leader=False)
-    assert not rules.vote_grants(1, 5, 2, 4, False)   # shorter WAL
-    assert not rules.vote_grants(2, 5, 2, 9, False)   # no epoch advance
-    assert not rules.vote_grants(1, 5, 2, 9, True)    # live leaseholder
+    assert rules.vote_grants(1, 1, 5, 2, 1, 5, heard_from_leader=False)
+    assert not rules.vote_grants(1, 1, 5, 2, 1, 4, False)  # shorter WAL
+    assert not rules.vote_grants(2, 1, 5, 2, 1, 9, False)  # stale epoch
+    assert not rules.vote_grants(1, 1, 5, 2, 1, 9, True)   # leaseholder
+    # the Raft up-to-date order: last-record TERM dominates length —
+    # equal-length logs that diverged across a failover are told apart
+    # only by the term of their last record
+    assert rules.vote_grants(1, 2, 4, 2, 3, 4, False)  # newer last term
+    assert not rules.vote_grants(1, 3, 4, 2, 2, 4, False)  # older term
+    assert not rules.vote_grants(1, 3, 4, 2, 2, 9, False)  # longer but
+    #                                            behind on term: refused
     with replica_set(tmp_path) as (eps, servers):
         leader, st = _leader(eps, servers)
         client = KVClient("127.0.0.1", 0, endpoints=eps)
@@ -258,10 +365,11 @@ def test_vote_rules_agree_with_live_server(tmp_path):
                            if i != leader.replica_id)
         voter = _status(follower_ep)
 
-        def vote(epoch, length):
+        def vote(epoch, term, length):
             req = urlrequest.Request(
                 f"http://{follower_ep}/_replica/vote",
                 data=json.dumps({"cand": 99, "epoch": epoch,
+                                 "last_term": term,
                                  "len": length}).encode(),
                 method="POST")
             with urlrequest.urlopen(req, timeout=2.0) as resp:
@@ -269,12 +377,15 @@ def test_vote_rules_agree_with_live_server(tmp_path):
 
         # a live follower has heard from the leader: every grant refused,
         # exactly what the rule says for heard_from_leader=True
-        probes = [(voter["epoch"] + 1, voter["seq"] - 1),  # shorter WAL
-                  (voter["epoch"], voter["seq"] + 5),      # stale epoch
-                  (voter["epoch"] + 1, voter["seq"] + 5)]  # heard
-        for epoch, length in probes:
-            assert vote(epoch, length) == rules.vote_grants(
-                voter["epoch"], voter["seq"], epoch, length, True)
+        lt = voter["last_term"]
+        probes = [(voter["epoch"] + 1, lt, voter["seq"] - 1),  # short
+                  (voter["epoch"], lt, voter["seq"] + 5),  # stale epoch
+                  (voter["epoch"] + 1, lt - 1, voter["seq"] + 5),  # term
+                  (voter["epoch"] + 1, lt, voter["seq"] + 5)]  # heard
+        for epoch, term, length in probes:
+            assert vote(epoch, term, length) == rules.vote_grants(
+                voter["epoch"], voter["last_term"], voter["seq"],
+                epoch, term, length, True)
 
 
 def test_handle_adopts_election_epoch_same_driver(tmp_path):
