@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: all lint lock-graph check-protocols conformance engine top tune-smoke autoscale-smoke tsan asan ubsan sanitizers test test-fast soak clean
+.PHONY: all lint lock-graph check-protocols conformance doctor engine top tune-smoke autoscale-smoke tsan asan ubsan sanitizers test test-fast soak clean
 
 all: engine
 
@@ -28,16 +28,30 @@ lock-graph:
 check-protocols:
 	$(PYTHON) -m horovod_tpu.verify
 
-# Replay the latest chaos-soak artifacts (KV WAL + flight dumps) against
-# the protocol specs. `make soak` exports its artifacts to
-# SOAK_ARTIFACTS via HOROVOD_SOAK_ARTIFACT_DIR; any directory holding a
-# wal.log / flight_rank*.json works.
+# Replay the latest chaos-soak artifacts (KV WAL + flight dumps + event
+# journals) against the protocol specs. `make soak` exports its
+# artifacts to SOAK_ARTIFACTS via HOROVOD_SOAK_ARTIFACT_DIR (journals
+# included — the journal auditor checks per-writer seq monotonicity and
+# epoch/generation regressions); any directory holding a wal.log /
+# flight_rank*.json / journal_*.log works.
 SOAK_ARTIFACTS ?= /tmp/hvdtpu_soak_artifacts
 conformance:
 	@test -e $(SOAK_ARTIFACTS) || { \
 	    echo "no soak artifacts at $(SOAK_ARTIFACTS) — run 'make soak'" \
 	         "first or pass SOAK_ARTIFACTS=<dir>"; exit 2; }
 	$(PYTHON) -m horovod_tpu.verify --conformance $(SOAK_ARTIFACTS)
+
+# Incident timeline + automated root-cause analysis over the latest soak
+# artifacts (hvd-doctor): merges every host's event journal with flight
+# dumps and KV WALs into one causally-ordered timeline, runs the
+# detector pipeline, prints the ranked verdict, and writes
+# doctor_verdict.json (the hvd-top banner reads it). Pass flags via
+# DOCTOR_ARGS, e.g. DOCTOR_ARGS="--perfetto /tmp/incident.json.gz".
+doctor:
+	@test -e $(SOAK_ARTIFACTS) || { \
+	    echo "no soak artifacts at $(SOAK_ARTIFACTS) — run 'make soak'" \
+	         "first or pass SOAK_ARTIFACTS=<dir>"; exit 2; }
+	$(PYTHON) -m horovod_tpu.obs.doctor $(SOAK_ARTIFACTS) $(DOCTOR_ARGS)
 
 engine:
 	$(MAKE) -C horovod_tpu/engine
@@ -95,12 +109,15 @@ test:
 # preemption drains, partitions, rejoins — now with driver kills mixed
 # into the event schedule; plus the subprocess drain and driver-recovery
 # acceptances, and the 1024-rank tiered-scrape soak whose KV WAL `make
-# conformance` replays) under a hard wall-clock budget. SOAK_BUDGET is
-# seconds.
+# conformance` replays) under a hard wall-clock budget. The run journals
+# every control-plane event to $(SOAK_ARTIFACTS)/journal so `make
+# conformance` can audit it and `make doctor` can explain it.
+# SOAK_BUDGET is seconds.
 SOAK_BUDGET ?= 900
 soak:
 	timeout -k 10 $(SOAK_BUDGET) env JAX_PLATFORMS=cpu \
 	    HOROVOD_SOAK_ARTIFACT_DIR=$(SOAK_ARTIFACTS) \
+	    HOROVOD_JOURNAL_DIR=$(SOAK_ARTIFACTS)/journal \
 	    $(PYTHON) -m pytest \
 	    tests/test_chaos_soak.py tests/test_elastic_recovery.py \
 	    tests/test_control_plane.py tests/test_telemetry_tier.py \

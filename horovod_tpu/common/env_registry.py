@@ -123,6 +123,16 @@ _decl("HOROVOD_KV_LEASE_SECONDS", "float", 2.0,
 _decl("HOROVOD_SOAK_ARTIFACT_DIR", "str", None,
       "chaos-soak runs copy their KV WAL + flight artifacts here so "
       "`make conformance` can replay the latest soak (hvd-check)")
+_decl("HOROVOD_JOURNAL_DIR", "str", None,
+      "durable structured event journal directory (unset = journaling "
+      "off); every control-plane event lands here for hvd-doctor's "
+      "incident timeline")
+_decl("HOROVOD_JOURNAL_SEGMENT_BYTES", "int", 4 << 20,
+      "journal segment size that triggers close-and-rotate (the active "
+      "segment is never deleted by retention)")
+_decl("HOROVOD_JOURNAL_SEGMENTS", "int", 8,
+      "journal segments retained per writer process; oldest closed "
+      "segments beyond this are deleted")
 
 # -- engine tuning knobs (EngineOptions, common.h) --
 _decl("HOROVOD_CYCLE_TIME", "float", 1.0,

@@ -1,0 +1,825 @@
+"""``hvd-doctor`` — offline incident analyzer over the fused event plane.
+
+The repo emits five artifact families during ordinary operation: the
+durable event journal (:mod:`horovod_tpu.common.journal`), per-shard KV
+WALs, flight-recorder dumps, request-trace rings, and the metrics plane.
+When an incident happens (a worker SIGKILLed mid-step, a KV leader
+election under a half-finished resize, a drain racing a kill), the
+evidence is spread across all of them. This module fuses journals from
+every host with the KV WALs and flight dumps into ONE causally-ordered
+timeline, runs a detector pipeline over it, and prints a ranked
+**verdict**: root cause, the evidence events by id, the blast radius,
+and a remediation hint.
+
+Ordering rules (the tentpole's contract):
+
+- Control-plane events are **fenced**: they carry ``control_epoch`` and
+  ``generation``, which only move forward (the conformance auditors
+  enforce exactly that). The timeline's primary order is
+  ``(control_epoch, generation)`` — carried forward per writer stream
+  for events between fenced ones — so a stale epoch's events sort
+  before the election that fenced them regardless of clock skew.
+- Within a fence bucket, wall clocks order cross-writer events and the
+  per-writer ``seq`` breaks ties (journal appends are monotonic per
+  writer by construction).
+- Per-rank flight events have no trustworthy wall clock; they are
+  aligned across ranks with the PR-5 CYCLE anchor method
+  (:func:`horovod_tpu.profiler.flight.align_clocks`) and anchored to
+  wall time by each dump's ``dump_unix_us``.
+
+Run it as ``hvd-doctor <dir>`` (or ``python -m horovod_tpu.obs.doctor``,
+or ``make doctor``) over a soak artifact directory — the same layout
+``make conformance`` replays: ``journal/`` (or loose ``journal_*.log``),
+``kv/`` and ``flight/`` subdirectories are discovered automatically.
+Every run also writes ``doctor_verdict.json`` next to the journal so
+``hvd-top`` can surface the newest verdict's age + incident count in its
+banner, and ``--perfetto OUT`` exports the fused timeline through the
+PR-5 ``trace_merge`` writer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.common import journal
+
+VERDICT_FILE = "doctor_verdict.json"
+
+# Shed-storm clustering: this many sheds inside the window is a storm,
+# not backpressure doing its job.
+SHED_STORM_MIN = 10
+SHED_STORM_WINDOW_SEC = 10.0
+
+_DRAIN_EVENTS = ("preempt_drain", "admin_drain", "drain_announce")
+_SHED_EVENTS = ("shed", "request_rejected", "request_expired")
+
+
+# ===========================================================================
+# Timeline construction
+# ===========================================================================
+
+def _journal_timeline(journal_dir) -> List[dict]:
+    out = []
+    for rec in journal.iter_journal(journal_dir):
+        ev = dict(rec)
+        ev["source"] = "journal"
+        ev["writer"] = f"{rec.get('host')}:{rec.get('pid')}"
+        out.append(ev)
+    return out
+
+
+def _kv_timeline(kv_dir) -> List[dict]:
+    """KV WAL ops as timeline events (read-only; one stream per shard).
+    The op-level ``"e"`` stamp is the epoch claim, the decoded value's
+    ``ts``/``generation`` fields supply wall clock and generation where
+    the family records them."""
+    from horovod_tpu.verify import conformance
+    kv_dir = Path(kv_dir)
+    wal_files = {"core": "wal.log"}
+    for f in sorted(kv_dir.glob("wal-*.log")):
+        wal_files[f.name[len("wal-"):-len(".log")]] = f.name
+    out: List[dict] = []
+    for shard, wal_file in wal_files.items():
+        for i, op in enumerate(conformance.iter_wal_ops(kv_dir, wal_file)):
+            val = conformance._decoded_value(op) \
+                if op.get("op") == "put" else None
+            ev = {
+                "id": f"kvwal:{shard}:{op.get('s', i)}",
+                "source": "kv_wal",
+                "writer": f"kvwal:{shard}",
+                "component": "kv_wal",
+                "event": f"{op.get('op', '?')} {op.get('k', '')}",
+                "seq": op.get("s", i),
+            }
+            if op.get("e") is not None:
+                ev["control_epoch"] = op["e"]
+            if isinstance(val, dict):
+                if "generation" in val:
+                    ev["generation"] = val["generation"]
+                if "ts" in val:
+                    try:
+                        ev["t_wall"] = float(val["ts"])
+                    except (TypeError, ValueError):
+                        pass
+                ev["detail"] = {k: v for k, v in val.items()
+                                if k not in ("ts",)}
+            out.append(ev)
+    return out
+
+
+def _flight_timeline(dumps: Dict[int, dict]) -> List[dict]:
+    """Per-rank flight events worth fusing (DESYNC + dump triggers),
+    CYCLE-aligned and wall-anchored by each dump's ``dump_unix_us``."""
+    from horovod_tpu.profiler.flight import align_clocks
+    if not dumps:
+        return []
+    offsets = align_clocks(dumps)
+    # wall anchor: pick one rank's (dump wall time, last aligned mono)
+    # pair and place every aligned mono timestamp relative to it
+    anchor_rank = sorted(dumps)[0]
+    anchor_wall = float(dumps[anchor_rank].get("dump_unix_us", 0)) / 1e6
+    anchor_mono = max((float(e.get("ts_us", 0))
+                       for e in dumps[anchor_rank].get("events", [])),
+                      default=0.0) + offsets.get(anchor_rank, 0.0)
+    out: List[dict] = []
+    for r, d in sorted(dumps.items()):
+        for i, e in enumerate(d.get("events", [])):
+            phase = e.get("phase")
+            if phase not in ("DESYNC", "DUMP"):
+                continue
+            aligned = float(e.get("ts_us", 0)) + offsets.get(r, 0.0)
+            out.append({
+                "id": f"flight:{r}:{i}",
+                "source": "flight",
+                "writer": f"flight:{r}",
+                "component": "flight",
+                "event": f"{phase} {e.get('name', '')}".strip(),
+                "rank": r,
+                "seq": i,
+                "t_wall": anchor_wall + (aligned - anchor_mono) / 1e6
+                if anchor_wall else None,
+            })
+    return out
+
+
+def order_events(events: List[dict]) -> List[dict]:
+    """Causal order: (control_epoch, generation) fence buckets first —
+    carried forward per writer stream so unfenced events ride their
+    stream's last-known fence — then wall clock, then (writer, seq)."""
+    by_writer: Dict[str, List[dict]] = {}
+    for ev in events:
+        by_writer.setdefault(ev.get("writer", "?"), []).append(ev)
+    for stream in by_writer.values():
+        stream.sort(key=lambda e: (e.get("seq") if isinstance(
+            e.get("seq"), (int, float)) else 0))
+        epoch, gen = -1, -1
+        for ev in stream:
+            if isinstance(ev.get("control_epoch"), (int, float)):
+                epoch = max(epoch, int(ev["control_epoch"]))
+            if isinstance(ev.get("generation"), (int, float)):
+                gen = max(gen, int(ev["generation"]))
+            ev["_ek"], ev["_gk"] = epoch, gen
+
+    def key(ev):
+        tw = ev.get("t_wall")
+        return (ev["_ek"], ev["_gk"],
+                float(tw) if tw is not None else 0.0,
+                str(ev.get("writer", "")),
+                ev.get("seq") if isinstance(ev.get("seq"),
+                                            (int, float)) else 0)
+    out = sorted(events, key=key)
+    for ev in out:
+        ev.pop("_ek", None)
+        ev.pop("_gk", None)
+    return out
+
+
+def _discover_dirs(path) -> Tuple[Optional[Path], List[Path], List[Path]]:
+    """(journal_dir, kv_dirs, flight_dirs) under a soak artifact root.
+    Loose ``journal_*.log`` files in the root count as the journal."""
+    path = Path(path)
+    journal_dir = None
+    for cand in (path / "journal", path):
+        if sorted(cand.glob("journal_*.log")):
+            journal_dir = cand
+            break
+    kv_dirs, seen = [], set()
+    for d in [path, path / "kv", *sorted(path.glob("**/"))]:
+        d = d.resolve()
+        if d not in seen and ((d / "wal.log").exists()
+                              or sorted(d.glob("wal-*.log"))):
+            seen.add(d)
+            kv_dirs.append(d)
+    flight_dirs = sorted({f.parent
+                          for f in path.glob("**/flight_rank*.json")})
+    return journal_dir, kv_dirs, flight_dirs
+
+
+def build_timeline(path, journal_dir=None, kv_dir=None,
+                   flight_dir=None) -> dict:
+    """The analysis context: fused ordered events + the per-family
+    artifacts the detectors lean on (flight analyzer verdict,
+    conformance divergences)."""
+    auto_journal, auto_kv, auto_flight = _discover_dirs(path)
+    journal_dir = Path(journal_dir) if journal_dir else auto_journal
+    kv_dirs = [Path(kv_dir)] if kv_dir else auto_kv
+    flight_dirs = [Path(flight_dir)] if flight_dir else auto_flight
+
+    events: List[dict] = []
+    if journal_dir is not None:
+        events += _journal_timeline(journal_dir)
+    for d in kv_dirs:
+        events += _kv_timeline(d)
+
+    from horovod_tpu.profiler import flight
+    dumps: Dict[int, dict] = {}
+    for d in flight_dirs:
+        dumps.update(flight.load_dumps(d))
+    flight_verdict = flight.analyze(dumps) if dumps else None
+    events += _flight_timeline(dumps)
+
+    divergences: List[str] = []
+    from horovod_tpu.verify import conformance
+    for d in kv_dirs:
+        divergences += conformance.check_kv_wal(d)
+    if journal_dir is not None:
+        divergences += conformance.check_journal(journal_dir)
+
+    return {
+        "path": str(path),
+        "journal_dir": str(journal_dir) if journal_dir else None,
+        "kv_dirs": [str(d) for d in kv_dirs],
+        "flight_dirs": [str(d) for d in flight_dirs],
+        "events": order_events(events),
+        "flight_dumps": dumps,
+        "flight_verdict": flight_verdict,
+        "divergences": divergences,
+    }
+
+
+# ===========================================================================
+# Detector pipeline
+# ===========================================================================
+
+def _incident(cause: str, severity: int, title: str, root_cause: str,
+              evidence: List[str], blast_radius: str,
+              remediation: str, **detail) -> dict:
+    inc = {"cause": cause, "severity": int(severity), "title": title,
+           "root_cause": root_cause,
+           "evidence": [e for e in evidence if e][:16],
+           "blast_radius": blast_radius, "remediation": remediation}
+    if detail:
+        inc["detail"] = detail
+    return inc
+
+
+def _slot(ev: dict) -> Optional[Tuple[str, object]]:
+    d = ev.get("detail") or {}
+    host, lr = d.get("host"), d.get("local_rank")
+    if host is None:
+        return None
+    return (str(host), lr)
+
+
+def detect_dead_rank(ctx) -> List[dict]:
+    """Worker death that no drain explains: SIGKILL/OOM/crash mid-step.
+    Flight-analyzer dead ranks corroborate when dumps are present."""
+    out = []
+    drained: set = set()
+    for ev in ctx["events"]:
+        if ev.get("event") in _DRAIN_EVENTS:
+            s = _slot(ev)
+            if s:
+                drained.add(s)
+        if ev.get("event") == "worker_exit" and \
+                (ev.get("detail") or {}).get("reason") == "failure":
+            s = _slot(ev)
+            if s in drained:
+                continue  # the drain-race detector owns this one
+            d = ev.get("detail") or {}
+            fl = ctx.get("flight_verdict") or {}
+            evidence = [ev.get("id")]
+            corroboration = ""
+            if fl.get("dead_ranks"):
+                evidence += [f"flight:{r}" for r in fl["dead_ranks"]]
+                corroboration = (" — flight analyzer confirms rank(s) "
+                                 f"{fl['dead_ranks']} left no dump")
+            if fl.get("in_flight"):
+                tensors = [x.get("tensor") for x in fl["in_flight"][:3]]
+                corroboration += (f"; collective(s) {tensors} were "
+                                  "in flight")
+            out.append(_incident(
+                "dead_rank", 100, "worker died mid-step",
+                f"worker {s[0]}/{s[1]} exited with code "
+                f"{d.get('exit_code')} with no drain announced (killed: "
+                f"SIGKILL/OOM/crash){corroboration}",
+                evidence,
+                f"generation {ev.get('generation')} torn down; every "
+                "surviving rank re-rendezvoused at the next generation",
+                "check the host's OOM killer / preemption logs; the "
+                "driver respawns the slot — recurring deaths on one "
+                "host end in a blacklist",
+                host=s[0], local_rank=s[1],
+                exit_code=d.get("exit_code")))
+    return out
+
+
+def detect_desync(ctx) -> List[dict]:
+    fl = ctx.get("flight_verdict") or {}
+    desync_events = [e for e in ctx["events"]
+                     if e.get("source") == "flight"
+                     and e.get("event", "").startswith("DESYNC")]
+    journal_desync = [e for e in ctx["events"]
+                      if e.get("event") == "flight_verdict"
+                      and (e.get("detail") or {}).get("desync")]
+    if not (fl.get("desync") or desync_events or journal_desync):
+        return []
+    return [_incident(
+        "desync", 95, "cross-rank collective desync",
+        "ranks submitted mismatched collectives under one name "
+        "(signature/exec-order divergence) — a framework-level bug, "
+        "not an infrastructure failure",
+        [e.get("id") for e in desync_events + journal_desync] or
+        ["flight-analyzer"],
+        "the whole job: results past the divergence are suspect",
+        "inspect the flight dumps' DESYNC records "
+        "(hvd-flight-analyze) and bisect the model change that made "
+        "rank programs diverge")]
+
+
+def detect_drain_race(ctx) -> List[dict]:
+    """A drain that lost its race: announced, but the worker died (or a
+    second drain piled on) before the handoff finalized."""
+    out = []
+    drains: Dict[Tuple[str, object], dict] = {}
+    kinds: Dict[Tuple[str, object], set] = {}
+    finalized: set = set()
+    for ev in ctx["events"]:
+        s = _slot(ev)
+        if ev.get("event") in _DRAIN_EVENTS and s:
+            drains.setdefault(s, ev)
+            kinds.setdefault(s, set()).add(ev["event"])
+        if ev.get("event") == "worker_exit" and s:
+            reason = (ev.get("detail") or {}).get("reason")
+            if reason == "drained":
+                finalized.add(s)
+            if reason == "failure" and s in drains:
+                out.append(_incident(
+                    "drain_race", 80, "drain lost its race",
+                    f"worker {s[0]}/{s[1]} announced a drain "
+                    f"(event {drains[s].get('id')}) but died (exit "
+                    f"{(ev.get('detail') or {}).get('exit_code')}) "
+                    "before the handoff completed — the preemption "
+                    "window was shorter than the drain",
+                    [drains[s].get("id"), ev.get("id")],
+                    "the slot's shard handoff was lost; the next "
+                    "generation re-materialized its state",
+                    "raise the preemption notice lead time or shrink "
+                    "commit intervals so handoffs beat the reaper",
+                    host=s[0], local_rank=s[1]))
+    for s, ks in kinds.items():
+        if "admin_drain" in ks and len(ks) > 1 and s not in finalized:
+            out.append(_incident(
+                "drain_race", 78, "double drain on one slot",
+                f"slot {s[0]}/{s[1]} was drained by the autoscaler AND "
+                "announced its own preemption drain — the second "
+                "notice force-exits the worker, dropping acked work",
+                [drains[s].get("id")],
+                f"slot {s[0]}/{s[1]}'s in-flight requests",
+                "the autoscaler must skip already-draining victims "
+                "(AutoscaleSpec's victim_draining mutant pins this)",
+                host=s[0], local_rank=s[1]))
+    return out
+
+
+def detect_split_brain(ctx) -> List[dict]:
+    fenced = [e for e in ctx["events"]
+              if e.get("event") == "stale_epoch_rejected"]
+    self_fences = [e for e in ctx["events"]
+                   if e.get("event") == "self_fence"]
+    wal_split = [d for d in ctx.get("divergences", [])
+                 if "split-brain" in d]
+    out = []
+    if fenced:
+        offers = sorted({(e.get("detail") or {}).get("offered")
+                         for e in fenced if e.get("detail")})
+        current = max((e.get("control_epoch") or 0) for e in fenced)
+        out.append(_incident(
+            "split_brain_attempt", 85,
+            "stale-epoch rival driver fenced",
+            f"a fenced-out driver (epoch(s) {offers}) kept mutating "
+            f"after epoch {current} was claimed — a rival/zombie "
+            "incarnation; every attempt was rejected with 409",
+            [e.get("id") for e in fenced],
+            "none: fencing held, no stale mutation landed"
+            if not wal_split else
+            f"WAL audit found {len(wal_split)} stale mutation(s) that "
+            "LANDED — state may be corrupt",
+            "verify the old driver process is dead; if the WAL audit "
+            "reports landed stale writes, restore from the last clean "
+            "snapshot", rejections=len(fenced)))
+    elif wal_split:
+        out.append(_incident(
+            "split_brain_attempt", 92, "split-brain mutation landed",
+            "the KV WAL audit found mutations claiming a regressed "
+            "control epoch — a stale driver's write was admitted",
+            [], "control-plane state past the regression is suspect",
+            "treat the KV as corrupt: re-seed from the last snapshot "
+            "preceding the regression", divergences=wal_split[:4]))
+    if self_fences:
+        out.append(_incident(
+            "split_brain_attempt", 70, "KV leader self-fenced",
+            "a KV replica leader lost its majority/lease and stepped "
+            "down rather than serve a minority partition",
+            [e.get("id") for e in self_fences],
+            "writes paused for one election round",
+            "expected behavior under partition; investigate the "
+            "network if it recurs"))
+    return out
+
+
+def detect_kv_leader_failover(ctx) -> List[dict]:
+    elections = [e for e in ctx["events"]
+                 if e.get("event") == "elected_leader"]
+    respawns = [e for e in ctx["events"]
+                if e.get("event") == "kv_replica_respawn"]
+    if len(elections) < 2 and not respawns:
+        return []  # a single election is just startup
+    # was a resize/autoscale decision in flight across the failover?
+    last_election_epoch = max((e.get("control_epoch") or 0)
+                              for e in elections) if elections else None
+    decides = [e for e in ctx["events"]
+               if e.get("event") in ("autoscale_decide",
+                                     "autoscale_resize",
+                                     "autoscale_drain")]
+    acks = [e for e in ctx["events"] if e.get("event") == "autoscale_ack"]
+    acked = {(e.get("detail") or {}).get("seq") for e in acks}
+    in_flight = [e for e in decides
+                 if (e.get("detail") or {}).get("seq") not in acked]
+    mid_resize = ""
+    if in_flight:
+        mid_resize = (" while autoscale decision seq "
+                      f"{(in_flight[-1].get('detail') or {}).get('seq')} "
+                      f"({(in_flight[-1].get('detail') or {}).get('action')}) "
+                      "was between decide and ack")
+    return [_incident(
+        "kv_leader_failover", 90, "KV leader failover" +
+        (" mid-resize" if in_flight else ""),
+        f"the KV leader died and a successor was elected"
+        f"{' (epoch ' + str(last_election_epoch) + ')' if last_election_epoch else ''}"
+        f"{mid_resize}; majority-acked state survived by the election "
+        "rule",
+        [e.get("id") for e in respawns + elections],
+        "control-plane writes stalled for one election; any in-flight "
+        "resize resumed from its KV decision record",
+        "nothing if it happened once (this is the design working); "
+        "recurring leader deaths mean the replica host is sick",
+        elections=len(elections), respawns=len(respawns),
+        resize_in_flight=bool(in_flight))]
+
+
+def detect_headless_outage(ctx) -> List[dict]:
+    crashes = [e for e in ctx["events"]
+               if e.get("event") == "driver_crash"]
+    recoveries = [e for e in ctx["events"]
+                  if e.get("event") == "driver_recovered"]
+    exhausted = [e for e in ctx["events"]
+                 if e.get("event") == "restart_limit_exhausted"]
+    entered = [e for e in ctx["events"]
+               if e.get("event") == "headless_entered"]
+    exited = [e for e in ctx["events"]
+              if e.get("event") == "headless_exited"]
+    aborts = [e for e in ctx["events"]
+              if e.get("event") == "headless_abort"]
+    out = []
+    unhealed = exhausted or aborts or \
+        (entered and len(exited) < len(entered) and not recoveries)
+    if unhealed:
+        out.append(_incident(
+            "headless_outage", 88, "headless outage (control plane down)",
+            "the driver/KV went down and never came back within the "
+            "deadline" + (" — the supervisor's restart budget is "
+                          "exhausted" if exhausted else "") +
+            (" — worker(s) aborted at the headless deadline"
+             if aborts else ""),
+            [e.get("id") for e in exhausted + aborts + entered + crashes],
+            "workers trained on peer-to-peer only (no resize, no "
+            "drain handling, no telemetry) until the deadline",
+            "restart the launcher; raise "
+            "HOROVOD_DRIVER_RESTART_LIMIT / inspect why every respawn "
+            "died"))
+    elif crashes:
+        out.append(_incident(
+            "driver_crash_recovered", 55, "driver crash (recovered)",
+            f"the driver crashed {len(crashes)} time(s); each respawn "
+            "replayed the WAL, re-claimed a higher epoch, and adopted "
+            "the still-running workers",
+            [e.get("id") for e in (crashes + recoveries)],
+            "a control-plane observability gap of seconds; training "
+            "never stopped (headless mode)",
+            "none needed — verify adopted worker counts match; "
+            "recurring crashes deserve a look at the driver host"))
+    return out
+
+
+def detect_shed_storm(ctx) -> List[dict]:
+    sheds = [e for e in ctx["events"]
+             if e.get("component") == "serve"
+             and e.get("event") in _SHED_EVENTS]
+    if len(sheds) < SHED_STORM_MIN:
+        return []
+    # densest window
+    times = sorted(float(e.get("t_wall") or 0.0) for e in sheds)
+    best, lo = 0, 0
+    for hi in range(len(times)):
+        while times[hi] - times[lo] > SHED_STORM_WINDOW_SEC:
+            lo += 1
+        best = max(best, hi - lo + 1)
+    if best < SHED_STORM_MIN:
+        return []
+    reasons = [((e.get("detail") or {}).get("reason") or
+                (e.get("detail") or {}).get("error") or "")
+               for e in sheds]
+    cache = sum(1 for r in reasons
+                if "cache" in r or "capacity" in r or "block" in r)
+    kind = "cache-exhaustion shed storm" if cache >= best // 2 \
+        else "flash-crowd shed storm"
+    return [_incident(
+        "shed_storm", 70, kind,
+        f"{len(sheds)} requests shed ({best} inside "
+        f"{SHED_STORM_WINDOW_SEC:.0f}s)" +
+        (" with cache/capacity exhaustion reasons — the paged KV "
+         "cache ran out of blocks" if cache >= best // 2 else
+         " — offered load exceeded fleet capacity"),
+        [e.get("id") for e in sheds[:8]],
+        f"{len(sheds)} client requests got 429/expired",
+        "scale up (the autoscaler should have fired — check its "
+        "cooldowns) or raise the cache block budget; verify priority "
+        "classes shed in the right order",
+        sheds=len(sheds), densest_window=best,
+        cache_exhaustion=cache >= best // 2)]
+
+
+def detect_flap(ctx) -> List[dict]:
+    decides = [e for e in ctx["events"]
+               if e.get("event") == "autoscale_decide"]
+    actions = [(e.get("detail") or {}).get("action") for e in decides]
+    flips = sum(1 for a, b in zip(actions, actions[1:])
+                if a != b and a in ("up", "down") and b in ("up", "down"))
+    if flips < 2:
+        return []
+    return [_incident(
+        "flap", 60, "autoscale flapping",
+        f"{len(decides)} autoscale decisions reversed direction "
+        f"{flips} time(s) — hysteresis windows/cooldowns are too "
+        "short for this load pattern",
+        [e.get("id") for e in decides[:8]],
+        "each flap is a resize: a full re-rendezvous paid for "
+        "nothing",
+        "raise HOROVOD_AUTOSCALE_UP_WINDOWS/DOWN_WINDOWS or the "
+        "cooldowns so one noisy window can't resize the fleet",
+        decisions=len(decides), direction_changes=flips)]
+
+
+def detect_partition(ctx) -> List[dict]:
+    stale = [e for e in ctx["events"]
+             if e.get("event") == "discovery_stale"]
+    healed = [e for e in ctx["events"]
+              if e.get("event") == "discovery_recovered"]
+    if not stale:
+        return []
+    if healed:
+        return [_incident(
+            "partition_healed", 50, "network partition (healed)",
+            "serve discovery went unreachable and later recovered — a "
+            "partition or control-plane restart separated the router "
+            "from the KV, then healed",
+            [e.get("id") for e in stale + healed],
+            "routers served on their last-known worker table during "
+            "the gap; no placements were lost to it",
+            "none if brief; correlate with KV failover events above "
+            "if any")]
+    return [_incident(
+        "partition", 72, "discovery partition (unhealed)",
+        "serve discovery went stale and never recovered in this "
+        "artifact window — routers are flying blind on a stale "
+        "worker table",
+        [e.get("id") for e in stale],
+        "new workers are invisible to routers; dead ones keep "
+        "receiving dispatch attempts until the retry path fails them",
+        "check the KV endpoints the router holds; restart the router "
+        "with fresh discovery if the KV moved")]
+
+
+def detect_step_regression(ctx) -> List[dict]:
+    """Straggler vs express-lane regression: one slow rank is a
+    straggler (that machine); most ranks slowing together is a lane
+    regression (the collective path got slower — express-lane demotion,
+    fusion misconfig)."""
+    stragglers = [e for e in ctx["events"]
+                  if e.get("event") == "straggler"]
+    anomalies = [e for e in ctx["events"]
+                 if e.get("event") == "step_anomaly"]
+    if not stragglers and not anomalies:
+        return []
+    ranks = {e.get("rank") for e in stragglers + anomalies
+             if e.get("rank") is not None}
+    fleet = 0
+    for e in ctx["events"]:
+        if e.get("event") == "resize":
+            fleet = max(fleet, int((e.get("detail") or {})
+                                   .get("slots") or 0))
+    fl = ctx.get("flight_verdict") or {}
+    if len(ranks) >= 2 and fleet and len(ranks) >= max(2, fleet // 2):
+        return [_incident(
+            "express_lane_regression", 65, "fleet-wide step regression",
+            f"{len(ranks)} of {fleet} ranks flagged slow in the same "
+            "window — not one sick machine but a shared-path "
+            "regression (express-lane demotion, fusion/cycle "
+            "misconfiguration, or network degradation)",
+            [e.get("id") for e in (stragglers + anomalies)[:8]],
+            "every step pays the regression until the knob is found",
+            "diff the tuner's current bucket/fusion/express knobs "
+            "against the last good run (hvd-top --tune); check "
+            "hvd_tune_* gauges for a recent demotion",
+            ranks=sorted(ranks), fleet=fleet)]
+    lag = f" (flight analyzer: rank {fl['lagging_rank']} lagged " \
+          f"{fl.get('lag_behind_us', 0) / 1e3:.0f}ms)" \
+        if fl.get("lagging_rank") is not None else ""
+    return [_incident(
+        "straggler", 40, "straggler rank",
+        f"rank(s) {sorted(ranks)} ran consistently slower than the "
+        f"fleet median{lag} — one machine's problem (thermal, "
+        "noisy neighbor, degraded link)",
+        [e.get("id") for e in (stragglers + anomalies)[:8]],
+        "synchronous steps run at the straggler's pace: the whole "
+        "fleet pays its slowdown",
+        "drain the slow host and let the elastic driver rebalance; "
+        "check its thermals/neighbors before re-admitting",
+        ranks=sorted(ranks))]
+
+
+DETECTORS = (
+    detect_dead_rank,
+    detect_desync,
+    detect_drain_race,
+    detect_split_brain,
+    detect_kv_leader_failover,
+    detect_headless_outage,
+    detect_shed_storm,
+    detect_flap,
+    detect_partition,
+    detect_step_regression,
+)
+
+
+def diagnose(ctx) -> dict:
+    """Run the detector pipeline; returns the ranked verdict."""
+    incidents: List[dict] = []
+    for det in DETECTORS:
+        try:
+            incidents += det(ctx)
+        except Exception as e:  # noqa: BLE001 — one broken detector must
+            incidents.append(_incident(  # not hide the others' findings
+                "detector_error", 1, f"detector {det.__name__} failed",
+                repr(e), [], "analysis gap", "fix the detector"))
+    incidents.sort(key=lambda i: (-i["severity"], i["cause"]))
+    return {
+        "generated_at": time.time(),
+        "analyzed": {
+            "events": len(ctx["events"]),
+            "journal_dir": ctx.get("journal_dir"),
+            "kv_dirs": ctx.get("kv_dirs", []),
+            "flight_dirs": ctx.get("flight_dirs", []),
+            "divergences": len(ctx.get("divergences", [])),
+        },
+        "incident_count": len(incidents),
+        "top_cause": incidents[0]["cause"] if incidents else None,
+        "incidents": incidents,
+    }
+
+
+# ===========================================================================
+# Output
+# ===========================================================================
+
+def render_verdict(verdict: dict) -> str:
+    a = verdict["analyzed"]
+    lines = [f"hvd-doctor verdict — {verdict['incident_count']} "
+             f"incident(s) over {a['events']} fused event(s)"
+             f" ({a['divergences']} conformance divergence(s))"]
+    if not verdict["incidents"]:
+        lines.append("  no incidents detected: the timeline is healthy")
+    for n, inc in enumerate(verdict["incidents"], 1):
+        lines.append(f"{n:3d}. [{inc['cause']}] {inc['title']} "
+                     f"(severity {inc['severity']})")
+        lines.append(f"     root cause : {inc['root_cause']}")
+        if inc["evidence"]:
+            lines.append(f"     evidence   : "
+                         f"{', '.join(map(str, inc['evidence']))}")
+        lines.append(f"     blast      : {inc['blast_radius']}")
+        lines.append(f"     remediation: {inc['remediation']}")
+    return "\n".join(lines)
+
+
+def export_perfetto(ctx, out_path) -> dict:
+    """The fused timeline as one Perfetto-loadable trace: flight dumps
+    through the PR-5 lane machinery, journal/KV events as an instant
+    lane per component."""
+    from horovod_tpu.profiler import flight, trace_merge
+    merged: List[dict] = []
+    dumps = ctx.get("flight_dumps") or {}
+    if dumps:
+        merged += flight.to_perfetto(dumps)["traceEvents"]
+    timeline = [e for e in ctx["events"] if e.get("source") != "flight"]
+    walls = [float(e["t_wall"]) for e in timeline
+             if e.get("t_wall") is not None]
+    t0 = min(walls) if walls else 0.0
+    instants = []
+    for e in timeline:
+        tw = e.get("t_wall")
+        instants.append({
+            "name": f"{e.get('component')}:{e.get('event')}",
+            "ph": "X", "dur": 1,
+            "ts": (float(tw) - t0) * 1e6 if tw is not None else 0.0,
+            "tid": str(e.get("component")),
+            "args": {"id": e.get("id"),
+                     "control_epoch": e.get("control_epoch"),
+                     "generation": e.get("generation"),
+                     "detail": e.get("detail")},
+        })
+    merged += trace_merge._rewrite_engine_events(
+        instants, engine_pid=trace_merge.DEFAULT_ENGINE_PID + 512,
+        engine_label="hvd-doctor incident timeline", offset_us=0.0)
+    return trace_merge.merge_traces([], jax_trace=merged,
+                                    out_path=out_path)
+
+
+def write_verdict_file(verdict: dict, journal_dir) -> Optional[str]:
+    """Persist the verdict next to the journal (write-then-rename) so
+    ``hvd-top`` can banner its age + incident count. Best-effort."""
+    if not journal_dir:
+        return None
+    path = os.path.join(str(journal_dir), VERDICT_FILE)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(verdict, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def read_verdict_file(journal_dir) -> Optional[dict]:
+    """The newest persisted verdict, or None (hvd-top's banner read)."""
+    try:
+        with open(os.path.join(str(journal_dir), VERDICT_FILE)) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+# ===========================================================================
+# CLI
+# ===========================================================================
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hvd-doctor",
+        description="offline incident analyzer: fuse event journals, KV "
+                    "WALs, and flight dumps into one causally-ordered "
+                    "timeline and name the root cause")
+    p.add_argument("path", nargs="?",
+                   help="artifact directory (journal/kv/flight "
+                        "subdirectories are discovered; default "
+                        "HOROVOD_JOURNAL_DIR, then "
+                        "HOROVOD_SOAK_ARTIFACT_DIR)")
+    p.add_argument("--journal-dir", help="explicit journal directory")
+    p.add_argument("--kv-dir", help="explicit KV WAL directory")
+    p.add_argument("--flight-dir", help="explicit flight-dump directory")
+    p.add_argument("--perfetto", metavar="OUT",
+                   help="export the fused timeline as a Perfetto trace")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable verdict")
+    p.add_argument("--fail-on-incident", action="store_true",
+                   help="exit 1 when any incident is detected (CI gates)")
+    args = p.parse_args(argv)
+
+    from horovod_tpu.common.env_registry import env_str
+    path = args.path or args.journal_dir or \
+        env_str("HOROVOD_JOURNAL_DIR") or \
+        env_str("HOROVOD_SOAK_ARTIFACT_DIR")
+    if not path:
+        p.error("no artifact path: pass a directory or set "
+                "HOROVOD_JOURNAL_DIR / HOROVOD_SOAK_ARTIFACT_DIR")
+    ctx = build_timeline(path, journal_dir=args.journal_dir,
+                         kv_dir=args.kv_dir, flight_dir=args.flight_dir)
+    verdict = diagnose(ctx)
+    written = write_verdict_file(
+        verdict, ctx.get("journal_dir") or
+        (args.journal_dir or env_str("HOROVOD_JOURNAL_DIR")))
+    if args.perfetto:
+        export_perfetto(ctx, args.perfetto)
+    if args.as_json:
+        print(json.dumps(verdict, indent=2, default=str))
+    else:
+        print(render_verdict(verdict))
+        if written:
+            print(f"(verdict persisted to {written})")
+        if args.perfetto:
+            print(f"(fused Perfetto timeline: {args.perfetto})")
+    if args.fail_on_incident and verdict["incident_count"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,6 +212,24 @@ def _fmt_bytes(n) -> str:
     return f"{int(n)}B"
 
 
+def render_doctor_banner(journal_dir) -> Optional[str]:
+    """The newest ``hvd-doctor`` verdict's age + incident count, when a
+    journal directory carries one (``doctor_verdict.json``). None = no
+    verdict yet — no banner line."""
+    from horovod_tpu.obs import doctor
+    verdict = doctor.read_verdict_file(journal_dir)
+    if not verdict:
+        return None
+    age = max(0.0, time.time() - float(verdict.get("generated_at", 0.0)))
+    age_s = f"{age / 3600:.1f}h" if age >= 3600 else f"{age:.0f}s"
+    n = int(verdict.get("incident_count", 0))
+    if not n:
+        return f"doctor: healthy (verdict {age_s} old)"
+    return (f"*** doctor: {n} incident(s), top cause "
+            f"{verdict.get('top_cause')} (verdict {age_s} old — rerun "
+            f"hvd-doctor for a fresh one) ***")
+
+
 def render_kv_banner(h: dict) -> str:
     if h["leader"] is None:
         return (f"*** KV: NO LEADER reachable ({h['up']}/{h['total']} "
@@ -817,6 +835,14 @@ class TopState:
             try:
                 text = render_kv_banner(
                     kv_health(self.kv_endpoints)) + "\n" + text
+            except Exception:  # noqa: BLE001 — banner is best-effort
+                pass
+        journal_dir = env_str("HOROVOD_JOURNAL_DIR")
+        if journal_dir:
+            try:
+                doctor_line = render_doctor_banner(journal_dir)
+                if doctor_line:
+                    text = doctor_line + "\n" + text
             except Exception:  # noqa: BLE001 — banner is best-effort
                 pass
         return text

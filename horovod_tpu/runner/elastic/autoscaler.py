@@ -47,7 +47,7 @@ import json
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
-from horovod_tpu.common import kv_keys
+from horovod_tpu.common import journal, kv_keys
 from horovod_tpu.common.env_registry import env_float, env_int
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
@@ -319,6 +319,12 @@ class Autoscaler:
         if self.kv is not None:
             self.kv.put_json(kv_keys.autoscale_decision(), self.pending,
                              epoch=self.epoch)
+        journal.emit("autoscaler", f"autoscale_{state}",
+                     control_epoch=self.epoch,
+                     seq=self.pending.get("seq"),
+                     action=self.pending.get("action"),
+                     victim=self.pending.get("victim"),
+                     outcome=extra.get("outcome"))
 
     def _open(self, decision: Decision, fleet_size: int):
         self._seq += 1
@@ -332,6 +338,10 @@ class Autoscaler:
         if self.kv is not None:
             self.kv.put_json(kv_keys.autoscale_decision(), self.pending,
                              epoch=self.epoch)
+        journal.emit("autoscaler", "autoscale_decide",
+                     control_epoch=self.epoch, seq=self._seq,
+                     action=decision.action, victim=decision.victim,
+                     reason=decision.reason, fleet=fleet_size)
         self._g_pending.set(1)
 
     def _ack(self, outcome: str = "completed"):
@@ -367,6 +377,10 @@ class Autoscaler:
             "autoscale recovery: resuming %s decision seq %s at state %s "
             "(old epoch %s -> %s)", rec.get("action"), rec.get("seq"),
             rec.get("state"), rec.get("epoch"), self.epoch)
+        journal.emit("autoscaler", "autoscale_resume",
+                     control_epoch=self.epoch, seq=rec.get("seq"),
+                     action=rec.get("action"), state=rec.get("state"),
+                     old_epoch=rec.get("epoch"))
         # the re-claimed record fences the dead driver's epoch out of the
         # rest of this decision's writes
         if self.kv is not None:

@@ -34,7 +34,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from horovod_tpu.common import kv_keys
+from horovod_tpu.common import journal, kv_keys
 from horovod_tpu.common.env_registry import (env_bool, env_float, env_int,
                                              env_is_set, env_str)
 from horovod_tpu.common.hvd_logging import get_logger
@@ -379,6 +379,9 @@ class ElasticDriver:
                                   log_path=self._worker_log_path(key))
                 self._workers[key] = w
                 self._worker_spawn_gen[key] = gen
+                journal.emit("driver", "worker_adopt",
+                             control_epoch=self._epoch, generation=gen,
+                             host=key[0], local_rank=key[1])
         recovery_s = (first_beat or time.monotonic()) - t0
         reg = get_registry()
         reg.counter("hvd_driver_recoveries_total",
@@ -392,6 +395,10 @@ class ElasticDriver:
                  "recovery_seconds": round(recovery_s, 3)}
         self._logger.warning("driver recovered: %s", json.dumps(event))
         self._log(f"driver_recovered: {json.dumps(event)}")
+        journal.emit("driver", "driver_recovered", control_epoch=self._epoch,
+                     generation=gen, adopted=len(adopted),
+                     expected=len(slots),
+                     recovery_seconds=round(recovery_s, 3))
         if len(adopted) < len(slots):
             # dead slots (or a resize/drain cut down mid-flight): the
             # normal rebalance machinery finishes the interrupted round
@@ -595,6 +602,9 @@ class ElasticDriver:
             publish_assignments(self._kv, slots, controller_addr,
                                 controller_port, data_port, generation=gen,
                                 epoch=self._epoch)
+            journal.emit("driver", "resize", control_epoch=self._epoch,
+                         generation=gen, slots=len(slots),
+                         hosts=len(host_list), first=bool(first))
             # mark slots no longer present as removed so resetting workers
             # on removed hosts exit cleanly (reference: gloo_context.cc
             # throws when the host is gone)
@@ -658,6 +668,9 @@ class ElasticDriver:
                                  rendezvous_addr=rdv_addr,
                                  epoch=self._epoch)
                 self._log(f"spawning worker {key} (generation {gen})")
+                journal.emit("driver", "worker_spawn",
+                             control_epoch=self._epoch, generation=gen,
+                             host=key[0], local_rank=key[1])
                 self._worker_spawn_gen[key] = gen
                 log_path = self._worker_log_path(key)
                 if log_path is not None and \
@@ -686,6 +699,8 @@ class ElasticDriver:
             self._target_np = min(self._target_np + 1, self._max_np)
             target = self._target_np
         self._log(f"autoscale: scale-up, target fleet -> {target}")
+        journal.emit("driver", "scale_up", control_epoch=self._epoch,
+                     generation=self._generation, target=target)
         self._rebalance_needed.set()
 
     def administrative_drain(self, key) -> bool:
@@ -745,6 +760,9 @@ class ElasticDriver:
                                          if h != key[0]] + [key[0]]
             target = self._target_np
         self._log(f"autoscale: draining {key} (target fleet {target})")
+        journal.emit("driver", "admin_drain", control_epoch=self._epoch,
+                     generation=self._generation, host=key[0],
+                     local_rank=key[1], target=target)
         try:
             w.terminate()  # the preemption notice, not a kill
         except Exception as e:  # noqa: BLE001 — the rebalance still
@@ -793,6 +811,9 @@ class ElasticDriver:
             "hvd_elastic_drains_total",
             "preemption-notice drains observed by the driver").inc()
         self._logger.warning("preemption drain: %s", json.dumps(event))
+        journal.emit("driver", "preempt_drain", control_epoch=self._epoch,
+                     generation=gen, host=host, local_rank=local_rank,
+                     announced_generation=announced_generation)
         self._log(f"drain announced by {host}/{local_rank}; "
                   f"scheduling proactive resize")
         self._rebalance_needed.set()
@@ -825,6 +846,11 @@ class ElasticDriver:
                     # post-mortem — the drain announcement already
                     # scheduled the resize
                     self._log(f"drained worker {key} exited (code {code})")
+                    journal.emit("driver", "worker_exit",
+                                 control_epoch=self._epoch,
+                                 generation=self._generation, host=host,
+                                 local_rank=local_rank, reason="drained",
+                                 exit_code=code)
                     del self._workers[key]
                     self._removed_slots.discard(key)
                     if key in self._admin_drains:
@@ -847,6 +873,11 @@ class ElasticDriver:
                         # a slot dropped by a scale-down exits cleanly; it
                         # is not a job-completion signal
                         self._log(f"removed worker {key} exited")
+                        journal.emit("driver", "worker_exit",
+                                     control_epoch=self._epoch,
+                                     generation=self._generation,
+                                     host=host, local_rank=local_rank,
+                                     reason="removed", exit_code=code)
                         del self._workers[key]
                         self._removed_slots.discard(key)
                         continue
@@ -881,10 +912,20 @@ class ElasticDriver:
                         late_drains.append(key)
                         continue
                     self._log(f"worker {key} finished successfully")
+                    journal.emit("driver", "worker_exit",
+                                 control_epoch=self._epoch,
+                                 generation=self._generation, host=host,
+                                 local_rank=local_rank, reason="success",
+                                 exit_code=0)
                     self._result = 0 if self._result is None else self._result
                     self._shutdown.set()
                     continue
                 self._log(f"worker {key} failed with code {code}")
+                journal.emit("driver", "worker_exit",
+                             control_epoch=self._epoch,
+                             generation=self._generation, host=host,
+                             local_rank=local_rank, reason="failure",
+                             exit_code=code)
                 del self._workers[key]
                 failed.append((key, code))
                 self._host_failures[host] = \
@@ -893,6 +934,10 @@ class ElasticDriver:
                     self._log(f"blacklisting {host} (cooldown applies — "
                               f"see HOROVOD_BLACKLIST_COOLDOWN_SECONDS)")
                     self._hosts.blacklist(host)
+                    journal.emit("driver", "host_blacklist",
+                                 control_epoch=self._epoch,
+                                 generation=self._generation, host=host,
+                                 failures=self._failures_to_blacklist)
                     self._host_failures.pop(host, None)
                 # request an explicit rebalance (respawns the dead slot at a
                 # fresh generation); replaces the prior hack of clearing the
@@ -960,6 +1005,14 @@ class ElasticDriver:
                 return
             verdict = flight.analyze(dumps)
             self.flight_verdicts.append(verdict)
+            journal.emit("driver", "flight_verdict",
+                         control_epoch=self._epoch,
+                         generation=self._generation,
+                         dead_ranks=verdict.get("dead_ranks"),
+                         desync=verdict.get("desync"),
+                         lagging_rank=verdict.get("lagging_rank"),
+                         failed=sorted(f"{k[0]}/{k[1]}"
+                                       for k, _ in failed))
             for line in verdict["lines"]:
                 self._logger.warning("flight analyzer: %s", line)
                 self._log(f"flight analyzer: {line}")
@@ -1083,6 +1136,9 @@ class ElasticDriver:
         }
         self.anomaly_events.append(event)
         self._logger.warning("worker step anomaly: %s", json.dumps(event))
+        journal.emit("driver", "step_anomaly", control_epoch=self._epoch,
+                     generation=gen, rank=event["rank"], host=key[0],
+                     local_rank=key[1], new_anomalies=int(delta))
         self._log(f"anomaly event: {json.dumps(event)}")
         try:
             self._kv.put_json(kv_keys.anomaly(gen, event["rank"]), event,
@@ -1100,6 +1156,11 @@ class ElasticDriver:
             self.straggler_events.append(event)
             self._logger.warning("straggler detected: %s",
                                  json.dumps(event))
+            journal.emit("driver", "straggler", control_epoch=self._epoch,
+                         generation=event.get("generation"),
+                         rank=event.get("rank"),
+                         step_time_sec=event.get("step_time_sec"),
+                         median_sec=event.get("median_sec"))
             self._log(f"straggler event: {json.dumps(event)}")
             try:
                 self._kv.put_json(

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from horovod_tpu.common import journal
 from horovod_tpu.common.env_registry import env_float
 from horovod_tpu.common.hvd_logging import get_logger
 
@@ -55,6 +56,8 @@ def _default_abort(outage_seconds: float):
         "headless deadline exceeded: %s",
         json.dumps({"event": "headless_deadline_exceeded",
                     "outage_seconds": round(outage_seconds, 1)}))
+    journal.emit("worker", "headless_abort",
+                 outage_seconds=round(outage_seconds, 1))
     os._exit(75)  # EX_TEMPFAIL: the control plane never came back
 
 
@@ -124,6 +127,7 @@ def note_failure():
         _logger.warning(
             "driver unreachable: %s",
             json.dumps({"event": "headless_entered"}))
+        journal.emit("worker", "headless_entered")
     deadline = env_float("HOROVOD_HEADLESS_DEADLINE_SECONDS")
     if deadline and deadline > 0 and outage > deadline:
         (hook or _default_abort)(outage)
@@ -145,6 +149,9 @@ def note_success(client=None):
     except Exception:  # noqa: BLE001
         pass
     if was is not None:
+        journal.emit("worker", "headless_exited",
+                     outage_seconds=round(time.monotonic() - was, 1),
+                     replaying_writes=len(pending))
         _logger.warning(
             "driver reachable again: %s",
             json.dumps({"event": "headless_exited",

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Optional
 
+from horovod_tpu.common import journal
 from horovod_tpu.common.env_registry import env_bool, env_str
 from horovod_tpu.common.hvd_logging import get_logger
 
@@ -106,6 +107,9 @@ def _announce():
                                             deadline=10.0)
         _logger.warning("preemption notice: announced drain for %s/%s",
                         host, slot)
+        journal.emit("worker", "drain_announce",
+                     generation=payload["generation"], host=host,
+                     local_rank=slot)
     except Exception as e:  # noqa: BLE001 — the driver also sees the exit
         # headless mode (driver mid-restart): queue the announcement so
         # the heartbeat thread replays it the moment the KV returns
@@ -283,4 +287,5 @@ def finalize_drain(state=None):
         except Exception:  # noqa: BLE001 — the exit code still says clean
             pass
     _logger.warning("drain complete; exiting cleanly")
+    journal.emit("worker", "drain_finalize")
     raise SystemExit(0)

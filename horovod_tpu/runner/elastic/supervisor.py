@@ -34,6 +34,7 @@ import sys
 import time
 from typing import List, Optional
 
+from horovod_tpu.common import journal
 from horovod_tpu.common.env_registry import env_float, env_int, env_str
 from horovod_tpu.common.hvd_logging import get_logger
 
@@ -161,6 +162,8 @@ class _ReplicaFleet:
                     "kv replica %d died (exit %s); respawning: %s", i, rc,
                     json.dumps({"event": "kv_replica_respawn",
                                 "replica": i, "exit_code": rc}))
+                journal.emit("supervisor", "kv_replica_respawn",
+                             replica=i, exit_code=rc)
                 self.procs[i] = self._spawn(i, self.endpoints, self.kv_dir)
 
     def stop(self):
@@ -224,11 +227,15 @@ def _supervise(cmd: List[str], kv_dir: str,
             event = {"event": "driver_crash", "exit_code": rc,
                      "restart": restarts, "limit": limit}
             _logger.warning("driver crashed: %s", json.dumps(event))
+            journal.emit("supervisor", "driver_crash", exit_code=rc,
+                         restart=restarts, limit=limit)
             sys.stderr.write(f"[supervisor] driver crashed (exit {rc}); "
                              f"respawn {restarts}/{limit}\n")
             sys.stderr.flush()
             if limit and restarts > limit:
                 _logger.error("driver restart limit exhausted")
+                journal.emit("supervisor", "restart_limit_exhausted",
+                             exit_code=rc, restarts=restarts, limit=limit)
                 return rc if rc else 1
             if backoff > 0:
                 time.sleep(backoff)

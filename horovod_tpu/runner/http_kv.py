@@ -712,6 +712,9 @@ class KVServer:
                 "fenced stale control epoch: %s",
                 json.dumps({"event": "stale_epoch_rejected",
                             "offered": e.offered, "current": e.current}))
+            from horovod_tpu.common import journal
+            journal.emit("kv", "stale_epoch_rejected",
+                         control_epoch=e.current, offered=e.offered)
         except Exception:  # noqa: BLE001 — logging must not mask the 409
             pass
 

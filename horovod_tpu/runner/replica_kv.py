@@ -74,7 +74,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib import error as urlerror
 from urllib import request as urlrequest
 
-from horovod_tpu.common import kv_keys
+from horovod_tpu.common import journal, kv_keys
 from horovod_tpu.runner.http_kv import (LEADER_HEADER, KVClient, KVServer,
                                         StaleEpochError)
 
@@ -386,6 +386,9 @@ class ReplicaKVServer(KVServer):
             _logger().warning(
                 "kv-replica %d: self-fencing (stepping down): %s",
                 self.replica_id, why)
+            journal.emit("replica_kv", "self_fence",
+                         control_epoch=self.epoch,
+                         replica=self.replica_id, why=why)
         self._role = "follower"
         self._leader_id = None
         self._lease_until = 0.0
@@ -524,6 +527,10 @@ class ReplicaKVServer(KVServer):
                 "kv-replica %d: elected leader (epoch %d, wal seq %d, "
                 "%d/%d votes)", self.replica_id, proposed, my_len, votes,
                 len(self._endpoints))
+            journal.emit("replica_kv", "elected_leader",
+                         control_epoch=proposed, replica=self.replica_id,
+                         wal_seq=my_len, votes=votes,
+                         replicas=len(self._endpoints))
             # persist + replicate the lease grant; failing to establish
             # it with a majority immediately self-fences
             self._replicate({"op": "lease", "leader": self.replica_id,
@@ -570,6 +577,11 @@ class ReplicaKVServer(KVServer):
                     "%d > leader seq %d; %d diverged key(s): %s)",
                     self.replica_id, self._seq, leader_seq,
                     len(diverged), diverged[:8])
+                journal.emit("replica_kv", "divergence_repair",
+                             control_epoch=term,
+                             replica=self.replica_id,
+                             local_seq=self._seq, leader_seq=leader_seq,
+                             diverged=len(diverged))
             elif self._seq < leader_seq:
                 _logger().info(
                     "kv-replica %d: catching up from leader %s "

@@ -38,6 +38,7 @@ import uuid
 from collections import deque
 from typing import List, Optional, Sequence, Tuple
 
+from horovod_tpu.common import journal
 from horovod_tpu.common.env_registry import env_float, env_int
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
 from horovod_tpu.obs.tracing import QUEUE_WAIT, get_tracer, now_us
@@ -331,6 +332,10 @@ class ContinuousBatcher:
         if req.done:
             return
         was_queued = req.status == "queued"
+        if status in ("expired", "rejected"):
+            journal.emit("serve", f"request_{status}", trace_id=req.trace,
+                         request_id=req.id, error=error,
+                         was_queued=was_queued)
         req.finish(status, error)
         if req.lease is not None and self.cache is not None:
             # the expiry split: a request that never left the queue only

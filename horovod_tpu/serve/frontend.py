@@ -33,6 +33,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from horovod_tpu.common import journal
 from horovod_tpu.metrics import histogram_quantile, snapshot_histogram, \
     snapshot_value
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
@@ -248,6 +249,12 @@ class ServeFrontend:
         verdict = self.admission.admit(body, queue_fill)
         if verdict.ok:
             return None
+        tid = body.get("trace")
+        journal.emit("serve", "shed", reason=verdict.reason,
+                     priority_class=verdict.cls,
+                     queue_fill=round(queue_fill, 3),
+                     trace_id=tid.get("id") if isinstance(tid, dict)
+                     else None)
         return 429, {"error": verdict.reason, "status": "rejected",
                      "priority_class": verdict.cls,
                      "retry_after_seconds": verdict.retry_after_seconds}
@@ -273,6 +280,8 @@ class ServeFrontend:
                         request_id=body.get("id"),
                         trace=tid)
                 except AdmissionRejected as e:
+                    journal.emit("serve", "shed", reason=str(e),
+                                 trace_id=tid)
                     shed = 429, {"error": str(e), "status": "rejected"}
         if shed is not None:
             code, payload = shed

@@ -37,6 +37,7 @@ from typing import Callable, Dict, List, Optional
 from urllib import error as urlerror
 from urllib import request as urlrequest
 
+from horovod_tpu.common import journal
 from horovod_tpu.common.env_registry import env_int
 from horovod_tpu.common.hvd_logging import get_logger
 from horovod_tpu.metrics.registry import MetricsRegistry, get_registry
@@ -207,6 +208,9 @@ class RequestRouter:
                         json.dumps({"event": "discovery_stale",
                                     "workers": len(self._workers),
                                     "generation": self.generation}))
+                    journal.emit("serve", "discovery_stale",
+                                 generation=self.generation,
+                                 workers=len(self._workers))
                 self.discovery_stale = True
             return False
         self.update_workers(info["workers"],
@@ -214,6 +218,9 @@ class RequestRouter:
         if self.discovery_stale:
             self._log.info("serve discovery recovered (generation %d)",
                            self.generation)
+            journal.emit("serve", "discovery_recovered",
+                         generation=self.generation,
+                         workers=len(self._workers))
         self.discovery_stale = False
         self._last_refresh = time.monotonic()
         return True
@@ -249,6 +256,8 @@ class RequestRouter:
         if orphans:
             self._log.warning("worker %s died with %d request(s) in "
                               "flight; re-routing", worker_id, len(orphans))
+        journal.emit("serve", "worker_failed", generation=self.generation,
+                     worker=worker_id, orphans=len(orphans))
         return orphans
 
     def drain_worker(self, worker_id: str) -> List[str]:
@@ -326,6 +335,9 @@ class RequestRouter:
                 self.fail_worker(worker.id)
                 if attempt < self.retry_limit:
                     self._rerouted.inc()
+                    journal.emit("serve", "re_route", trace_id=tid,
+                                 request_id=request_id,
+                                 failed_worker=worker.id, attempt=attempt)
                     # span covers the failed dispatch attempt — the time
                     # the re-route decision cost this request
                     get_tracer().record(

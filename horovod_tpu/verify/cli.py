@@ -7,6 +7,7 @@ Usage::
     hvd-check --mutant epoch_accept_stale_notify
                                       # seeded bug: expects a counterexample
     hvd-check --conformance DIR       # replay flight dumps + KV WALs
+                                      #   + event journals
     hvd-check --list-specs / --list-mutants
     make check-protocols              # repo-root CI target
     make conformance                  # replay the latest soak artifacts
@@ -54,12 +55,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="keep exploring after the first counterexample")
     p.add_argument("--conformance", metavar="DIR",
                    help="replay artifacts (flight_rank*.json dumps, KV "
-                        "wal.log/snapshot.json) under DIR against the "
-                        "protocol rules")
+                        "wal.log/snapshot.json, journal_*.log event "
+                        "journals) under DIR against the protocol rules")
     p.add_argument("--kv-dir", help="explicit KV directory for "
                                     "--conformance")
     p.add_argument("--flight-dir", help="explicit flight-dump directory "
                                         "for --conformance")
+    p.add_argument("--journal-dir", help="explicit event-journal "
+                                         "directory for --conformance")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--list-specs", action="store_true")
@@ -79,7 +82,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.conformance:
         report = conformance.check_artifacts(
             args.conformance, kv_dir=args.kv_dir,
-            flight_dir=args.flight_dir)
+            flight_dir=args.flight_dir, journal_dir=args.journal_dir)
         if args.as_json:
             print(json.dumps(report, indent=2))
         else:

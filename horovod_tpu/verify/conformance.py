@@ -316,14 +316,82 @@ def check_flight_dumps(dumps: Dict[int, dict]) -> List[str]:
 
 
 # ===========================================================================
+# Event-journal replay
+# ===========================================================================
+
+def check_journal(journal_dir) -> List[str]:
+    """Divergences between a durable event journal and its protocol
+    rules. Empty list = conformant. Three audits, sharing the carry-
+    forward style of :func:`_audit_stream`:
+
+    - per-writer, per-component ``seq`` strict monotonicity (the
+      JournalSpec's durable-order invariant, checked on real artifacts);
+    - epoch-claim monotonicity over each writer's stream — a journal
+      record claiming an older ``control_epoch`` after a newer one was
+      recorded means a fenced-out incarnation kept emitting;
+    - generation regression per writer (same rule ``_audit_stream``
+      applies to the ``_GENERATION_FAMILIES`` KV records).
+    """
+    from horovod_tpu.common import journal as _journal
+    out: List[str] = []
+    files = _journal.segment_files(journal_dir)
+    if not files:
+        out.append(f"{journal_dir}: no journal_*.log segments — not a "
+                   "journal directory")
+        return out
+    for writer, segments in sorted(files.items()):
+        last_seq: Optional[int] = None
+        max_epoch: Optional[int] = None
+        max_gen: Optional[int] = None
+        i = -1
+        for seg in segments:
+            for rec in _journal.iter_segment(seg):
+                i += 1
+                label = f"journal[{writer}][{i}]"
+                seq = rec.get("seq")
+                if not isinstance(seq, int):
+                    out.append(f"{label}: missing/non-integer seq "
+                               f"{seq!r}")
+                elif last_seq is not None and seq <= last_seq:
+                    out.append(
+                        f"{label}: seq {seq} after {last_seq} — the "
+                        "per-writer append order regressed (rotation "
+                        "dropped an unflushed segment, or two "
+                        "processes shared one writer id)")
+                if isinstance(seq, int):
+                    last_seq = seq if last_seq is None \
+                        else max(last_seq, seq)
+                e = rec.get("control_epoch")
+                if isinstance(e, int):
+                    if max_epoch is not None and e < max_epoch:
+                        out.append(
+                            f"{label}: event {rec.get('event')!r} "
+                            f"claimed control epoch {e} after "
+                            f"{max_epoch} — a fenced-out incarnation "
+                            "kept emitting (split-brain)")
+                    max_epoch = max(max_epoch or e, e)
+                g = rec.get("generation")
+                if isinstance(g, int):
+                    if max_gen is not None and g < max_gen:
+                        out.append(
+                            f"{label}: event {rec.get('event')!r} "
+                            f"carried generation {g} after {max_gen} — "
+                            "generation regressed within one writer")
+                    max_gen = max(max_gen or g, g)
+    return out
+
+
+# ===========================================================================
 # Artifact-directory front door
 # ===========================================================================
 
-def check_artifacts(path, kv_dir=None, flight_dir=None) -> dict:
+def check_artifacts(path, kv_dir=None, flight_dir=None,
+                    journal_dir=None) -> dict:
     """Replay every artifact found under ``path`` (or the explicit
-    ``kv_dir``/``flight_dir`` overrides): ``{"checked": [...],
-    "divergences": [...]}``. A soak artifact directory usually holds the
-    control-plane KV dir (wal.log) and a set of flight_rank*.json."""
+    ``kv_dir``/``flight_dir``/``journal_dir`` overrides):
+    ``{"checked": [...], "divergences": [...]}``. A soak artifact
+    directory usually holds the control-plane KV dir (wal.log), a set
+    of flight_rank*.json, and a journal/ of journal_*.log segments."""
     path = Path(path)
     checked: List[str] = []
     divergences: List[str] = []
@@ -355,15 +423,28 @@ def check_artifacts(path, kv_dir=None, flight_dir=None) -> dict:
         divergences += [f"{d}: {line}"
                         for line in check_flight_dumps(dumps)]
 
+    journal_candidates = [Path(journal_dir)] if journal_dir else [
+        d for d in [path, path / "journal", *sorted(path.glob("**/"))]
+        if sorted(d.glob("journal_*.log"))]
+    seen = set()
+    for d in journal_candidates:
+        d = d.resolve()
+        if d in seen:
+            continue
+        seen.add(d)
+        checked.append(f"journal: {d}")
+        divergences += [f"{d}: {line}" for line in check_journal(d)]
+
     if not checked:
         divergences.append(
-            f"{path}: no wal.log/snapshot.json or flight_rank*.json "
-            "artifacts found")
+            f"{path}: no wal.log/snapshot.json, flight_rank*.json, or "
+            "journal_*.log artifacts found")
     return {"checked": checked, "divergences": divergences}
 
 
 def copy_soak_artifacts(kv_dir: Optional[str] = None,
-                        flight_dir: Optional[str] = None):
+                        flight_dir: Optional[str] = None,
+                        journal_dir: Optional[str] = None):
     """Copy a soak run's artifacts to ``HOROVOD_SOAK_ARTIFACT_DIR`` (if
     set) so ``make conformance`` can replay the latest soak after the
     fact. Best-effort by design — artifact export must never fail a
@@ -384,6 +465,15 @@ def copy_soak_artifacts(kv_dir: Optional[str] = None,
             target.mkdir(exist_ok=True)
             for f in Path(flight_dir).glob("flight_rank*.json"):
                 shutil.copy(f, target / f.name)
+        journal_dir = journal_dir or env_str("HOROVOD_JOURNAL_DIR")
+        if journal_dir and Path(journal_dir).exists():
+            target = Path(dest) / "journal"
+            target.mkdir(exist_ok=True)
+            if Path(journal_dir).resolve() != target.resolve():
+                # `make soak` journals straight into <dest>/journal —
+                # already in place, nothing to copy
+                for f in Path(journal_dir).glob("journal_*.log"):
+                    shutil.copy(f, target / f.name)
         return dest
     except OSError:
         return None

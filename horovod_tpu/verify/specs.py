@@ -16,7 +16,9 @@ Explicit state machines covering the interlocking control protocols
 - :class:`PagedCacheSpec` — serving block-paged KV-cache accounting;
 - :class:`ScrapeSpec` — the tiered telemetry scrape plane;
 - :class:`ReplicaSpec` — leader-lease KV replication: majority-ack
-  commit, epoch-as-term elections, self-fencing, divergence repair.
+  commit, epoch-as-term elections, self-fencing, divergence repair;
+- :class:`JournalSpec` — the durable event journal: flush-then-ack,
+  segment rotation, closed-segment retention, crash-loss accounting.
 
 Spec constants come from the real code: the express threshold and flag
 bits are parsed out of ``engine/src`` (``engine_constants``), KV keys in
@@ -1619,6 +1621,165 @@ class ScrapeSpec(Spec):
         ]
 
 
+# ===========================================================================
+# Durable event journal (common/journal.py)
+# ===========================================================================
+
+class JournalState(NamedTuple):
+    buffered: tuple        # (comp, seq) appended, not yet durable
+    active_durable: tuple  # flushed records still in the active segment
+    closed_segs: tuple     # closed segments, oldest first (tuples of events)
+    acked: frozenset       # events whose emitter was told "recorded"
+    retired: frozenset     # retention-deleted (closed segments only)
+    lost: frozenset        # gone without ever becoming durable
+    crashed: bool          # the writer process died (buffer gone)
+    next_seq: tuple        # per-component next sequence number
+    appends_left: int
+    rotations_left: int
+    crashes_left: int
+
+
+class JournalSpec(Spec):
+    """One journal writer appending events for two components, with
+    segment rotation, closed-segment retention, and a crash that loses
+    whatever is buffered but not flushed. The durable order — closed
+    segments oldest-first, then the active segment's flushed records —
+    is exactly what :func:`common.journal.iter_journal` replays and what
+    ``hvd-check --conformance``'s journal auditor checks on real
+    artifacts.
+
+    Mutations re-introduce the three ways a journal silently lies:
+    acking before the flush (a crash then loses an acked event), seq
+    reset at rotation (replay order becomes ambiguous across segments),
+    and rotation closing the active segment without flushing its tail
+    (durable-looking records evaporate with no crash at all)."""
+
+    COMPONENTS = ("driver", "serve")
+
+    def __init__(self, appends: int = 4, rotations: int = 2,
+                 crashes: int = 1, keep: int = 1,
+                 ack_before_flush: bool = False,
+                 seq_reset_on_rotate: bool = False,
+                 rotate_skip_flush: bool = False):
+        super().__init__(name="journal", mutations=tuple(
+            m for m, on in [("ack_before_flush", ack_before_flush),
+                            ("seq_reset_on_rotate", seq_reset_on_rotate),
+                            ("rotate_skip_flush", rotate_skip_flush)]
+            if on))
+        self.appends = appends
+        self.rotations = rotations
+        self.crashes = crashes
+        self.keep = keep  # retention: closed segments retained
+        self.ack_before_flush = ack_before_flush
+        self.seq_reset_on_rotate = seq_reset_on_rotate
+        self.rotate_skip_flush = rotate_skip_flush
+
+    def initial(self) -> JournalState:
+        return JournalState(
+            buffered=(), active_durable=(), closed_segs=(),
+            acked=frozenset(), retired=frozenset(), lost=frozenset(),
+            crashed=False, next_seq=(0,) * len(self.COMPONENTS),
+            appends_left=self.appends, rotations_left=self.rotations,
+            crashes_left=self.crashes)
+
+    @staticmethod
+    def _durable_order(s: JournalState) -> tuple:
+        out: tuple = ()
+        for seg in s.closed_segs:
+            out += seg
+        return out + s.active_durable
+
+    def actions(self, s: JournalState):
+        out = []
+        if s.appends_left > 0 and not s.crashed:
+            for ci, comp in enumerate(self.COMPONENTS):
+                seq = s.next_seq[ci]
+                ev = (comp, seq)
+                nxt = s._replace(
+                    buffered=s.buffered + (ev,),
+                    next_seq=_rep(s.next_seq, ci, seq + 1),
+                    appends_left=s.appends_left - 1)
+                if self.ack_before_flush:
+                    # the seeded lie: the emitter hears "recorded"
+                    # while the record is still a volatile buffer
+                    nxt = nxt._replace(acked=nxt.acked | {ev})
+                out.append((f"append({comp}, seq={seq})", nxt))
+        if s.buffered and not s.crashed:
+            out.append(("flush(ack)", s._replace(
+                buffered=(),
+                active_durable=s.active_durable + s.buffered,
+                acked=s.acked | frozenset(s.buffered))))
+        if s.rotations_left > 0 and not s.crashed and \
+                (s.active_durable or s.buffered):
+            if self.rotate_skip_flush:
+                # seeded bug: close the active segment without flushing
+                # its tail — the buffered records just evaporate
+                nxt = s._replace(
+                    buffered=(), lost=s.lost | frozenset(s.buffered),
+                    active_durable=(),
+                    closed_segs=s.closed_segs + (s.active_durable,),
+                    rotations_left=s.rotations_left - 1)
+            else:
+                nxt = s._replace(
+                    buffered=(), active_durable=(),
+                    acked=s.acked | frozenset(s.buffered),
+                    closed_segs=s.closed_segs +
+                    (s.active_durable + s.buffered,),
+                    rotations_left=s.rotations_left - 1)
+            if self.seq_reset_on_rotate:
+                nxt = nxt._replace(
+                    next_seq=(0,) * len(self.COMPONENTS))
+            out.append(("rotate(flush+close)", nxt))
+        if len(s.closed_segs) > self.keep:
+            # retention prunes oldest CLOSED segments only; the active
+            # segment is structurally out of reach
+            out.append(("retention.delete(oldest closed)", s._replace(
+                closed_segs=s.closed_segs[1:],
+                retired=s.retired | frozenset(s.closed_segs[0]))))
+        if s.crashes_left > 0 and s.buffered and not s.crashed:
+            out.append(("crash(buffer lost)", s._replace(
+                buffered=(), lost=s.lost | frozenset(s.buffered),
+                crashed=True, crashes_left=s.crashes_left - 1,
+                appends_left=0)))
+        return out
+
+    @property
+    def invariants(self) -> List[Invariant]:
+        def no_lost_acked(s: JournalState) -> bool:
+            durable = set(self._durable_order(s))
+            return all(e in durable or e in s.retired for e in s.acked)
+
+        def seq_monotone(s: JournalState) -> bool:
+            last: Dict[str, int] = {}
+            for comp, seq in self._durable_order(s):
+                if comp in last and seq <= last[comp]:
+                    return False
+                last[comp] = seq
+            return True
+
+        return [
+            Invariant(
+                "no_lost_acked_event",
+                "every acked event is durable (flushed segment) or was "
+                "retired by retention after being durable — never "
+                "sitting in a volatile buffer a crash can take",
+                no_lost_acked),
+            Invariant(
+                "per_component_seq_monotone",
+                "the durable replay order (closed segments oldest-"
+                "first, then the active segment) carries strictly "
+                "increasing seq per component — the property the "
+                "journal auditor checks on real artifacts",
+                seq_monotone),
+            Invariant(
+                "rotation_never_drops_unflushed",
+                "no event is ever lost without a crash: rotation "
+                "flushes the active tail before closing, and retention "
+                "only deletes closed (fully durable) segments",
+                lambda s: not s.lost or s.crashed),
+        ]
+
+
 SPECS: Dict[str, type] = {
     "cycle": CycleSpec,
     "epoch": EpochSpec,
@@ -1628,6 +1789,7 @@ SPECS: Dict[str, type] = {
     "paged_cache": PagedCacheSpec,
     "scrape": ScrapeSpec,
     "replica": ReplicaSpec,
+    "journal": JournalSpec,
 }
 
 # mutant name -> (spec name, constructor kwarg, description). Each is a
@@ -1734,6 +1896,23 @@ MUTANTS: Dict[str, Tuple[str, str, str]] = {
         "the (client, seq) idempotency-token dedupe removed: a client "
         "retry after a timed-out-but-committed write re-appends the "
         "same op, which lands twice in every replica's WAL"),
+    "journal_ack_before_flush": (
+        "journal", "ack_before_flush",
+        "journal append acks the emitter before the segment flush: a "
+        "crash in the gap loses an event the caller was told is "
+        "durable, so hvd-doctor's timeline silently misses the acked "
+        "evidence"),
+    "journal_seq_reset_on_rotate": (
+        "journal", "seq_reset_on_rotate",
+        "the per-writer sequence counter restarts at segment rotation: "
+        "replayed seqs regress across the segment boundary and the "
+        "journal auditor's per-component monotonicity (the doctor's "
+        "tie-breaking order) is violated"),
+    "journal_rotate_skip_flush": (
+        "journal", "rotate_skip_flush",
+        "rotation closes the active segment without flushing its "
+        "buffered tail: records evaporate with no crash anywhere — the "
+        "rotation-never-drops-an-unflushed-segment rule is violated"),
     "scrape_consume_stale_window": (
         "scrape", "consume_stale_window",
         "the per-host window floor removed: an age-fresh /agg.json "

@@ -33,6 +33,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from horovod_tpu.common import journal
+
 
 def find_worker_pids(pattern: str) -> List[int]:
     """PIDs of live processes whose command line matches ``pattern``
@@ -528,6 +530,7 @@ class SimCluster:
         self._pending_kills: List[tuple] = []
         self.last_resize_stats: dict = {}
         self._ctx = None
+        self.generation = 0
         self.handoffs: Dict[tuple, dict] = {}
         with self._phase(world):
             for r in range(world):
@@ -637,19 +640,41 @@ class SimCluster:
         buddy is still alive is judged at resize time (the buddy may die
         in the same incident)."""
         victim = self.members[idx]
-        self._pending_kills.append(
-            (victim.state._world, victim.state._old_rank))
+        old_rank = victim.state._old_rank
+        self._pending_kills.append((victim.state._world, old_rank))
         del self.members[idx]
+        journal.emit("driver", "worker_exit", generation=self.generation,
+                     reason="failure", exit_code=-9,
+                     host=f"sim{old_rank}", local_rank=old_rank)
 
     def drain(self, idx: int):
         """Preemption notice: the member hands off its LIVE shard (the
         real handoff payload) and departs cleanly."""
         victim = self.members[idx]
         world, old_rank, payload = victim.state.shard_handoff_payload()
+        journal.emit("worker", "drain_announce",
+                     generation=self.generation,
+                     host=f"sim{old_rank}", local_rank=old_rank)
         if payload:
             self.handoffs[(world, old_rank)] = {
                 "combined": payload["combined"]}
         del self.members[idx]
+        journal.emit("driver", "worker_exit", generation=self.generation,
+                     reason="drained", exit_code=0,
+                     host=f"sim{old_rank}", local_rank=old_rank)
+
+    def kill_during_drain(self, idx: int):
+        """The drain race: the preemption notice lands (the drain is
+        announced in the journal and to the driver) but the host is
+        reaped before the live-shard handoff completes — exactly a too-
+        short preemption window. The shard falls back to its ring
+        buddy's committed copy, like a plain kill."""
+        victim = self.members[idx]
+        old_rank = victim.state._old_rank
+        journal.emit("worker", "drain_announce",
+                     generation=self.generation,
+                     host=f"sim{old_rank}", local_rank=old_rank)
+        self.kill(idx)
 
     def rejoin(self, n: int = 1):
         """n fresh joiners (new hosts after a cooldown / replacement spot
@@ -690,6 +715,10 @@ class SimCluster:
         with self._phase(world):
             self._run_members(lambda i, m: m.state.sync())
         dt = time.monotonic() - t0
+        self.generation += 1
+        journal.emit("driver", "resize", generation=self.generation,
+                     slots=world, hosts=world,
+                     first=(self.generation == 1))
         self.last_resize_stats = {"recovery_seconds": dt, "world": world}
         return dt
 

@@ -1,0 +1,233 @@
+"""The durable event journal (common/journal.py): framing, rotation,
+retention, crash tolerance, and the emit() front door's contract that it
+can never hurt the caller."""
+
+import json
+import os
+import threading
+import zlib
+
+import pytest
+
+from horovod_tpu.common import journal
+
+
+@pytest.fixture(autouse=True)
+def _fresh_journal(monkeypatch):
+    monkeypatch.delenv("HOROVOD_JOURNAL_DIR", raising=False)
+    journal._reset_for_tests()
+    yield
+    journal._reset_for_tests()
+
+
+def _enable(monkeypatch, tmp_path):
+    d = tmp_path / "journal"
+    monkeypatch.setenv("HOROVOD_JOURNAL_DIR", str(d))
+    journal._reset_for_tests()
+    return d
+
+
+# ---------------------------------------------------------------------------
+# emit() front door
+# ---------------------------------------------------------------------------
+
+def test_emit_noop_when_unset():
+    assert not journal.enabled()
+    assert journal.emit("driver", "resize", generation=1) is None
+
+
+def test_emit_appends_and_replays(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    journal.emit("driver", "resize", generation=3, slots=4, hosts=2)
+    journal.emit("serve", "shed", reason="full", trace_id="t1")
+    events = journal.load_events(d)
+    assert [e["event"] for e in events] == ["resize", "shed"]
+    r = events[0]
+    # typed schema: lifted fields top-level, the rest under detail
+    assert r["component"] == "driver" and r["generation"] == 3
+    assert r["detail"] == {"slots": 4, "hosts": 2}
+    assert r["seq"] == 1 and r["pid"] == os.getpid()
+    assert r["t_mono"] > 0 and r["t_wall"] > 0
+    assert r["id"].endswith(":1")
+    assert events[1]["trace_id"] == "t1"
+
+
+def test_emit_never_raises(monkeypatch, tmp_path):
+    # a file where the directory should be: every writer op fails, the
+    # caller must never notice
+    bad = tmp_path / "notadir"
+    bad.write_text("x")
+    monkeypatch.setenv("HOROVOD_JOURNAL_DIR", str(bad / "sub"))
+    journal._reset_for_tests()
+    for _ in range(3):
+        assert journal.emit("driver", "x") is None
+
+
+def test_emit_unserializable_detail_never_raises(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    journal.emit("driver", "weird", payload=object())
+    journal.emit("driver", "after")
+    # the poisoned record is dropped, the stream stays usable
+    assert "after" in [e["event"] for e in journal.load_events(d)]
+
+
+# ---------------------------------------------------------------------------
+# framing / crash tolerance
+# ---------------------------------------------------------------------------
+
+def test_framing_matches_wal(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    journal.emit("driver", "one")
+    seg = next(iter(journal.segment_files(d).values()))[0]
+    data = seg.read_bytes()
+    length = int.from_bytes(data[:4], "little")
+    crc = int.from_bytes(data[4:8], "little")
+    payload = data[8:8 + length]
+    assert zlib.crc32(payload) & 0xFFFFFFFF == crc
+    assert json.loads(payload)["event"] == "one"
+
+
+def test_replay_stops_at_torn_tail(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    for i in range(3):
+        journal.emit("driver", f"e{i}")
+    seg = next(iter(journal.segment_files(d).values()))[0]
+    with open(seg, "ab") as f:
+        f.write(b"\x40\x00\x00\x00garbage-torn-record")
+    events = journal.load_events(d)
+    assert [e["event"] for e in events] == ["e0", "e1", "e2"]
+
+
+def test_replay_stops_at_crc_corruption(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    journal.emit("driver", "good")
+    journal.emit("driver", "flipped")
+    seg = next(iter(journal.segment_files(d).values()))[0]
+    data = bytearray(seg.read_bytes())
+    data[-3] ^= 0xFF  # flip a byte inside the second record's payload
+    seg.write_bytes(bytes(data))
+    assert [e["event"] for e in journal.load_events(d)] == ["good"]
+
+
+# ---------------------------------------------------------------------------
+# rotation / retention / seq
+# ---------------------------------------------------------------------------
+
+def test_rotation_and_retention(tmp_path):
+    w = journal.JournalWriter(tmp_path, segment_bytes=256, max_segments=2)
+    for i in range(40):
+        w.append("driver", f"e{i}")
+    files = journal.segment_files(tmp_path)[w.writer_id]
+    assert len(files) == 2  # retention pruned the older closed segments
+    events = list(journal.iter_journal(tmp_path))
+    # the retained tail is contiguous and seq-monotone up to the last
+    seqs = [e["seq"] for e in events]
+    assert seqs == list(range(seqs[0], 41))
+    assert seqs[-1] == 40
+
+
+def test_rotation_never_deletes_active_segment(tmp_path):
+    w = journal.JournalWriter(tmp_path, segment_bytes=200, max_segments=1)
+    for i in range(20):
+        w.append("driver", f"e{i}")
+    files = journal.segment_files(tmp_path)[w.writer_id]
+    assert len(files) == 1
+    assert files[0] == w.active_path  # the survivor IS the active one
+    w.append("driver", "after-retention")
+    assert list(journal.iter_segment(w.active_path))
+
+
+def test_writer_resumes_after_restart(tmp_path):
+    w1 = journal.JournalWriter(tmp_path, host="h", pid=7)
+    w1.append("driver", "a")
+    w1.append("driver", "b")
+    w1.close()
+    # same (host, pid) writer identity restarting over the same dir must
+    # continue, not clobber: new segment index, seq keeps rising
+    w2 = journal.JournalWriter(tmp_path, host="h", pid=7)
+    w2.append("driver", "c")
+    events = list(journal.iter_journal(tmp_path))
+    assert [e["event"] for e in events] == ["a", "b", "c"]
+    assert [e["seq"] for e in events] == [1, 2, 3]
+
+
+def test_multi_writer_streams_are_separate(tmp_path):
+    wa = journal.JournalWriter(tmp_path, host="hostA", pid=1)
+    wb = journal.JournalWriter(tmp_path, host="hostB", pid=2)
+    wa.append("driver", "a1")
+    wb.append("serve", "b1")
+    wa.append("driver", "a2")
+    files = journal.segment_files(tmp_path)
+    assert len(files) == 2
+    by_writer = {}
+    for e in journal.iter_journal(tmp_path):
+        by_writer.setdefault(e["host"], []).append(e["seq"])
+    assert by_writer == {"hostA": [1, 2], "hostB": [1]}
+
+
+def test_concurrent_emit_seq_monotone(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    n, threads = 25, 4
+
+    def spam(t):
+        for i in range(n):
+            journal.emit("driver", f"t{t}e{i}")
+
+    ts = [threading.Thread(target=spam, args=(t,)) for t in range(threads)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    seqs = [e["seq"] for e in journal.load_events(d)]
+    assert seqs == list(range(1, n * threads + 1))
+
+
+# ---------------------------------------------------------------------------
+# the conformance auditor over journal artifacts
+# ---------------------------------------------------------------------------
+
+def test_check_journal_clean(monkeypatch, tmp_path):
+    from horovod_tpu.verify import conformance
+    d = _enable(monkeypatch, tmp_path)
+    journal.emit("driver", "resize", control_epoch=2, generation=1)
+    journal.emit("driver", "resize", control_epoch=2, generation=2)
+    assert conformance.check_journal(d) == []
+
+
+def test_check_journal_flags_epoch_and_generation_regress(tmp_path):
+    from horovod_tpu.verify import conformance
+    w = journal.JournalWriter(tmp_path, host="h", pid=1)
+    w.append("driver", "resize", control_epoch=3, generation=2)
+    w.append("driver", "resize", control_epoch=2, generation=1)
+    out = conformance.check_journal(tmp_path)
+    assert any("control epoch" in line for line in out)
+    assert any("generation" in line for line in out)
+
+
+def test_check_journal_flags_seq_regress(tmp_path):
+    w = journal.JournalWriter(tmp_path, host="h", pid=1)
+    w.append("driver", "a")
+    w.append("driver", "b")
+    # hand-forge a seq regression the way a rotation-drop would look
+    seg = journal.segment_files(tmp_path)[w.writer_id][0]
+    rec = {"id": "h:1:1", "seq": 1, "component": "driver",
+           "event": "forged", "host": "h", "pid": 1,
+           "t_mono": 0.0, "t_wall": 0.0}
+    payload = json.dumps(rec).encode()
+    frame = (len(payload).to_bytes(4, "little") +
+             (zlib.crc32(payload) & 0xFFFFFFFF).to_bytes(4, "little") +
+             payload)
+    with open(seg, "ab") as f:
+        f.write(frame)
+    from horovod_tpu.verify import conformance
+    out = conformance.check_journal(tmp_path)
+    assert any("seq" in line and "regressed" in line for line in out)
+
+
+def test_check_artifacts_discovers_journals(monkeypatch, tmp_path):
+    from horovod_tpu.verify import conformance
+    d = tmp_path / "artifacts" / "journal"
+    d.mkdir(parents=True)
+    w = journal.JournalWriter(d)
+    w.append("driver", "resize", generation=1)
+    report = conformance.check_artifacts(tmp_path / "artifacts")
+    assert any(c.startswith("journal:") for c in report["checked"])
+    assert not any("journal" in x for x in report["divergences"])
